@@ -1,0 +1,35 @@
+"""hadoop_bam_tpu — a TPU-native framework for distributed, splittable genomics I/O.
+
+Capability parity target: trozamon/Hadoop-BAM (Java, Hadoop MapReduce adapter
+around htsjdk).  Where Hadoop-BAM turns BAM/SAM/CRAM/VCF/BCF/FASTQ/QSEQ/FASTA
+files into record-aligned Hadoop ``InputSplit``s feeding map tasks, this
+framework turns them into record-aligned *spans* feeding a ``jax.sharding.Mesh``:
+compressed BGZF blocks are batch-inflated and records are unpacked into
+structure-of-arrays batches on device.
+
+Layer map (mirrors SURVEY.md section 7):
+
+- ``formats/``  — pure-spec codecs (BGZF, BAM, SAM, CRAM, VCF, BCF, FASTQ,
+  QSEQ, FASTA); host reference implementations, NumPy-vectorized.
+- ``split/``    — split planning: BGZF/BAM/BCF split guessers, splitting-bai /
+  .sbi sidecar indexes, per-format planners producing ``FileVirtualSpan``s.
+- ``ops/``      — device kernels (Pallas / jnp): batched record unpack to SoA,
+  sequence decode, flagstat, CRC32, tokenizers.
+- ``parallel/`` — mesh runtime: sharded decode pipeline (``shard_map`` over the
+  data axis), multi-host planning, collectives.
+- ``api/``      — user surface: ``open_bam()`` et al., format dispatch
+  (AnySAM semantics), writers, mergers.
+- ``tools/``    — CLI verbs (index, view, cat, summarize, ...).
+- ``utils/``    — seekable byte-range readers, header readers, metrics.
+
+Reference provenance: /root/reference was empty at survey time; behavior is
+built to the public format specs (SAMv1/BGZF, VCFv4.x, BCF2, CRAM) plus the
+upstream component inventory reconstructed in SURVEY.md.  Reference citations
+in docstrings use upstream paths, e.g.
+``src/main/java/org/seqdoop/hadoop_bam/BAMInputFormat.java`` (abbreviated
+``hb/``).
+"""
+
+__version__ = "0.1.0"
+
+from hadoop_bam_tpu.config import HBamConfig, ValidationStringency  # noqa: F401

@@ -1,0 +1,143 @@
+"""Typed configuration — the rebuild of Hadoop-BAM's string-keyed Configuration.
+
+The reference's entire "flag system" is Hadoop ``Configuration`` string keys
+scattered over the classes that consume them (SURVEY.md section 5):
+
+- ``hadoopbam.anysam.trust-exts``              (hb/AnySAMInputFormat.java)
+- ``hadoopbam.vcf.trust-exts``                 (hb/VCFInputFormat.java)
+- ``hadoopbam.samheaderreader.validation-stringency`` (hb/util/SAMHeaderReader.java)
+- ``hadoopbam.cram.reference-source-path``     (hb/CRAMInputFormat.java)
+- ``hadoopbam.vcf.output-format``              (hb/VCFOutputFormat.java)
+- ``hbam.fastq-input.base-quality-encoding``, ``...filter-failed-qc``
+                                               (hb/FormatConstants.java)
+- ``hadoopbam.bam.intervals``                  (hb/BAMInputFormat.java, 7.7+)
+
+Here they become one typed dataclass with the same semantic knobs, plus the
+TPU-specific knobs (backend selection, mesh shape, batch geometry).  A
+``from_dict`` constructor accepts the reference's string keys verbatim so
+Hadoop-BAM users can carry configs over unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Mapping, Optional, Sequence, Tuple
+
+
+class ValidationStringency(enum.Enum):
+    """Mirror of htsjdk ValidationStringency as consumed by
+    hb/util/SAMHeaderReader.java: governs malformed-record handling."""
+
+    STRICT = "STRICT"     # raise on malformed records
+    LENIENT = "LENIENT"   # warn and skip
+    SILENT = "SILENT"     # skip silently
+
+    @classmethod
+    def parse(cls, s: "str | ValidationStringency | None") -> "ValidationStringency":
+        if s is None:
+            return cls.SILENT
+        if isinstance(s, cls):
+            return s
+        return cls[str(s).upper()]
+
+
+class BaseQualityEncoding(enum.Enum):
+    """FASTQ/QSEQ base-quality encodings (hb/FormatConstants.java).
+    Offsets are [SPEC]: Sanger = Phred+33, Illumina(1.3-1.7) = Phred+64."""
+
+    SANGER = 33
+    ILLUMINA = 64
+
+    @classmethod
+    def parse(cls, s: "str | BaseQualityEncoding | None", default: "BaseQualityEncoding"):
+        if s is None:
+            return default
+        if isinstance(s, cls):
+            return s
+        return cls[str(s).upper()]
+
+
+# Mapping from the reference's Hadoop Configuration keys to dataclass fields.
+_HADOOP_KEY_MAP = {
+    "hadoopbam.anysam.trust-exts": "trust_exts",
+    "hadoopbam.vcf.trust-exts": "vcf_trust_exts",
+    "hadoopbam.samheaderreader.validation-stringency": "validation_stringency",
+    "hadoopbam.cram.reference-source-path": "cram_reference_source_path",
+    "hadoopbam.vcf.output-format": "vcf_output_format",
+    "hadoopbam.bam.intervals": "bam_intervals",
+    "hbam.fastq-input.base-quality-encoding": "fastq_base_quality_encoding",
+    "hbam.fastq-input.filter-failed-qc": "fastq_filter_failed_qc",
+    "hbam.qseq-input.base-quality-encoding": "qseq_base_quality_encoding",
+    "hbam.qseq-input.filter-failed-qc": "qseq_filter_failed_qc",
+    "hadoop-bam.backend": "backend",
+}
+
+
+@dataclasses.dataclass
+class HBamConfig:
+    # --- format dispatch (hb/AnySAMInputFormat.java, hb/VCFInputFormat.java) ---
+    trust_exts: bool = True          # skip magic sniffing when extension is known
+    vcf_trust_exts: bool = True
+
+    # --- decode behavior ---
+    validation_stringency: ValidationStringency = ValidationStringency.SILENT
+    cram_reference_source_path: Optional[str] = None
+
+    # --- output ---
+    vcf_output_format: str = "VCF"   # "VCF" | "BCF" (hb/VCFOutputFormat.java)
+    write_header: bool = True        # per-shard header (KeyIgnoring*RecordWriter)
+    write_terminator: bool = True    # BGZF EOF block on close
+
+    # --- FASTQ / QSEQ (hb/FormatConstants.java) ---
+    fastq_base_quality_encoding: BaseQualityEncoding = BaseQualityEncoding.SANGER
+    fastq_filter_failed_qc: bool = False
+    qseq_base_quality_encoding: BaseQualityEncoding = BaseQualityEncoding.ILLUMINA
+    qseq_filter_failed_qc: bool = False
+
+    # --- interval filtering (hb/BAMInputFormat.java upstream 7.7+) ---
+    # "chr20:1-100000,chr21" style; None = no filtering.
+    bam_intervals: Optional[str] = None
+
+    # --- split planning ---
+    split_size: int = 128 * 1024 * 1024   # analog of HDFS block size splits
+    splitting_index_granularity: int = 4096  # records per splitting-bai sample
+    use_splitting_index: bool = True      # snap splits via sidecar when present
+
+    # --- TPU backend ---
+    backend: str = "tpu"                  # "tpu" | "cpu" (host NumPy decode)
+    blocks_per_batch: int = 512           # BGZF blocks per device batch
+    records_capacity_per_block: int = 2048  # SoA capacity per 64KiB block
+    mesh_shape: Optional[Tuple[int, ...]] = None  # None = all local devices, 1D
+    mesh_axis_names: Sequence[str] = ("data",)
+    use_native: bool = True               # C++ batched inflate when available
+
+    @classmethod
+    def from_dict(cls, conf: Mapping[str, object]) -> "HBamConfig":
+        """Build from a Hadoop-style string-keyed dict (reference key names)."""
+        kwargs = {}
+        for key, value in conf.items():
+            field = _HADOOP_KEY_MAP.get(key, key)
+            kwargs[field] = value
+        return cls(**_coerce(kwargs))
+
+
+def _coerce(kwargs: dict) -> dict:
+    out = dict(kwargs)
+    if "validation_stringency" in out:
+        out["validation_stringency"] = ValidationStringency.parse(
+            out["validation_stringency"])
+    for k, default in (
+        ("fastq_base_quality_encoding", BaseQualityEncoding.SANGER),
+        ("qseq_base_quality_encoding", BaseQualityEncoding.ILLUMINA),
+    ):
+        if k in out:
+            out[k] = BaseQualityEncoding.parse(out[k], default)
+    for k in ("trust_exts", "vcf_trust_exts", "fastq_filter_failed_qc",
+              "qseq_filter_failed_qc", "write_header", "write_terminator",
+              "use_splitting_index", "use_native"):
+        if k in out and isinstance(out[k], str):
+            out[k] = out[k].lower() in ("1", "true", "yes")
+    return out
+
+
+DEFAULT_CONFIG = HBamConfig()

@@ -1,0 +1,12 @@
+"""Pure-spec format codecs (host reference implementations, NumPy-vectorized).
+
+Everything in this package is implementable directly from the public
+specifications (SAMv1/BGZF, VCFv4.x, BCF2, CRAM3, FASTQ/QSEQ conventions) —
+tagged [SPEC] in SURVEY.md — and is therefore the contract layer of the
+framework regardless of the reference snapshot.
+"""
+from hadoop_bam_tpu.formats.virtual_offset import (  # noqa: F401
+    make_voffset,
+    split_voffset,
+    VirtualOffset,
+)

@@ -1,0 +1,134 @@
+"""Whole-file BAM read/write helpers tying BGZF + BAM codecs together.
+
+These are the host-side, single-stream paths (the equivalents of "just use
+htsjdk SamReader/SAMFileWriter"): fixture generation, golden tests, the CLI,
+and writers use them.  The scaled decode path (span planning + batched device
+inflate/unpack) lives in split/ + ops/ + parallel/.
+"""
+from __future__ import annotations
+
+import io
+from typing import Iterable, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bam import (
+    BamBatch, SAMHeader, walk_record_offsets,
+)
+from hadoop_bam_tpu.formats.sam import SamRecord
+from hadoop_bam_tpu.formats.virtual_offset import make_voffset
+
+
+class BamWriter:
+    """Streaming BAM writer (header + records -> BGZF file).
+
+    Mirrors hb/KeyIgnoringBAMRecordWriter.java semantics: header emission and
+    the BGZF EOF terminator are both optional so that headerless shards can be
+    concatenated by the merger (hb/util/SAMFileMerger.java).
+    ``record_voffsets()`` exposes per-record virtual offsets for the
+    splitting-bai indexer (hb/SplittingBAMIndexer.java's MR-integrated mode).
+    """
+
+    def __init__(self, sink, header: SAMHeader, *, write_header: bool = True,
+                 write_eof: bool = True, level: int = 6,
+                 track_voffsets: bool = False):
+        self._own = False
+        if isinstance(sink, (str, bytes)):
+            sink = open(sink, "wb")
+            self._own = True
+        self._sink = sink
+        self.header = header
+        self._w = bgzf.BGZFWriter(sink, level=level, write_eof=write_eof)
+        self._voffsets: List[int] = []
+        self._track = track_voffsets
+        self.records_written = 0
+        if write_header:
+            self._w.write(header.to_bam_bytes())
+
+    def write_record_bytes(self, rec: bytes) -> int:
+        v = self._w.tell_voffset()
+        if self._track:
+            self._voffsets.append(v)
+        self._w.write(rec)
+        self.records_written += 1
+        return v
+
+    def write_sam_record(self, rec: SamRecord) -> int:
+        return self.write_record_bytes(rec.to_bam_bytes(self.header))
+
+    def record_voffsets(self) -> List[int]:
+        return self._voffsets
+
+    def close(self) -> None:
+        self._w.close()
+        if self._own:
+            self._sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_bam(path_or_sink, header: SAMHeader,
+              records: Iterable[Union[SamRecord, bytes]], **kw) -> None:
+    with BamWriter(path_or_sink, header, **kw) as w:
+        for r in records:
+            if isinstance(r, SamRecord):
+                w.write_sam_record(r)
+            else:
+                w.write_record_bytes(r)
+
+
+def read_bam_header(source) -> Tuple[SAMHeader, int]:
+    """Read the header; returns (header, first-record virtual offset).
+
+    Equivalent of hb/util/SAMHeaderReader.java for BAM containers (and of the
+    header step of hb/BAMRecordReader.initialize)."""
+    r = bgzf.BGZFReader(source)
+    # Headers are typically < a few MB; read blocks until parse succeeds.
+    size = 1 << 16
+    while True:
+        r.seek_voffset(0)
+        buf = r.read(size)
+        try:
+            header, after = SAMHeader.from_bam_bytes(buf, 0)
+            break
+        except (IndexError, Exception) as e:
+            if len(buf) < size:  # EOF — really malformed
+                raise
+            size *= 4
+    # Convert the plain offset-after-header into a virtual offset by walking
+    # blocks again (cheap: headers span few blocks).
+    r.seek_voffset(0)
+    remaining = after
+    coff = 0
+    while True:
+        head = r._src.pread(coff, bgzf.MAX_BLOCK_SIZE)
+        info = bgzf.parse_block_header(head, 0)
+        if remaining < info.isize or (remaining == info.isize and info.isize > 0):
+            # position is inside (or exactly at end of) this block
+            if remaining == info.isize:
+                return header, make_voffset(info.next_coffset, 0)
+            return header, make_voffset(coff, remaining)
+        remaining -= info.isize
+        coff = info.next_coffset
+
+
+def read_bam(source, header: Optional[SAMHeader] = None) -> Tuple[SAMHeader, BamBatch]:
+    """Inflate a whole BAM and return (header, SoA batch of all records)."""
+    r = bgzf.BGZFReader(source)
+    data = r.read_all_from(0)
+    hdr, after = SAMHeader.from_bam_bytes(data, 0)
+    arr = np.frombuffer(data, dtype=np.uint8)
+    offs = walk_record_offsets(data, start=after)
+    return hdr, BamBatch(arr, offs, header=hdr)
+
+
+def iter_sam_lines(source) -> Iterator[str]:
+    """Decode a BAM to SAM lines (CLI `view` path; golden-test oracle hook)."""
+    hdr, batch = read_bam(source)
+    for i in range(len(batch)):
+        yield batch.to_sam_line(i)

@@ -1,0 +1,347 @@
+"""BGZF block-compression layer.
+
+[SPEC] SAMv1 section 4.1: BGZF is a series of concatenated gzip members, each
+with an FEXTRA subfield ``SI1=66 ('B'), SI2=67 ('C'), SLEN=2`` whose payload is
+``BSIZE`` (u16) = total block size minus one.  Each member's inflated payload
+is at most 65536 bytes (0x10000); the file ends with a fixed 28-byte empty
+block (the EOF terminator).  Because members are independent DEFLATE streams,
+BGZF gives *position-invariant random access*: any block can be inflated
+without its neighbors — the property both Hadoop-BAM's split machinery and our
+TPU batch-inflate pipeline exploit (SURVEY.md section 5, long-context analog).
+
+Reference equivalents: htsjdk ``BlockCompressedInputStream`` /
+``BlockCompressedOutputStream`` (external dependency of the reference), plus
+the scan logic of hb/BGZFSplitGuesser.java (rebuilt in
+hadoop_bam_tpu/split/bgzf_guesser.py on top of this module's primitives).
+
+This module is the host (NumPy + zlib) reference implementation; the batched
+decode path lives in hadoop_bam_tpu/ops/inflate.py and the native C++
+multithreaded inflate in native/.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# [SPEC] gzip member header: ID1 ID2 CM FLG, with FLG.FEXTRA set.
+GZIP_MAGIC = b"\x1f\x8b\x08\x04"
+# [SPEC] BGZF extra subfield identifiers.
+BGZF_SI1 = 66   # 'B'
+BGZF_SI2 = 67   # 'C'
+BGZF_SLEN = 2
+# [SPEC] fixed 12-byte BGZF header prefix through XLEN for blocks we *write*:
+# magic, MTIME=0, XFL=0, OS=255(unknown), XLEN=6.
+_BLOCK_HEADER_FMT = "<4sIBBH"  # magic, mtime, xfl, os, xlen
+_XTRA_FMT = "<BBHH"            # SI1, SI2, SLEN, BSIZE
+HEADER_SIZE = 18               # fixed header size for blocks with only the BC subfield
+FOOTER_SIZE = 8                # CRC32 + ISIZE
+MAX_BLOCK_SIZE = 0x10000       # max *compressed* total block size (65536)
+MAX_UNCOMPRESSED = 0x10000     # max inflated payload per block
+# Payload budget so that worst-case deflate expansion still fits MAX_BLOCK_SIZE.
+# htsjdk uses 0xff00 for the same reason.
+WRITE_PAYLOAD_SIZE = 0xFF00
+
+# [SPEC] the 28-byte BGZF EOF terminator block (empty payload, fixed bytes).
+EOF_BLOCK = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000")
+
+
+class BGZFError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class BlockInfo:
+    """Metadata of one BGZF block located in a file/buffer."""
+    coffset: int        # compressed offset of the block start
+    block_size: int     # total compressed size (BSIZE + 1)
+    isize: int          # inflated payload length (from the block footer)
+    cdata_offset: int   # offset of the DEFLATE payload within the file/buffer
+    cdata_size: int     # DEFLATE payload length
+
+    @property
+    def next_coffset(self) -> int:
+        return self.coffset + self.block_size
+
+    @property
+    def is_eof_block(self) -> bool:
+        return self.isize == 0
+
+
+def parse_block_header(buf: bytes, offset: int = 0) -> BlockInfo:
+    """Parse one BGZF block header at ``offset`` (without inflating).
+
+    Walks all FEXTRA subfields looking for the BC subfield [SPEC]; raises
+    BGZFError if the bytes are not a BGZF block start.
+    """
+    if len(buf) - offset < HEADER_SIZE:
+        raise BGZFError("truncated BGZF header")
+    if buf[offset:offset + 4] != GZIP_MAGIC:
+        raise BGZFError("not a BGZF block: bad gzip magic/flags")
+    xlen = struct.unpack_from("<H", buf, offset + 10)[0]
+    xtra_start = offset + 12
+    xtra_end = xtra_start + xlen
+    if len(buf) < xtra_end:
+        raise BGZFError("truncated FEXTRA")
+    bsize = None
+    p = xtra_start
+    while p + 4 <= xtra_end:
+        si1, si2, slen = buf[p], buf[p + 1], struct.unpack_from("<H", buf, p + 2)[0]
+        if si1 == BGZF_SI1 and si2 == BGZF_SI2 and slen == BGZF_SLEN:
+            bsize = struct.unpack_from("<H", buf, p + 4)[0]
+            break
+        p += 4 + slen
+    if bsize is None:
+        raise BGZFError("gzip member without BGZF BC subfield")
+    block_size = bsize + 1
+    if block_size < xtra_end - offset + FOOTER_SIZE:
+        raise BGZFError("BSIZE smaller than header+footer")
+    if len(buf) - offset < block_size:
+        raise BGZFError("truncated BGZF block body")
+    isize = struct.unpack_from("<I", buf, offset + block_size - 4)[0]
+    if isize > MAX_UNCOMPRESSED:
+        raise BGZFError("ISIZE exceeds 64 KiB — not a valid BGZF block")
+    cdata_offset = xtra_end
+    cdata_size = block_size - (xtra_end - offset) - FOOTER_SIZE
+    return BlockInfo(coffset=offset, block_size=block_size, isize=isize,
+                     cdata_offset=cdata_offset, cdata_size=cdata_size)
+
+
+def inflate_block(buf: bytes, info: Optional[BlockInfo] = None,
+                  offset: int = 0, check_crc: bool = True) -> bytes:
+    """Inflate one BGZF block; verifies CRC32 and ISIZE [SPEC] by default."""
+    if info is None:
+        info = parse_block_header(buf, offset)
+    raw = bytes(buf[info.cdata_offset:info.cdata_offset + info.cdata_size])
+    data = zlib.decompress(raw, wbits=-15)
+    if len(data) != info.isize:
+        raise BGZFError(f"ISIZE mismatch: {len(data)} != {info.isize}")
+    if check_crc:
+        crc = struct.unpack_from("<I", buf, info.coffset + info.block_size - 8)[0]
+        if zlib.crc32(data) & 0xFFFFFFFF != crc:
+            raise BGZFError("BGZF block CRC32 mismatch")
+    return data
+
+
+def deflate_block(payload: bytes, level: int = 6) -> bytes:
+    """Build one complete BGZF block around ``payload`` (≤ WRITE_PAYLOAD_SIZE)."""
+    if len(payload) > MAX_UNCOMPRESSED:
+        raise BGZFError("payload exceeds 64 KiB BGZF limit")
+    co = zlib.compressobj(level, zlib.DEFLATED, -15)
+    cdata = co.compress(payload) + co.flush()
+    if HEADER_SIZE + len(cdata) + FOOTER_SIZE > MAX_BLOCK_SIZE:
+        # Incompressible data at high payload sizes: store uncompressed.
+        co = zlib.compressobj(0, zlib.DEFLATED, -15)
+        cdata = co.compress(payload) + co.flush()
+    block_size = HEADER_SIZE + len(cdata) + FOOTER_SIZE
+    if block_size > MAX_BLOCK_SIZE:
+        raise BGZFError("deflated block exceeds 64 KiB — reduce payload size")
+    header = struct.pack(_BLOCK_HEADER_FMT, GZIP_MAGIC, 0, 0, 255, 6) + \
+        struct.pack(_XTRA_FMT, BGZF_SI1, BGZF_SI2, BGZF_SLEN, block_size - 1)
+    footer = struct.pack("<II", zlib.crc32(payload) & 0xFFFFFFFF, len(payload))
+    return header + cdata + footer
+
+
+def scan_blocks(buf: bytes, offset: int = 0, limit: Optional[int] = None) -> List[BlockInfo]:
+    """Walk consecutive BGZF blocks from a known block start."""
+    out: List[BlockInfo] = []
+    end = len(buf) if limit is None else min(len(buf), limit)
+    while offset < end:
+        info = parse_block_header(buf, offset)
+        out.append(info)
+        offset = info.next_coffset
+    return out
+
+
+def find_block_starts_numpy(buf: np.ndarray, require_valid_bsize: bool = True
+                            ) -> np.ndarray:
+    """Vectorized candidate scan for BGZF block starts in a byte buffer.
+
+    Rebuild of the scan loop of hb/BGZFSplitGuesser.java, but SIMD-style: one
+    vectorized pass finds every offset whose bytes match the gzip magic
+    ``1f 8b 08 04`` and (optionally) whose XLEN/BC subfield layout is
+    consistent.  Candidates still need confirmation by inflating (the guesser
+    does that); this just prunes 99.99% of offsets in O(n) NumPy time.
+    """
+    b = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf
+    n = b.size
+    if n < HEADER_SIZE:
+        return np.empty(0, dtype=np.int64)
+    hits = (b[:-3] == 0x1F) & (b[1:-2] == 0x8B) & (b[2:-1] == 0x08) & (b[3:] == 0x04)
+    cand = np.nonzero(hits)[0]
+    cand = cand[cand + HEADER_SIZE <= n]
+    if cand.size and require_valid_bsize:
+        # XLEN at +10 (u16 LE) must be >= 6; check the standard layout where
+        # the BC subfield comes first (how htsjdk and we write it); fall back
+        # to the full subfield walk only for nonstandard writers.
+        xlen = b[cand + 10].astype(np.int32) | (b[cand + 11].astype(np.int32) << 8)
+        si_ok = (b[cand + 12] == BGZF_SI1) & (b[cand + 13] == BGZF_SI2) & \
+                (b[cand + 14] == BGZF_SLEN) & (b[cand + 15] == 0)
+        standard = (xlen == 6) & si_ok
+        nonstandard = (xlen > 6) & (xlen < 256)
+        keep = standard | nonstandard
+        cand = cand[keep]
+    return cand.astype(np.int64)
+
+
+class BGZFReader:
+    """Random-access reader over a BGZF file: seek by virtual offset, read
+    inflated bytes across block boundaries.
+
+    Host-side equivalent of htsjdk ``BlockCompressedInputStream`` as used by
+    hb/BAMRecordReader.java (seek to split-start voffset, stream records).
+    Works over any object with ``pread(offset, size) -> bytes`` and ``size``
+    (see hadoop_bam_tpu/utils/seekable.py).
+    """
+
+    def __init__(self, source, check_crc: bool = False):
+        from hadoop_bam_tpu.utils.seekable import as_byte_source
+        self._src = as_byte_source(source)
+        self._check_crc = check_crc
+        self._block_coffset = -1
+        self._block_data = b""
+        self._uoffset = 0
+        self._next_coffset = 0
+
+    @property
+    def file_size(self) -> int:
+        return self._src.size
+
+    def voffset(self) -> int:
+        """Current position as a packed virtual offset."""
+        coff = self._block_coffset if self._block_coffset >= 0 else self._next_coffset
+        if self._uoffset == len(self._block_data) and self._block_coffset >= 0:
+            # Normalized position: start of next block (matches htsjdk).
+            return (self._next_coffset << 16)
+        return (coff << 16) | self._uoffset
+
+    def seek_voffset(self, v: int) -> None:
+        coffset, uoffset = v >> 16, v & 0xFFFF
+        self._load_block(coffset)
+        if uoffset > len(self._block_data):
+            raise BGZFError("virtual offset beyond block payload")
+        self._uoffset = uoffset
+
+    def _load_block(self, coffset: int) -> bool:
+        if coffset == self._block_coffset:
+            self._uoffset = 0
+            return True
+        if coffset >= self._src.size:
+            self._block_coffset = -1
+            self._block_data = b""
+            self._uoffset = 0
+            self._next_coffset = coffset
+            return False
+        head = self._src.pread(coffset, MAX_BLOCK_SIZE)
+        info = parse_block_header(head, 0)
+        self._block_data = inflate_block(head, info, check_crc=self._check_crc)
+        self._block_coffset = coffset
+        self._next_coffset = coffset + info.block_size
+        self._uoffset = 0
+        return True
+
+    def read(self, n: int) -> bytes:
+        """Read exactly n inflated bytes (fewer only at EOF)."""
+        out = bytearray()
+        while n > 0:
+            avail = len(self._block_data) - self._uoffset
+            if avail == 0:
+                if not self._load_block(self._next_coffset):
+                    break
+                if len(self._block_data) == 0:  # EOF/empty block: keep walking
+                    continue
+                avail = len(self._block_data)
+            take = min(avail, n)
+            out += self._block_data[self._uoffset:self._uoffset + take]
+            self._uoffset += take
+            n -= take
+        return bytes(out)
+
+    def read_all_from(self, voffset: int = 0) -> bytes:
+        self.seek_voffset(voffset)
+        chunks = [self.read(1 << 20)]
+        while chunks[-1]:
+            chunks.append(self.read(1 << 20))
+        return b"".join(chunks)
+
+
+class BGZFWriter:
+    """Streaming BGZF writer (htsjdk ``BlockCompressedOutputStream`` analog).
+
+    Buffers up to WRITE_PAYLOAD_SIZE bytes per block; ``tell_voffset`` returns
+    the virtual offset of the *next* byte written — the hook the splitting-bai
+    indexer (hb/SplittingBAMIndexer.java) needs.
+    """
+
+    def __init__(self, sink, level: int = 6, write_eof: bool = True):
+        self._sink = sink  # file-like with .write
+        self._level = level
+        self._write_eof = write_eof
+        self._buf = bytearray()
+        self._coffset = 0
+        self._closed = False
+
+    def tell_voffset(self) -> int:
+        return (self._coffset << 16) | len(self._buf)
+
+    def write(self, data: bytes) -> None:
+        self._buf += data
+        while len(self._buf) >= WRITE_PAYLOAD_SIZE:
+            self._flush_block(WRITE_PAYLOAD_SIZE)
+
+    def _flush_block(self, n: int) -> None:
+        payload = bytes(self._buf[:n])
+        del self._buf[:n]
+        block = deflate_block(payload, self._level)
+        self._sink.write(block)
+        self._coffset += len(block)
+
+    def flush(self) -> None:
+        if self._buf:
+            self._flush_block(len(self._buf))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.flush()
+        if self._write_eof:
+            self._sink.write(EOF_BLOCK)
+            self._coffset += len(EOF_BLOCK)
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def compress_bytes(data: bytes, level: int = 6, write_eof: bool = True) -> bytes:
+    """One-shot: BGZF-compress ``data`` into a sequence of blocks."""
+    import io
+    sink = io.BytesIO()
+    w = BGZFWriter(sink, level=level, write_eof=write_eof)
+    w.write(data)
+    w.close()
+    return sink.getvalue()
+
+
+def decompress_bytes(data: bytes, check_crc: bool = True) -> bytes:
+    """One-shot: inflate a whole BGZF byte string."""
+    out = []
+    for info in scan_blocks(data):
+        out.append(inflate_block(data, info, check_crc=check_crc))
+    return b"".join(out)
+
+
+def is_bgzf(head: bytes) -> bool:
+    """Magic sniff used by format dispatch (hb/SAMFormat.java semantics)."""
+    try:
+        parse_block_header(head[:MAX_BLOCK_SIZE], 0)
+        return True
+    except BGZFError:
+        return False

@@ -1,0 +1,56 @@
+"""BGZF virtual file offsets.
+
+[SPEC] SAMv1 section 4.1.1: a virtual offset packs the compressed-file offset
+of a BGZF block start (48 bits) and the offset within the inflated block
+(16 bits) into one 64-bit value::
+
+    voffset = (compressed_block_start << 16) | offset_within_inflated_block
+
+This convention is load-bearing across the whole reference library
+(SURVEY.md section 2.2): hb/FileVirtualSplit.java carries start/end virtual
+offsets, hb/BAMRecordReader.java keys every record by its virtual pointer, and
+hb/SplittingBAMIndex.java stores sampled record voffsets.  We preserve it
+exactly so .splitting-bai / .bai / .sbi sidecars interoperate.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+SHIFT = 16
+UOFFSET_MASK = 0xFFFF
+
+
+class VirtualOffset(NamedTuple):
+    coffset: int  # compressed offset of the BGZF block start in the file
+    uoffset: int  # offset within the inflated block contents
+
+    @property
+    def packed(self) -> int:
+        return make_voffset(self.coffset, self.uoffset)
+
+    @classmethod
+    def from_packed(cls, v: int) -> "VirtualOffset":
+        return cls(*split_voffset(v))
+
+    def __int__(self) -> int:
+        return self.packed
+
+
+def make_voffset(coffset, uoffset):
+    """Pack (block start, in-block offset) into a 64-bit virtual offset.
+    Works on Python ints and NumPy arrays alike."""
+    if isinstance(coffset, np.ndarray) or isinstance(uoffset, np.ndarray):
+        return (np.asarray(coffset, dtype=np.uint64) << np.uint64(SHIFT)) | (
+            np.asarray(uoffset, dtype=np.uint64) & np.uint64(UOFFSET_MASK))
+    return (int(coffset) << SHIFT) | (int(uoffset) & UOFFSET_MASK)
+
+
+def split_voffset(v):
+    """Unpack a 64-bit virtual offset into (coffset, uoffset)."""
+    if isinstance(v, np.ndarray):
+        v = np.asarray(v, dtype=np.uint64)
+        return v >> np.uint64(SHIFT), (v & np.uint64(UOFFSET_MASK)).astype(np.int64)
+    v = int(v)
+    return v >> SHIFT, v & UOFFSET_MASK

@@ -1,0 +1,1 @@
+"""Utility layer: seekable byte sources, header readers, mergers, metrics."""

@@ -1,0 +1,77 @@
+"""Seekable byte sources — positioned reads over files and buffers.
+
+Rebuild of the reference's seekable-stream adapters
+(hb/util/WrapSeekable.java: htsjdk SeekableStream over Hadoop
+FSDataInputStream; hb/util/SeekableArrayStream.java: over byte[]): every layer
+above works against one tiny interface, ``pread(offset, size) -> bytes`` plus
+``size``, so local files, in-memory buffers, and (later) object-store
+byte-range fetchers are interchangeable.  Positioned reads (not stateful
+seeks) are the right primitive for the TPU pipeline: span fetches are
+stateless and trivially parallel across threads/hosts.
+"""
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import Union
+
+
+class ByteSource:
+    """Interface: stateless positioned reads."""
+
+    size: int
+
+    def pread(self, offset: int, size: int) -> bytes:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FileByteSource(ByteSource):
+    """Positioned reads over a local file via os.pread (thread-safe, no
+    seek state — many fetcher threads can share one fd)."""
+
+    def __init__(self, path: Union[str, os.PathLike]):
+        self.path = os.fspath(path)
+        self._fd = os.open(self.path, os.O_RDONLY)
+        self.size = os.fstat(self._fd).st_size
+
+    def pread(self, offset: int, size: int) -> bytes:
+        if offset >= self.size or size <= 0:
+            return b""
+        return os.pread(self._fd, size, offset)
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+
+class BytesByteSource(ByteSource):
+    """Over an in-memory buffer (hb/util/SeekableArrayStream.java analog);
+    guessers re-scan fetched windows through this."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self.size = len(data)
+
+    def pread(self, offset: int, size: int) -> bytes:
+        return self._data[offset:offset + size]
+
+
+def as_byte_source(obj) -> ByteSource:
+    if isinstance(obj, ByteSource):
+        return obj
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return BytesByteSource(bytes(obj))
+    if isinstance(obj, (str, os.PathLike)):
+        return FileByteSource(obj)
+    raise TypeError(f"cannot make a ByteSource from {type(obj)!r}")

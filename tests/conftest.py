@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU platform for mesh tests.
+
+The reference's test strategy (SURVEY.md section 4) never spins up a cluster: it
+exercises the InputFormat/RecordReader *interfaces* in-process.  We adopt the
+same philosophy — all distributed logic is tested on a virtual 8-device CPU
+mesh, and correctness of split planning is tested with every-byte-offset
+property tests.
+"""
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    return jax.devices("cpu")

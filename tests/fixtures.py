@@ -1,0 +1,56 @@
+"""Deterministic synthetic fixtures.
+
+The reference checks in tiny .sam/.bam/.vcf files under src/test/resources/
+(SURVEY.md section 4).  With no reference mount and no pysam in the image, our
+fixtures are *generated from the spec layer itself* and cross-checked against
+independent implementations where possible (Python gzip for BGZF, hand-built
+byte layouts for BAM records).
+"""
+from __future__ import annotations
+
+import random
+import string
+from typing import List
+
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.sam import SamRecord
+
+
+def make_header(n_ref: int = 3) -> SAMHeader:
+    names = [f"chr{i + 1}" for i in range(n_ref)]
+    lengths = [1_000_000 * (i + 1) for i in range(n_ref)]
+    text = "@HD\tVN:1.6\tSO:coordinate\n" + "".join(
+        f"@SQ\tSN:{n}\tLN:{l}\n" for n, l in zip(names, lengths))
+    return SAMHeader(text=text, ref_names=names, ref_lengths=lengths)
+
+
+def make_records(header: SAMHeader, n: int, seed: int = 0,
+                 with_tags: bool = True) -> List[SamRecord]:
+    rng = random.Random(seed)
+    recs = []
+    for i in range(n):
+        l_seq = rng.randint(20, 150)
+        seq = "".join(rng.choice("ACGT") for _ in range(l_seq))
+        qual = "".join(chr(33 + rng.randint(0, 41)) for _ in range(l_seq))
+        rid = rng.randrange(header.n_ref)
+        pos = rng.randint(1, header.ref_lengths[rid] - l_seq)
+        flag = rng.choice([0, 16, 99, 147, 83, 163, 4])
+        tags = []
+        if with_tags:
+            tags = [("NM", "i", rng.randint(0, 5)),
+                    ("RG", "Z", f"rg{rng.randint(0, 3)}")]
+            if rng.random() < 0.3:
+                tags.append(("AS", "i", rng.randint(0, 300)))
+        cigar = f"{l_seq}M" if flag != 4 else "*"
+        recs.append(SamRecord(
+            qname=f"read{i:06d}_{''.join(rng.choice(string.ascii_lowercase) for _ in range(4))}",
+            flag=flag,
+            rname=header.ref_names[rid] if flag != 4 else "*",
+            pos=pos if flag != 4 else 0,
+            mapq=rng.randint(0, 60) if flag != 4 else 0,
+            cigar=cigar,
+            rnext="=" if flag & 0x1 else "*",
+            pnext=pos + rng.randint(-200, 200) if flag & 0x1 else 0,
+            tlen=rng.randint(-500, 500) if flag & 0x1 else 0,
+            seq=seq, qual=qual, tags=tags))
+    return recs

@@ -1,0 +1,105 @@
+"""BGZF layer tests — cross-checked against Python's independent gzip module
+(BGZF blocks are legal gzip members [SPEC], so gzip.decompress is an oracle
+the framework's own code never touches)."""
+import gzip
+import io
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.formats import bgzf
+
+
+def test_eof_block_is_valid_empty_block():
+    info = bgzf.parse_block_header(bgzf.EOF_BLOCK)
+    assert info.block_size == 28
+    assert info.isize == 0
+    assert bgzf.inflate_block(bgzf.EOF_BLOCK, info) == b""
+
+
+def test_roundtrip_small():
+    payload = b"hello bgzf world" * 10
+    block = bgzf.deflate_block(payload)
+    info = bgzf.parse_block_header(block)
+    assert info.block_size == len(block)
+    assert bgzf.inflate_block(block, info) == payload
+    # independent oracle: gzip can decompress a BGZF member
+    assert gzip.decompress(block) == payload
+
+
+def test_roundtrip_large_multiblock():
+    rng = np.random.default_rng(0)
+    # mix of compressible and incompressible data, > several blocks
+    data = (b"ACGT" * 40000) + rng.integers(0, 256, 200000, dtype=np.uint8).tobytes()
+    comp = bgzf.compress_bytes(data)
+    assert bgzf.decompress_bytes(comp) == data
+    # gzip oracle: concatenated members decompress to the whole payload
+    assert gzip.decompress(comp) == data
+    blocks = bgzf.scan_blocks(comp)
+    assert blocks[-1].is_eof_block
+    assert all(b.block_size <= bgzf.MAX_BLOCK_SIZE for b in blocks)
+
+
+def test_incompressible_payload_still_fits():
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, bgzf.WRITE_PAYLOAD_SIZE, dtype=np.uint8).tobytes()
+    block = bgzf.deflate_block(payload, level=9)
+    assert len(block) <= bgzf.MAX_BLOCK_SIZE
+    assert bgzf.inflate_block(block) == payload
+
+
+def test_crc_validation():
+    payload = b"payload under test"
+    block = bytearray(bgzf.deflate_block(payload))
+    block[-5] ^= 0xFF  # corrupt CRC byte
+    with pytest.raises(bgzf.BGZFError):
+        bgzf.inflate_block(bytes(block), check_crc=True)
+
+
+def test_find_block_starts_numpy():
+    data = b"x" * 100000
+    comp = bgzf.compress_bytes(data)
+    truth = [b.coffset for b in bgzf.scan_blocks(comp)]
+    cand = bgzf.find_block_starts_numpy(np.frombuffer(comp, dtype=np.uint8))
+    # every true block start must be among candidates
+    assert set(truth) <= set(cand.tolist())
+
+
+def test_reader_seek_and_read(tmp_path):
+    data = bytes(range(256)) * 1000
+    path = tmp_path / "t.bgzf"
+    path.write_bytes(bgzf.compress_bytes(data))
+    r = bgzf.BGZFReader(str(path), check_crc=True)
+    assert r.read_all_from(0) == data
+    # voffset round-trip mid-stream
+    r.seek_voffset(0)
+    r.read(1000)
+    v = r.voffset()
+    rest = r.read(len(data))
+    r.seek_voffset(v)
+    assert r.read(len(data)) == rest
+
+
+def test_writer_voffsets_monotonic():
+    sink = io.BytesIO()
+    w = bgzf.BGZFWriter(sink)
+    vs = []
+    for i in range(5000):
+        vs.append(w.tell_voffset())
+        w.write(b"record%06d" % i)
+    w.close()
+    assert vs == sorted(vs)
+    assert len(set(vs)) == len(vs)
+    # each recorded voffset points at its record
+    r = bgzf.BGZFReader(sink.getvalue())
+    for i in [0, 1, 4999, 2500]:
+        r.seek_voffset(vs[i])
+        assert r.read(12) == b"record%06d" % i
+
+
+def test_is_bgzf():
+    assert bgzf.is_bgzf(bgzf.compress_bytes(b"abc"))
+    assert not bgzf.is_bgzf(gzip.compress(b"abc"))  # plain gzip: no BC subfield
+    assert not bgzf.is_bgzf(b"plain text here....")
