@@ -111,10 +111,10 @@ def read_bam_header(source) -> Tuple[SAMHeader, int]:
         if remaining < info.isize or (remaining == info.isize and info.isize > 0):
             # position is inside (or exactly at end of) this block
             if remaining == info.isize:
-                return header, make_voffset(info.next_coffset, 0)
+                return header, make_voffset(coff + info.block_size, 0)
             return header, make_voffset(coff, remaining)
         remaining -= info.isize
-        coff = info.next_coffset
+        coff += info.block_size  # info offsets are window-relative
 
 
 def read_bam(source, header: Optional[SAMHeader] = None) -> Tuple[SAMHeader, BamBatch]:

@@ -1,0 +1,15 @@
+"""Split planning: record-aligned spans from arbitrary byte ranges.
+
+Rebuild of the reference's crown jewels (SURVEY.md section 2.2):
+hb/BGZFSplitGuesser.java, hb/BAMSplitGuesser.java, hb/BCFSplitGuesser.java,
+hb/SplittingBAMIndex(er).java, hb/FileVirtualSplit.java and the
+``getSplits()`` logic of the InputFormats.  All host-side (NumPy), stateless,
+and idempotent per span — the property that makes the whole framework
+embarrassingly data-parallel.
+"""
+from hadoop_bam_tpu.split.spans import FileVirtualSpan  # noqa: F401
+from hadoop_bam_tpu.split.bgzf_guesser import BGZFSplitGuesser  # noqa: F401
+from hadoop_bam_tpu.split.bam_guesser import BAMSplitGuesser  # noqa: F401
+from hadoop_bam_tpu.split.splitting_index import (  # noqa: F401
+    SplittingIndex, build_splitting_index,
+)

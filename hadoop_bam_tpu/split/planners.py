@@ -1,0 +1,254 @@
+"""Span planners: the ``getSplits()`` layer.
+
+Rebuild of the reference's InputFormat split planning (SURVEY.md section 3.1):
+byte ranges at a target split size are converted to record-aligned spans —
+via a sidecar splitting index when present (hb/SplittingBAMIndex.java path) or
+the split guessers otherwise (hb/BAMSplitGuesser.java path) — then empty spans
+are dropped.  Planning runs once on one host and the resulting span list is
+broadcast (hadoop_bam_tpu/parallel/distributed.py), mirroring client-side
+``Job.getSplits()`` at submission time.
+
+Also provides the span *readers* (RecordReader equivalents): given a span,
+produce the records whose start lies inside it — the reference's contract that
+makes the union of all splits yield each record exactly once
+(hb/BAMRecordReader.java: decode until the record's virtual pointer passes the
+split's end voffset; text readers: skip the partial first line unless at file
+start, read past the end to finish the last line).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bam import BamBatch, SAMHeader, walk_record_offsets
+from hadoop_bam_tpu.formats.bamio import read_bam_header
+from hadoop_bam_tpu.formats.virtual_offset import make_voffset
+from hadoop_bam_tpu.split.bam_guesser import BAMSplitGuesser
+from hadoop_bam_tpu.split.spans import FileByteSpan, FileVirtualSpan
+from hadoop_bam_tpu.split.splitting_index import SplittingIndex
+from hadoop_bam_tpu.utils.seekable import as_byte_source
+
+
+def plan_byte_ranges(size: int, *, num_spans: Optional[int] = None,
+                     span_bytes: Optional[int] = None) -> List[Tuple[int, int]]:
+    """Uniform byte ranges — the FileInputFormat.getSplits starting point."""
+    if size <= 0:
+        return []
+    if num_spans is not None:
+        num_spans = max(1, min(num_spans, size))
+        bounds = np.linspace(0, size, num_spans + 1, dtype=np.int64)
+    else:
+        sb = span_bytes or DEFAULT_CONFIG.split_size
+        bounds = np.arange(0, size + sb, sb, dtype=np.int64)
+        bounds[-1] = size
+        bounds = np.unique(bounds)
+    return [(int(bounds[i]), int(bounds[i + 1])) for i in range(len(bounds) - 1)]
+
+
+# ---------------------------------------------------------------------------
+# BAM
+# ---------------------------------------------------------------------------
+
+def plan_bam_spans(path: str, *, num_spans: Optional[int] = None,
+                   config: HBamConfig = DEFAULT_CONFIG,
+                   header: Optional[SAMHeader] = None,
+                   index: Optional[SplittingIndex] = None,
+                   ) -> List[FileVirtualSpan]:
+    """hb/BAMInputFormat.getSplits: byte ranges -> record-aligned virtual
+    spans, snapped by the splitting index when available, guessed otherwise."""
+    src = as_byte_source(path)
+    try:
+        size = src.size
+        if header is None:
+            header, first_voffset = read_bam_header(src)
+        else:
+            _, first_voffset = read_bam_header(src)
+        if index is None and config.use_splitting_index:
+            index = SplittingIndex.load_for(path)
+        ranges = plan_byte_ranges(size, num_spans=num_spans,
+                                  span_bytes=None if num_spans else config.split_size)
+        boundaries: List[int] = []
+        guesser = None if index is not None else BAMSplitGuesser(src, header)
+        for (bstart, _bend) in ranges:
+            if bstart == 0:
+                boundaries.append(first_voffset)
+                continue
+            if index is not None:
+                boundaries.append(index.first_record_at_or_after(bstart))
+            else:
+                v = guesser.guess_next_record_start(bstart)
+                boundaries.append(size << 16 if v is None else
+                                  max(v, first_voffset))
+        end_sentinel = size << 16
+        boundaries.append(end_sentinel)
+        spans: List[FileVirtualSpan] = []
+        for i in range(len(boundaries) - 1):
+            s, e = boundaries[i], boundaries[i + 1]
+            if s < e:  # drop empty spans (duplicate boundaries merge here)
+                spans.append(FileVirtualSpan(path, s, e))
+        return spans
+    finally:
+        src.close()
+
+
+def read_bam_span(source, span: FileVirtualSpan,
+                  header: Optional[SAMHeader] = None,
+                  check_crc: bool = False) -> BamBatch:
+    """hb/BAMRecordReader semantics: every record whose start virtual offset
+    is in [span.start, span.end) — even if its body extends past the end.
+
+    Batched implementation: inflate the span's block range in one pass, walk
+    record boundaries in memory, and extend with following blocks only if the
+    final record is cut (instead of the reference's per-record stream loop).
+    """
+    src = as_byte_source(source)
+    if header is None:
+        header, _ = read_bam_header(src)
+    start_c, start_u = span.start
+    end_c, end_u = span.end
+
+    r = bgzf.BGZFReader(src, check_crc=check_crc)
+    r.seek_voffset(span.start_voffset)
+
+    chunks: List[bytes] = []
+    # inflated offset (within our chunk buffer) of each block start, and the
+    # coffset of each block, so record offsets map back to virtual offsets
+    block_bases: List[Tuple[int, int]] = []  # (inflated_base, coffset)
+    total = 0
+    # First (possibly partial) block chunk:
+    coffset = start_c
+    while coffset < src.size:
+        head = src.pread(coffset, bgzf.MAX_BLOCK_SIZE)
+        info = bgzf.parse_block_header(head, 0)
+        if coffset > end_c or (coffset == end_c and end_u == 0):
+            break
+        data = bgzf.inflate_block(head, info, check_crc=check_crc)
+        if coffset == start_c and start_u:
+            data = data[start_u:]
+            block_bases.append((total - start_u, coffset))
+        else:
+            block_bases.append((total, coffset))
+        chunks.append(data)
+        total += len(data)
+        coffset += info.block_size  # info offsets are window-relative
+
+    buf = b"".join(chunks)
+    data_arr = np.frombuffer(buf, dtype=np.uint8)
+
+    # end limit within the inflated buffer: records starting at voffset >= end
+    # are excluded.  Find the inflated offset corresponding to (end_c, end_u).
+    if end_c >= coffset and coffset >= src.size:
+        end_inflated = len(buf)
+    else:
+        end_inflated = len(buf)
+        for base, c in block_bases:
+            if c == end_c:
+                end_inflated = base + end_u + (start_u if c == start_c else 0)
+                break
+
+    offs = walk_record_offsets(buf, 0, None)
+    offs = offs[offs < max(end_inflated, 1)] if len(offs) else offs
+
+    # If the last in-range record is truncated in ``buf``, pull more blocks.
+    if offs.size:
+        last = int(offs[-1])
+        bs = int.from_bytes(buf[last:last + 4], "little", signed=True)
+        need = last + 4 + bs
+        while need > len(buf) and coffset < src.size:
+            head = src.pread(coffset, bgzf.MAX_BLOCK_SIZE)
+            info = bgzf.parse_block_header(head, 0)
+            chunks.append(bgzf.inflate_block(head, info, check_crc=check_crc))
+            block_bases.append((len(buf), coffset))
+            buf = b"".join(chunks)
+            coffset += info.block_size
+        data_arr = np.frombuffer(buf, dtype=np.uint8)
+        offs = walk_record_offsets(buf, 0, None)
+        offs = offs[offs < end_inflated]
+    # also: records may have been cut at end_inflated boundary mid-walk —
+    # ensure completeness: re-walk already covers it since buf grew.
+
+    voffs = _inflated_to_voffsets(offs, block_bases, start_c, start_u)
+    return BamBatch(data_arr, offs, header=header, voffsets=voffs)
+
+
+def _inflated_to_voffsets(offs: np.ndarray, block_bases: List[Tuple[int, int]],
+                          start_c: int, start_u: int) -> np.ndarray:
+    """Map inflated-buffer offsets back to packed virtual offsets."""
+    if offs.size == 0:
+        return np.empty(0, dtype=np.uint64)
+    bases = np.asarray([b for b, _ in block_bases], dtype=np.int64)
+    coffs = np.asarray([c for _, c in block_bases], dtype=np.int64)
+    idx = np.searchsorted(bases, offs, side="right") - 1
+    idx = np.clip(idx, 0, len(bases) - 1)
+    uoff = offs - bases[idx]
+    return make_voffset(coffs[idx], uoff)
+
+
+# ---------------------------------------------------------------------------
+# Text formats (SAM, VCF, QSEQ, ...): newline-aligned spans
+# ---------------------------------------------------------------------------
+
+def plan_text_spans(path: str, *, num_spans: Optional[int] = None,
+                    span_bytes: Optional[int] = None) -> List[FileByteSpan]:
+    """Plain byte splits; alignment happens at read time via LineRecordReader
+    semantics (skip partial first line unless at 0, finish last line past
+    end) — exactly how hb/SAMInputFormat and text VCF splits behave."""
+    src = as_byte_source(path)
+    try:
+        ranges = plan_byte_ranges(src.size, num_spans=num_spans,
+                                  span_bytes=span_bytes)
+        return [FileByteSpan(path, s, e) for s, e in ranges]
+    finally:
+        src.close()
+
+
+def read_text_span(source, span: FileByteSpan, *, skip_prefix_lines_at_zero=0,
+                   chunk: int = 1 << 20) -> bytes:
+    """Return the bytes of all lines *starting* in [span.start, span.end).
+
+    LineRecordReader contract: if start > 0, the (possibly partial) line in
+    progress at ``start`` belongs to the previous span — skip to the first
+    newline; read past ``end`` to complete the final line."""
+    src = as_byte_source(source)
+    start, end = span.start, span.end
+    if start > 0:
+        # Find the first newline at/after start-1: a line starting exactly at
+        # ``start`` is ours only if byte start-1 is a newline, which this
+        # probe handles uniformly.
+        probe_off = start - 1
+        probe = b""
+        while True:
+            got = src.pread(probe_off + len(probe), chunk)
+            if not got:
+                return b""
+            probe += got
+            nl = probe.find(b"\n")
+            if nl >= 0:
+                start = probe_off + nl + 1
+                break
+    if start >= end:
+        return b""  # no line *starts* inside this span
+    out = bytearray()
+    pos = start
+    while pos < end:
+        got = src.pread(pos, min(chunk, end - pos))
+        if not got:
+            break
+        out += got
+        pos += len(got)
+    # finish the final line
+    while not out.endswith(b"\n") and pos < src.size:
+        got = src.pread(pos, chunk)
+        if not got:
+            break
+        nl = got.find(b"\n")
+        if nl >= 0:
+            out += got[:nl + 1]
+            break
+        out += got
+        pos += len(got)
+    return bytes(out)

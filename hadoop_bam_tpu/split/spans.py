@@ -1,0 +1,67 @@
+"""FileVirtualSpan — the unit of distributable work.
+
+Rebuild of hb/FileVirtualSplit.java: a Hadoop ``InputSplit`` subclass carrying
+(path, start virtual offset, end virtual offset, hosts).  Ours is a plain
+dataclass with a compact dict/JSON form so the multi-host planner can compute
+spans once (host 0) and broadcast them (SURVEY.md section 2.9); "locations"
+generalize HDFS block hosts to an optional host/device placement hint.
+
+A span is *self-describing*: any host can decode any span independently, which
+is also the failure-recovery mechanism (SURVEY.md section 5) — retry is simply
+re-decoding the span, exactly as MapReduce re-runs a map task.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from hadoop_bam_tpu.formats.virtual_offset import split_voffset
+
+
+@dataclass(frozen=True)
+class FileVirtualSpan:
+    path: str
+    start_voffset: int  # packed (coffset << 16 | uoffset), inclusive
+    end_voffset: int    # exclusive
+    locations: Tuple[str, ...] = ()
+
+    @property
+    def start(self) -> Tuple[int, int]:
+        return tuple(int(x) for x in split_voffset(self.start_voffset))
+
+    @property
+    def end(self) -> Tuple[int, int]:
+        return tuple(int(x) for x in split_voffset(self.end_voffset))
+
+    @property
+    def compressed_size(self) -> int:
+        """Approximate compressed byte extent (for load balancing)."""
+        return max(0, self.end[0] - self.start[0])
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "start": int(self.start_voffset),
+                "end": int(self.end_voffset), "locations": list(self.locations)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileVirtualSpan":
+        return cls(d["path"], int(d["start"]), int(d["end"]),
+                   tuple(d.get("locations", ())))
+
+
+@dataclass(frozen=True)
+class FileByteSpan:
+    """A plain byte-range split (text formats: SAM, VCF, FASTQ, QSEQ, FASTA) —
+    the analog of Hadoop ``FileSplit`` before virtual-offset conversion."""
+    path: str
+    start: int
+    end: int
+    locations: Tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "start": self.start, "end": self.end,
+                "locations": list(self.locations)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileByteSpan":
+        return cls(d["path"], int(d["start"]), int(d["end"]),
+                   tuple(d.get("locations", ())))

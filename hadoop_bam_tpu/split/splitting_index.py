@@ -1,0 +1,144 @@
+"""Splitting indexes: precomputed record-boundary samples for exact splits.
+
+Rebuild of hb/SplittingBAMIndex.java (read) + hb/SplittingBAMIndexer.java
+(write).  A splitting index samples the virtual offset of every Nth
+(granularity) record plus an end sentinel, so planners can snap an arbitrary
+byte range to exact record-aligned virtual offsets with a binary search —
+eliminating split guessing entirely.
+
+Two on-disk flavors are supported:
+
+- ``.splitting-bai`` (legacy Hadoop-BAM sidecar): a sequence of big-endian
+  u64 virtual offsets, last entry = file_size << 16.  [MED — SURVEY.md section
+  2.2 flags the exact layout as unverifiable with the reference mount empty;
+  this reconstruction is self-consistent read+write.]
+- ``.sbi`` (the modern htsjdk/GATK format that superseded it): little-endian;
+  magic "SBI\\x01", file_length u64, md5[16], uuid[16], total_records u64,
+  granularity u64, n_offsets u64, then the offsets.  [MED likewise.]
+
+Both flavors are read transparently; ``build_splitting_index`` can emit either.
+"""
+from __future__ import annotations
+
+import bisect
+import struct
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bam import SAMHeader, walk_record_offsets
+from hadoop_bam_tpu.formats.virtual_offset import make_voffset
+from hadoop_bam_tpu.utils.seekable import as_byte_source
+
+SBI_MAGIC = b"SBI\x01"
+SPLITTING_BAI_SUFFIX = ".splitting-bai"
+SBI_SUFFIX = ".sbi"
+
+
+@dataclass
+class SplittingIndex:
+    """In-memory model: sorted virtual offsets of sampled records + end
+    sentinel (file_size << 16)."""
+
+    voffsets: List[int]           # sorted, includes end sentinel as last entry
+    granularity: int = 0          # 0 = unknown (legacy files don't store it)
+    total_records: int = 0        # 0 = unknown
+
+    @property
+    def end_voffset(self) -> int:
+        return self.voffsets[-1]
+
+    def first_record_at_or_after(self, file_offset: int) -> int:
+        """Smallest indexed voffset whose compressed offset >= file_offset
+        (hb/SplittingBAMIndex.nextAlignment semantics); returns the end
+        sentinel when the range contains no sampled record."""
+        key = file_offset << 16
+        i = bisect.bisect_left(self.voffsets, key)
+        return self.voffsets[min(i, len(self.voffsets) - 1)]
+
+    def span_bounds(self, byte_start: int, byte_end: int) -> Tuple[int, int]:
+        """Snap a plain byte range to (start_voffset, end_voffset)."""
+        return (self.first_record_at_or_after(byte_start),
+                self.first_record_at_or_after(byte_end))
+
+    # ------------------------------------------------------------------ I/O
+    def to_splitting_bai_bytes(self) -> bytes:
+        return b"".join(struct.pack(">Q", v) for v in self.voffsets)
+
+    def to_sbi_bytes(self, file_length: int) -> bytes:
+        head = SBI_MAGIC + struct.pack("<Q", file_length) + b"\x00" * 32
+        head += struct.pack("<QQQ", self.total_records, self.granularity,
+                            len(self.voffsets))
+        return head + np.asarray(self.voffsets, dtype="<u8").tobytes()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "SplittingIndex":
+        if raw[:4] == SBI_MAGIC:
+            (file_length,) = struct.unpack_from("<Q", raw, 4)
+            total, gran, n = struct.unpack_from("<QQQ", raw, 44)
+            offs = np.frombuffer(raw, dtype="<u8", count=n, offset=68)
+            return cls(voffsets=[int(v) for v in offs], granularity=int(gran),
+                       total_records=int(total))
+        if len(raw) % 8:
+            raise ValueError("malformed splitting index")
+        offs = np.frombuffer(raw, dtype=">u8")
+        return cls(voffsets=[int(v) for v in offs])
+
+    @classmethod
+    def load_for(cls, bam_path: str) -> Optional["SplittingIndex"]:
+        """Find and read a sidecar index next to ``bam_path`` (legacy first,
+        then .sbi), as hb/BAMInputFormat.getSplits does."""
+        import os
+        for suffix in (SPLITTING_BAI_SUFFIX, SBI_SUFFIX):
+            p = bam_path + suffix
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    return cls.from_bytes(f.read())
+        return None
+
+
+def build_splitting_index(bam_source, granularity: int = 4096,
+                          ) -> SplittingIndex:
+    """Stream a BAM once and sample every Nth record's virtual offset —
+    hb/SplittingBAMIndexer.java's standalone mode (SURVEY.md section 3.5):
+    per record, read block_size, skip the body, count; emit every Nth record's
+    virtual offset plus the end sentinel."""
+    src = as_byte_source(bam_source)
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    _, first_voffset = read_bam_header(src)
+    r = bgzf.BGZFReader(src)
+    r.seek_voffset(first_voffset)
+    voffsets: List[int] = []
+    count = 0
+    while True:
+        v = r.voffset()
+        head = r.read(4)
+        if len(head) < 4:
+            break
+        bs = int.from_bytes(head, "little", signed=True)
+        body = r.read(bs)
+        if len(body) < bs:
+            raise ValueError("truncated BAM record while indexing")
+        if count % granularity == 0:
+            voffsets.append(v)
+        count += 1
+    return SplittingIndex(voffsets=voffsets + [src.size << 16],
+                          granularity=granularity, total_records=count)
+
+
+def write_splitting_index(bam_path: str, granularity: int = 4096,
+                          flavor: str = "splitting-bai") -> str:
+    """Build and write a sidecar index; returns the sidecar path."""
+    idx = build_splitting_index(bam_path, granularity)
+    src = as_byte_source(bam_path)
+    if flavor == "sbi":
+        out = bam_path + SBI_SUFFIX
+        data = idx.to_sbi_bytes(src.size)
+    else:
+        out = bam_path + SPLITTING_BAI_SUFFIX
+        data = idx.to_splitting_bai_bytes()
+    with open(out, "wb") as f:
+        f.write(data)
+    return out

@@ -1,0 +1,140 @@
+"""Split machinery tests — the reference's core test idea (SURVEY.md section
+4): place split boundaries at adversarial offsets and assert the union of all
+spans yields each record exactly once."""
+import io
+import random
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bamio import BamWriter, read_bam
+from hadoop_bam_tpu.split.bam_guesser import BAMSplitGuesser
+from hadoop_bam_tpu.split.bgzf_guesser import BGZFSplitGuesser
+from hadoop_bam_tpu.split.planners import (
+    plan_bam_spans, plan_text_spans, read_bam_span, read_text_span,
+)
+from hadoop_bam_tpu.split.spans import FileByteSpan
+from hadoop_bam_tpu.split.splitting_index import (
+    SplittingIndex, build_splitting_index, write_splitting_index,
+)
+
+from fixtures import make_header, make_records
+
+
+@pytest.fixture(scope="module")
+def bam_file(tmp_path_factory):
+    """A multi-block BAM with known per-record virtual offsets."""
+    path = str(tmp_path_factory.mktemp("bam") / "fixture.bam")
+    header = make_header()
+    records = make_records(header, 3000, seed=42)
+    with BamWriter(path, header, track_voffsets=True) as w:
+        for r in records:
+            w.write_sam_record(r)
+        voffs = list(w.record_voffsets())
+    return path, header, records, voffs
+
+
+def test_bgzf_guesser_every_offset(bam_file):
+    path, *_ = bam_file
+    data = open(path, "rb").read()
+    truth = [b.coffset for b in bgzf.scan_blocks(data)]
+    g = BGZFSplitGuesser(data)
+    # every byte offset in the first 2 blocks + around every block boundary
+    offsets = set(range(0, truth[1] if len(truth) > 1 else len(data)))
+    for t in truth:
+        offsets.update(range(max(0, t - 3), min(len(data), t + 4)))
+    for off in sorted(offsets):
+        expect = next((t for t in truth if t >= off), None)
+        got = g.guess_next_block_start(off)
+        assert got == expect, f"offset {off}: got {got}, want {expect}"
+
+
+def test_bam_guesser_samples(bam_file):
+    path, header, records, voffs = bam_file
+    data = open(path, "rb").read()
+    block_starts = [b.coffset for b in bgzf.scan_blocks(data)]
+    g = BAMSplitGuesser(data, header)
+
+    def expected_for(offset):
+        # first record whose containing block starts at-or-after offset
+        bs = next((t for t in block_starts if t >= offset), None)
+        if bs is None:
+            return None
+        return next((v for v in voffs if (v >> 16) >= bs), None)
+
+    offsets = set(range(0, 400))                        # dense at file head
+    offsets.update(range(0, len(data), 997))            # stride sample
+    for t in block_starts:                              # block boundaries
+        offsets.update((max(0, t - 2), t, t + 1, t + 2))
+    for off in sorted(o for o in offsets if o < len(data)):
+        got = g.guess_next_record_start(off)
+        assert got == expected_for(off), f"offset {off}"
+
+
+def test_splitting_index_build_and_roundtrip(bam_file, tmp_path):
+    path, header, records, voffs = bam_file
+    gran = 100
+    idx = build_splitting_index(path, granularity=gran)
+    assert idx.total_records == len(records)
+    assert idx.voffsets[:-1] == voffs[::gran]
+    assert idx.end_voffset == len(open(path, "rb").read()) << 16
+
+    legacy = SplittingIndex.from_bytes(idx.to_splitting_bai_bytes())
+    assert legacy.voffsets == idx.voffsets
+    sbi = SplittingIndex.from_bytes(idx.to_sbi_bytes(12345))
+    assert sbi.voffsets == idx.voffsets
+    assert sbi.granularity == gran
+    assert sbi.total_records == len(records)
+
+
+@pytest.mark.parametrize("num_spans", [1, 2, 7, 16, 64])
+@pytest.mark.parametrize("use_index", [False, True])
+def test_span_union_exactly_once(bam_file, tmp_path, num_spans, use_index):
+    """THE split-robustness property: union over spans == every record once."""
+    path, header, records, voffs = bam_file
+    index = build_splitting_index(path, granularity=16) if use_index else None
+    spans = plan_bam_spans(path, num_spans=num_spans, index=index,
+                           header=header)
+    got_voffs = []
+    got_names = []
+    for span in spans:
+        batch = read_bam_span(path, span, header=header)
+        got_voffs.extend(int(v) for v in batch.voffsets)
+        got_names.extend(batch.read_name(i) for i in range(len(batch)))
+    assert got_voffs == voffs
+    assert got_names == [r.qname for r in records]
+
+
+def test_plan_respects_sidecar(bam_file, tmp_path):
+    path, header, records, voffs = bam_file
+    sidecar = write_splitting_index(path, granularity=50)
+    loaded = SplittingIndex.load_for(path)
+    assert loaded is not None
+    spans = plan_bam_spans(path, num_spans=8, header=header)
+    # all interior boundaries must be sampled record voffsets
+    sampled = set(loaded.voffsets)
+    for s in spans[1:]:
+        assert s.start_voffset in sampled
+    import os
+    os.remove(sidecar)
+
+
+def test_text_span_every_offset(tmp_path):
+    lines = [f"line{i:04d}|{'x' * (i % 37)}\n".encode() for i in range(200)]
+    data = b"".join(lines)
+    path = tmp_path / "t.txt"
+    path.write_bytes(data)
+    # 2-way partition at EVERY byte offset
+    for cut in range(0, len(data) + 1, 1):
+        a = read_text_span(data, FileByteSpan("t", 0, cut))
+        b = read_text_span(data, FileByteSpan("t", cut, len(data)))
+        assert a + b == data, f"cut at {cut}"
+    # random 5-way partitions
+    rng = random.Random(0)
+    for _ in range(50):
+        cuts = sorted(rng.randrange(len(data) + 1) for _ in range(4))
+        bounds = [0] + cuts + [len(data)]
+        parts = [read_text_span(data, FileByteSpan("t", bounds[i], bounds[i + 1]))
+                 for i in range(5)]
+        assert b"".join(parts) == data
