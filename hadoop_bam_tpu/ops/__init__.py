@@ -1,0 +1,20 @@
+"""Device kernels (JAX/jnp + Pallas): the compute the reference hid in JNI.
+
+In Hadoop-BAM, the per-record hot loop (SURVEY.md section 3.2) bottoms out in
+htsjdk ``BAMRecordCodec.decode`` and zlib-over-JNI inflate.  Here that work is
+reshaped for the TPU:
+
+- record *boundary discovery* stays on the host (serial block_size chaining;
+  C++ native path) — it is O(records) pointer-walking, not FLOPs;
+- record *field unpack* becomes a fixed-shape batched gather on device
+  (unpack_bam.py), emitting SoA columns;
+- sequence/quality decode, flagstat-style reductions, and tokenization are
+  vectorized device ops;
+- BGZF inflate is dispatched (inflate.py): host zlib, native C++
+  multithreaded, or the experimental on-device path.
+
+All jittable entry points take static shapes (capacity + count scalars) so XLA
+traces once and the same compiled step serves every span batch.
+"""
+from hadoop_bam_tpu.ops.unpack_bam import unpack_fixed_fields, FIXED_FIELDS  # noqa: F401
+from hadoop_bam_tpu.ops.flagstat import flagstat_from_columns, FLAGSTAT_FIELDS  # noqa: F401

@@ -1,0 +1,101 @@
+"""Flagstat: samtools-style flag summary as a device reduction.
+
+The "model" of this framework's minimum end-to-end slice (SURVEY.md section 7):
+decode a BAM span on device, reduce flag columns to counters.  Equivalent
+functionality in the reference universe is the CLI ``summarize`` plugin
+[VER?]; counts follow the samtools flagstat definitions over the FLAG field
+[SPEC section 1.4].
+
+All counters are jnp sums over masked boolean columns — embarrassingly
+fusable, and on a mesh they finish with one ``psum`` over the data axis
+(hadoop_bam_tpu/parallel/pipeline.py).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from hadoop_bam_tpu.formats.bam import (
+    FDUP, FMUNMAP, FPAIRED, FPROPER_PAIR, FQCFAIL, FREAD1, FREAD2, FREVERSE,
+    FSECONDARY, FSUPPLEMENTARY, FUNMAP,
+)
+
+FLAGSTAT_FIELDS = (
+    "total", "primary", "secondary", "supplementary", "duplicates",
+    "primary_duplicates", "mapped", "primary_mapped", "paired", "read1",
+    "read2", "properly_paired", "with_itself_and_mate_mapped", "singletons",
+    "mate_on_different_chr", "mate_on_different_chr_mapq5",
+)
+
+
+@jax.jit
+def flagstat_from_columns(cols: Dict[str, jnp.ndarray], valid: jnp.ndarray
+                          ) -> Dict[str, jnp.ndarray]:
+    """cols: output of ops.unpack_bam.unpack_fixed_fields; valid: bool [N].
+    Returns a dict of int32 scalar counters (a pytree, psum-able);
+    per-batch counts fit int32, cross-batch accumulation is host-side Python."""
+    flag = cols["flag"]
+    refid = cols["refid"]
+    mate_refid = cols["mate_refid"]
+    mapq = cols["mapq"]
+
+    def has(bit):
+        return (flag & bit) != 0
+
+    v = valid
+    secondary = has(FSECONDARY)
+    supplementary = has(FSUPPLEMENTARY)
+    primary = ~secondary & ~supplementary
+    mapped = ~has(FUNMAP)
+    paired = has(FPAIRED)
+    mate_mapped = ~has(FMUNMAP)
+    both = paired & mapped & mate_mapped
+    diff_chr = both & (mate_refid != refid) & (refid >= 0) & (mate_refid >= 0)
+
+    def count(mask):
+        return jnp.sum(jnp.where(v & mask, 1, 0), dtype=jnp.int32)
+
+    return {
+        "total": count(jnp.ones_like(flag, dtype=bool)),
+        "primary": count(primary),
+        "secondary": count(secondary),
+        "supplementary": count(supplementary),
+        "duplicates": count(has(FDUP)),
+        "primary_duplicates": count(primary & has(FDUP)),
+        "mapped": count(mapped),
+        "primary_mapped": count(primary & mapped),
+        "paired": count(paired),
+        "read1": count(paired & has(FREAD1)),
+        "read2": count(paired & has(FREAD2)),
+        "properly_paired": count(paired & has(FPROPER_PAIR) & mapped),
+        "with_itself_and_mate_mapped": count(both),
+        "singletons": count(paired & mapped & ~mate_mapped),
+        "mate_on_different_chr": count(diff_chr),
+        "mate_on_different_chr_mapq5": count(diff_chr & (mapq >= 5)),
+    }
+
+
+def format_flagstat(stats: Dict[str, int]) -> str:
+    """samtools-flagstat-style text rendering (host side)."""
+    g = {k: int(v) for k, v in stats.items()}
+    lines = [
+        f"{g['total']} + 0 in total (QC-passed reads + QC-failed reads)",
+        f"{g['primary']} + 0 primary",
+        f"{g['secondary']} + 0 secondary",
+        f"{g['supplementary']} + 0 supplementary",
+        f"{g['duplicates']} + 0 duplicates",
+        f"{g['primary_duplicates']} + 0 primary duplicates",
+        f"{g['mapped']} + 0 mapped",
+        f"{g['primary_mapped']} + 0 primary mapped",
+        f"{g['paired']} + 0 paired in sequencing",
+        f"{g['read1']} + 0 read1",
+        f"{g['read2']} + 0 read2",
+        f"{g['properly_paired']} + 0 properly paired",
+        f"{g['with_itself_and_mate_mapped']} + 0 with itself and mate mapped",
+        f"{g['singletons']} + 0 singletons",
+        f"{g['mate_on_different_chr']} + 0 with mate mapped to a different chr",
+        f"{g['mate_on_different_chr_mapq5']} + 0 with mate mapped to a different chr (mapQ>=5)",
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,132 @@
+"""Batched BGZF inflate dispatch: the framework's replacement for the
+per-block zlib-over-JNI inflate in the reference hot loop (SURVEY.md 3.2).
+
+Paths, in preference order:
+
+- ``native``: C++ multithreaded zlib over all blocks of a span at once
+  (native/hbam_native.cpp) — the production host path feeding device batches.
+- ``zlib``: Python zlib per block (portable fallback, still batched at the
+  span level).
+- ``device``: experimental Pallas DEFLATE (ops/inflate_device.py, later
+  rounds) — blocks inflate *on the TPU*, removing the host decompress from
+  the critical path entirely.
+
+All paths share one contract: given the raw compressed span bytes and the
+parsed block table, produce a contiguous inflated buffer + per-block inflated
+offsets.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.utils import native
+
+
+def block_table(raw: bytes, offset: int = 0) -> dict:
+    """Parse consecutive BGZF block headers into a columnar table."""
+    coffs, cdata_off, cdata_len, isize = [], [], [], []
+    p = offset
+    n = len(raw)
+    while p < n:
+        info = bgzf.parse_block_header(raw, p)
+        coffs.append(info.coffset)
+        cdata_off.append(info.cdata_offset)
+        cdata_len.append(info.cdata_size)
+        isize.append(info.isize)
+        p = info.next_coffset
+    return {
+        "coffset": np.asarray(coffs, dtype=np.int64),
+        "cdata_off": np.asarray(cdata_off, dtype=np.int64),
+        "cdata_len": np.asarray(cdata_len, dtype=np.int32),
+        "isize": np.asarray(isize, dtype=np.int32),
+    }
+
+
+def inflate_span(raw: bytes, table: Optional[dict] = None,
+                 backend: str = "auto", n_threads: int = 0
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Inflate all blocks of a compressed span.
+
+    Returns (data, ubase): ``data`` is the contiguous inflated bytes of the
+    span; ``ubase[i]`` is each block's starting offset within ``data`` (the
+    map from (block, in-block offset) to buffer offset — i.e. from virtual
+    offsets to positions).
+    """
+    if table is None:
+        table = block_table(raw)
+    isize = table["isize"]
+    ubase = np.zeros(isize.size + 1, dtype=np.int64)
+    np.cumsum(isize, out=ubase[1:])
+    total = int(ubase[-1])
+    dst = np.empty(total, dtype=np.uint8)
+    src = np.frombuffer(raw, dtype=np.uint8)
+
+    if backend == "auto":
+        backend = "native" if native.available() else "zlib"
+    if backend == "native":
+        native.inflate_batch(src, table["cdata_off"], table["cdata_len"],
+                             dst, ubase[:-1], isize, n_threads)
+    elif backend == "zlib":
+        mv = memoryview(raw)
+        for i in range(isize.size):
+            o, l = int(table["cdata_off"][i]), int(table["cdata_len"][i])
+            out = zlib.decompress(bytes(mv[o:o + l]), wbits=-15)
+            if len(out) != int(isize[i]):
+                raise bgzf.BGZFError(f"ISIZE mismatch in block {i}")
+            dst[int(ubase[i]):int(ubase[i + 1])] = np.frombuffer(out, np.uint8)
+    else:
+        raise ValueError(f"unknown inflate backend {backend!r}")
+    return dst, ubase[:-1]
+
+
+def verify_crcs(raw: bytes, table: dict, data: np.ndarray,
+                ubase: np.ndarray, n_threads: int = 0) -> None:
+    """Validate every block's CRC32 footer against the inflated bytes
+    (native batched CRC when available)."""
+    n = table["isize"].size
+    src = np.frombuffer(raw, dtype=np.uint8)
+    # footer CRC sits 8 bytes before each block end
+    foot = table["cdata_off"] + table["cdata_len"]
+    expect = (src[foot].astype(np.uint32)
+              | (src[foot + 1].astype(np.uint32) << 8)
+              | (src[foot + 2].astype(np.uint32) << 16)
+              | (src[foot + 3].astype(np.uint32) << 24))
+    if native.available():
+        import ctypes
+        lib = native.load()
+        got = np.empty(n, dtype=np.uint32)
+        lib.hbam_crc32_batch(
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ubase.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            table["isize"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n, got.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            n_threads if n_threads > 0 else 0 or 1)
+    else:
+        got = np.empty(n, dtype=np.uint32)
+        for i in range(n):
+            s, e = int(ubase[i]), int(ubase[i]) + int(table["isize"][i])
+            got[i] = zlib.crc32(data[s:e].tobytes()) & 0xFFFFFFFF
+    bad = np.nonzero(got != expect)[0]
+    if bad.size:
+        raise bgzf.BGZFError(f"CRC32 mismatch in block(s) {bad[:8].tolist()}")
+
+
+def walk_records(data: np.ndarray, start: int = 0,
+                 cap: Optional[int] = None) -> Tuple[np.ndarray, int]:
+    """Record-boundary walk over inflated bytes: native when available,
+    NumPy/Python otherwise.  Returns (offsets, tail_offset) where tail_offset
+    is the first incomplete record's offset (== len when exact)."""
+    if cap is None:
+        cap = max(16, data.size // 40)  # generous: min plausible record ~40 B
+    if native.available():
+        return native.walk_bam_records(np.ascontiguousarray(data), start, cap)
+    from hadoop_bam_tpu.formats.bam import walk_record_offsets
+    offs = walk_record_offsets(data.tobytes(), start=start)
+    tail = int(offs[-1] + 4 + int.from_bytes(
+        data[int(offs[-1]):int(offs[-1]) + 4].tobytes(), "little", signed=True)
+        ) if offs.size else start
+    return offs, tail

@@ -1,0 +1,71 @@
+"""Sequence/quality payload decode on device.
+
+Device-side replacement for htsjdk's per-record seq/qual string decode:
+the 4-bit packed bases [SPEC "=ACMGRSVTWYHKDBN"] of a whole batch are
+unpacked into an [N, L] uint8 matrix by one gather + nibble select, and
+qualities by one gather + offset — the shapes downstream TPU compute wants
+(one row per read, fixed length, masked).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hadoop_bam_tpu.formats.bam import SEQ_NIBBLE
+
+_NIBBLE_LUT = np.frombuffer(SEQ_NIBBLE.encode(), dtype=np.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def decode_seq(data: jnp.ndarray, seq_offsets: jnp.ndarray,
+               l_seq: jnp.ndarray, max_len: int) -> jnp.ndarray:
+    """data u8 [D]; seq_offsets/l_seq i32 [N] -> ASCII bases u8 [N, max_len],
+    zero beyond each read's length."""
+    pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]          # [1, L]
+    byte_idx = seq_offsets[:, None] + pos // 2                   # [N, L]
+    byte_idx = jnp.minimum(byte_idx, data.shape[0] - 1)
+    packed = data[byte_idx]                                      # [N, L]
+    nibble = jnp.where(pos % 2 == 0, packed >> 4, packed & 0xF)
+    lut = jnp.asarray(_NIBBLE_LUT)
+    ascii_ = lut[nibble]
+    mask = pos < l_seq[:, None]
+    return jnp.where(mask, ascii_, 0).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "ascii_offset"))
+def decode_qual(data: jnp.ndarray, qual_offsets: jnp.ndarray,
+                l_seq: jnp.ndarray, max_len: int,
+                ascii_offset: int = 33) -> jnp.ndarray:
+    """Phred qualities as ASCII (offset +33 by default); 0 beyond length."""
+    pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    idx = jnp.minimum(qual_offsets[:, None] + pos, data.shape[0] - 1)
+    q = data[idx]
+    mask = (pos < l_seq[:, None]) & (q != 0xFF)
+    return jnp.where(mask, q + ascii_offset, 0).astype(jnp.uint8)
+
+
+@jax.jit
+def base_composition(seq_ascii: jnp.ndarray) -> jnp.ndarray:
+    """Count A/C/G/T/N/other over an [N, L] ASCII base matrix -> int32 [6]."""
+    flat = seq_ascii.reshape(-1)
+    live = flat != 0
+    counts = []
+    for ch in b"ACGTN":
+        counts.append(jnp.sum(jnp.where(live & (flat == ch), 1, 0),
+                              dtype=jnp.int32))
+    total = jnp.sum(jnp.where(live, 1, 0), dtype=jnp.int32)
+    counts.append(total - sum(counts))
+    return jnp.stack(counts)
+
+
+@jax.jit
+def mean_base_quality(qual_ascii: jnp.ndarray, ascii_offset: int = 33
+                      ) -> jnp.ndarray:
+    """Mean Phred score over valid bases of an [N, L] ASCII quality matrix."""
+    live = qual_ascii != 0
+    q = jnp.where(live, qual_ascii.astype(jnp.int32) - ascii_offset, 0)
+    n = jnp.maximum(jnp.sum(jnp.where(live, 1, 0)), 1)
+    return jnp.sum(q) / n

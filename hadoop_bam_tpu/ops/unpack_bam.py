@@ -1,0 +1,140 @@
+"""Batched BAM record field unpack: bytes + offsets -> SoA columns, on device.
+
+The device-side replacement for htsjdk ``BAMRecordCodec.decode``'s per-record
+field parse (the hot loop of hb/BAMRecordReader.java, SURVEY.md section 3.2).
+Input is the inflated span bytes (uint8, padded to a static capacity) and the
+record start offsets (int32, padded); output is one int32 column per fixed
+field [SPEC record layout, formats/bam.py docstring].
+
+Two implementations with identical semantics:
+
+- ``unpack_fixed_fields``: pure jnp.  The single gather
+  ``data[offsets[:, None] + arange(36)]`` pulls each record's fixed 36-byte
+  prefix into an [N, 36] tile; field extraction is then fused elementwise
+  arithmetic.  XLA lowers this well on TPU and it is the default.
+- ``unpack_fixed_fields_pallas``: Pallas kernel tiling the offset vector, with
+  the span bytes resident in VMEM; useful when fusing unpack with downstream
+  per-record compute in one kernel.
+
+Padding convention: offsets[i] for i >= n_records MUST point at valid bytes
+(use 0); consumers mask with ``valid = arange(N) < n_records``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# column name -> (byte offset in record, byte width, signed)
+FIXED_FIELDS: Dict[str, Tuple[int, int, bool]] = {
+    "block_size": (0, 4, True),
+    "refid": (4, 4, True),
+    "pos": (8, 4, True),
+    "l_read_name": (12, 1, False),
+    "mapq": (13, 1, False),
+    "bin": (14, 2, False),
+    "n_cigar": (16, 2, False),
+    "flag": (18, 2, False),
+    "l_seq": (20, 4, True),
+    "mate_refid": (24, 4, True),
+    "mate_pos": (28, 4, True),
+    "tlen": (32, 4, True),
+}
+
+PREFIX = 36
+
+
+def _fields_from_tile(tile: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """tile: [N, 36] uint8 -> dict of int32 columns (fused elementwise)."""
+    t = tile.astype(jnp.uint32)
+    out: Dict[str, jnp.ndarray] = {}
+    for name, (off, width, signed) in FIXED_FIELDS.items():
+        acc = t[:, off]
+        for k in range(1, width):
+            acc = acc | (t[:, off + k] << (8 * k))
+        col = acc.astype(jnp.int32) if (signed or width == 4) else \
+            acc.astype(jnp.int32)
+        out[name] = col
+    return out
+
+
+@jax.jit
+def unpack_fixed_fields(data: jnp.ndarray, offsets: jnp.ndarray
+                        ) -> Dict[str, jnp.ndarray]:
+    """data: uint8 [D]; offsets: int32 [N] (padded with safe offsets).
+    Returns dict of int32 [N] columns for every fixed field."""
+    idx = offsets[:, None] + jnp.arange(PREFIX, dtype=offsets.dtype)[None, :]
+    tile = data[idx]  # [N, 36] uint8 gather
+    return _fields_from_tile(tile)
+
+
+def unpack_fixed_fields_pallas(data: jnp.ndarray, offsets: jnp.ndarray,
+                               block_n: int = 1024) -> Dict[str, jnp.ndarray]:
+    """Pallas variant: grid over offset tiles; span bytes stay in ANY/HBM and
+    each tile gathers through dynamic indexing.
+
+    Note: on TPU, arbitrary-offset gathers inside a kernel serialize through
+    scalar loads, so this variant mainly exists as the fusion point for
+    later kernels (unpack + filter + reduce in one pass); the jnp gather above
+    is the throughput path today."""
+    from jax.experimental import pallas as pl
+
+    n = offsets.shape[0]
+    assert n % block_n == 0, "pad offsets to a multiple of block_n"
+
+    def kernel(data_ref, offs_ref, *out_refs):
+        offs = offs_ref[:]  # [block_n]
+        idx = offs[:, None] + jax.lax.broadcasted_iota(
+            jnp.int32, (block_n, PREFIX), 1)
+        tile = data_ref[idx]
+        cols = _fields_from_tile(tile)
+        for ref, name in zip(out_refs, FIXED_FIELDS):
+            ref[:] = cols[name]
+
+    out_shapes = tuple(jax.ShapeDtypeStruct((n,), jnp.int32)
+                       for _ in FIXED_FIELDS)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(n // block_n,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((block_n,), lambda i: (i,)),
+        ],
+        out_specs=tuple(pl.BlockSpec((block_n,), lambda i: (i,))
+                        for _ in FIXED_FIELDS),
+        out_shape=out_shapes,
+        interpret=jax.default_backend() == "cpu",
+    )(data, offsets)
+    return dict(zip(FIXED_FIELDS, outs))
+
+
+@jax.jit
+def gather_record_windows(data: jnp.ndarray, offsets: jnp.ndarray,
+                          window: int) -> jnp.ndarray:
+    """Gather a fixed-size byte window per record (for payload-stage kernels:
+    names, cigar, seq).  Returns uint8 [N, window]."""
+    idx = offsets[:, None] + jnp.arange(window, dtype=offsets.dtype)[None, :]
+    idx = jnp.minimum(idx, data.shape[0] - 1)
+    return data[idx]
+
+
+def pad_offsets(offsets: np.ndarray, capacity: int) -> Tuple[np.ndarray, int]:
+    """Host helper: pad an offsets vector to ``capacity`` with zeros."""
+    n = int(offsets.size)
+    if n > capacity:
+        raise ValueError(f"{n} records exceed capacity {capacity}")
+    out = np.zeros(capacity, dtype=np.int32)
+    out[:n] = offsets
+    return out, n
+
+
+def pad_data(data: np.ndarray, capacity: int) -> np.ndarray:
+    """Host helper: pad span bytes to ``capacity`` (static shape for jit)."""
+    if data.size > capacity:
+        raise ValueError(f"{data.size} bytes exceed capacity {capacity}")
+    out = np.zeros(capacity, dtype=np.uint8)
+    out[:data.size] = data
+    return out

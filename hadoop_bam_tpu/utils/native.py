@@ -1,0 +1,118 @@
+"""Build + load the native C++ helper library (ctypes).
+
+The reference reached native code through java.util.zip's JNI; we compile
+native/hbam_native.cpp on first use with g++ and bind via ctypes (no pybind11
+in this image).  Every caller must tolerate ``load() is None`` — the NumPy /
+zlib-module fallbacks keep the framework fully functional without a compiler.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "hbam_native.cpp")
+_OUT_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_OUT_DIR, "libhbam_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> bool:
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _SO, "-lz"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (compiling if needed) the native library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _compile():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        i8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.hbam_inflate_batch.restype = ctypes.c_int
+        lib.hbam_inflate_batch.argtypes = [
+            i8p, i64p, i32p, ctypes.c_int32, i8p, i64p, i32p, ctypes.c_int32]
+        lib.hbam_walk_bam_records.restype = ctypes.c_int64
+        lib.hbam_walk_bam_records.argtypes = [
+            i8p, ctypes.c_int64, ctypes.c_int64, i64p, ctypes.c_int64, i64p]
+        lib.hbam_crc32_batch.restype = ctypes.c_int
+        lib.hbam_crc32_batch.argtypes = [
+            i8p, i64p, i32p, ctypes.c_int32, u32p, ctypes.c_int32]
+        lib.hbam_deflate_batch.restype = ctypes.c_int
+        lib.hbam_deflate_batch.argtypes = [
+            i8p, i64p, i32p, ctypes.c_int32, i8p, i64p, i32p, i32p,
+            ctypes.c_int32, ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def inflate_batch(src: np.ndarray, cdata_off: np.ndarray,
+                  cdata_len: np.ndarray, dst: np.ndarray,
+                  dst_off: np.ndarray, isize: np.ndarray,
+                  n_threads: int = 0) -> None:
+    """Native batched inflate; raises on corrupt blocks."""
+    lib = load()
+    assert lib is not None
+    if n_threads <= 0:
+        n_threads = min(len(cdata_off), os.cpu_count() or 1)
+    rc = lib.hbam_inflate_batch(
+        _ptr(src, ctypes.c_uint8), _ptr(cdata_off, ctypes.c_int64),
+        _ptr(cdata_len, ctypes.c_int32), len(cdata_off),
+        _ptr(dst, ctypes.c_uint8), _ptr(dst_off, ctypes.c_int64),
+        _ptr(isize, ctypes.c_int32), n_threads)
+    if rc:
+        raise ValueError(f"native inflate failed at block {rc - 1000}")
+
+
+def walk_bam_records(buf: np.ndarray, start: int, cap: int
+                     ) -> tuple[np.ndarray, int]:
+    """Native record walk; returns (offsets, tail_offset)."""
+    lib = load()
+    assert lib is not None
+    out = np.empty(cap, dtype=np.int64)
+    tail = np.zeros(1, dtype=np.int64)
+    n = lib.hbam_walk_bam_records(
+        _ptr(buf, ctypes.c_uint8), buf.size, start,
+        _ptr(out, ctypes.c_int64), cap, _ptr(tail, ctypes.c_int64))
+    if n < 0:
+        raise ValueError("malformed BAM record chain")
+    if n > cap:
+        raise ValueError(f"record count {n} exceeds capacity {cap}")
+    return out[:n], int(tail[0])
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,160 @@
+// hbam_native: host-side native kernels for hadoop-bam-tpu.
+//
+// The reference's native layer is zlib behind java.util.zip JNI (SURVEY.md
+// section 2.8).  Ours is explicit: a small C++ library doing the two serial,
+// branchy jobs that belong on the host —
+//   1. batched multithreaded BGZF DEFLATE inflate (feeding device batches),
+//   2. BAM record-boundary walking (the block_size chain),
+// leaving vectorizable decode to the TPU.  Exposed via plain C ABI for ctypes.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -pthread hbam_native.cpp -lz
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+extern "C" {
+
+// Inflate n_blocks independent raw-DEFLATE streams concurrently.
+// src: the whole compressed span; cdata_off/cdata_len: per-block payload
+// location; dst: output buffer; dst_off: per-block output position;
+// expected_isize: per-block expected inflated size (from BGZF footers).
+// Returns 0 on success, or (1000 + first failing block index).
+int hbam_inflate_batch(const uint8_t* src,
+                       const int64_t* cdata_off, const int32_t* cdata_len,
+                       int32_t n_blocks,
+                       uint8_t* dst, const int64_t* dst_off,
+                       const int32_t* expected_isize,
+                       int32_t n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int32_t> next(0);
+  std::atomic<int32_t> fail(-1);
+  auto worker = [&]() {
+    z_stream zs;
+    std::memset(&zs, 0, sizeof(zs));
+    bool live = false;
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n_blocks || fail.load(std::memory_order_relaxed) >= 0) break;
+      if (!live) {
+        if (inflateInit2(&zs, -15) != Z_OK) { fail.store(i); break; }
+        live = true;
+      } else {
+        inflateReset(&zs);
+      }
+      zs.next_in = const_cast<Bytef*>(src + cdata_off[i]);
+      zs.avail_in = static_cast<uInt>(cdata_len[i]);
+      zs.next_out = dst + dst_off[i];
+      zs.avail_out = static_cast<uInt>(expected_isize[i]);
+      int rc = inflate(&zs, Z_FINISH);
+      if (rc != Z_STREAM_END ||
+          static_cast<int32_t>(zs.total_out) != expected_isize[i]) {
+        int32_t expect = -1;
+        fail.compare_exchange_strong(expect, i);
+        break;
+      }
+    }
+    if (live) inflateEnd(&zs);
+  };
+  if (n_threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  int32_t f = fail.load();
+  return f >= 0 ? 1000 + f : 0;
+}
+
+// Walk BAM record boundaries: offsets of each record's block_size field.
+// buf/n: inflated bytes; start: first record offset; out/cap: output array.
+// Writes record-start offsets; returns count (may be < actual if cap hit),
+// or -1 on a malformed block_size.  *tail_off receives the offset of the
+// first incomplete record (== n when the walk consumed everything).
+int64_t hbam_walk_bam_records(const uint8_t* buf, int64_t n, int64_t start,
+                              int64_t* out, int64_t cap, int64_t* tail_off) {
+  int64_t p = start, count = 0;
+  while (p + 4 <= n) {
+    int32_t bs;
+    std::memcpy(&bs, buf + p, 4);  // BAM is little-endian; so are our hosts
+    if (bs < 32) return -1;
+    if (p + 4 + bs > n) break;
+    if (count < cap) out[count] = p;
+    ++count;
+    p += 4 + static_cast<int64_t>(bs);
+  }
+  if (tail_off) *tail_off = p;
+  return count;
+}
+
+// CRC32 of a batch of byte ranges (BGZF block payload validation), threaded.
+// Returns 0; crcs[i] receives the zlib CRC32 of data[off[i] .. off[i]+len[i]).
+int hbam_crc32_batch(const uint8_t* data, const int64_t* off,
+                     const int32_t* len, int32_t n, uint32_t* crcs,
+                     int32_t n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int32_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n) break;
+      crcs[i] = static_cast<uint32_t>(
+          crc32(0L, data + off[i], static_cast<uInt>(len[i])));
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
+// Batched BGZF block deflate (writer path): compress n independent payloads.
+// levels: zlib level; dst must have 64 KiB capacity per block at dst_off[i];
+// out_len[i] receives each compressed size (header+cdata+footer are NOT
+// added here — this is the raw DEFLATE payload only).
+int hbam_deflate_batch(const uint8_t* src, const int64_t* src_off,
+                       const int32_t* src_len, int32_t n_blocks,
+                       uint8_t* dst, const int64_t* dst_off,
+                       const int32_t* dst_cap, int32_t* out_len,
+                       int32_t level, int32_t n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int32_t> next(0);
+  std::atomic<int32_t> fail(-1);
+  auto worker = [&]() {
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n_blocks || fail.load(std::memory_order_relaxed) >= 0) break;
+      z_stream zs;
+      std::memset(&zs, 0, sizeof(zs));
+      if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8,
+                       Z_DEFAULT_STRATEGY) != Z_OK) {
+        fail.store(i);
+        break;
+      }
+      zs.next_in = const_cast<Bytef*>(src + src_off[i]);
+      zs.avail_in = static_cast<uInt>(src_len[i]);
+      zs.next_out = dst + dst_off[i];
+      zs.avail_out = static_cast<uInt>(dst_cap[i]);
+      int rc = deflate(&zs, Z_FINISH);
+      if (rc != Z_STREAM_END) {
+        int32_t expect = -1;
+        fail.compare_exchange_strong(expect, i);
+      } else {
+        out_len[i] = static_cast<int32_t>(zs.total_out);
+      }
+      deflateEnd(&zs);
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+  int32_t f = fail.load();
+  return f >= 0 ? 1000 + f : 0;
+}
+
+}  // extern "C"
