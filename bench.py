@@ -1,0 +1,129 @@
+"""Benchmark: BAM decode records/sec/chip vs single-thread CPU baseline.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+- Baseline: single-thread host decode — per-block zlib inflate + full
+  fixed-field decode in NumPy (the htsjdk-single-thread-equivalent of
+  BASELINE.md config #1; real htsjdk/pysam are not in this image).
+- Measured: the framework pipeline on the default JAX device — threaded
+  native C++ inflate + record walk feeding the jitted device unpack+flagstat
+  step (the reference hot loop of SURVEY.md section 3.2 rebuilt).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+BENCH_RECORDS = int(os.environ.get("BENCH_RECORDS", "300000"))
+BENCH_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_data")
+BENCH_BAM = os.path.join(BENCH_DIR, f"bench_{BENCH_RECORDS}.bam")
+
+
+def build_fixture() -> str:
+    if os.path.exists(BENCH_BAM):
+        return BENCH_BAM
+    os.makedirs(BENCH_DIR, exist_ok=True)
+    from hadoop_bam_tpu.formats.bam import SAMHeader, encode_record
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+
+    header = SAMHeader.from_sam_text(
+        "@HD\tVN:1.6\tSO:coordinate\n"
+        "@SQ\tSN:chr20\tLN:64444167\n@SQ\tSN:chr21\tLN:46709983\n")
+    rng = random.Random(1234)
+    bases = "ACGT"
+    with BamWriter(BENCH_BAM + ".tmp", header) as w:
+        pos = 1
+        for i in range(BENCH_RECORDS):
+            l = 151
+            seq = "".join(rng.choice(bases) for _ in range(l))
+            qual = "".join(chr(33 + rng.randint(2, 40)) for _ in range(l))
+            pos += rng.randint(0, 40)
+            flag = 99 if i % 2 == 0 else 147
+            rec = encode_record(
+                name=f"read{i:09d}", flag=flag, refid=0, pos=pos, mapq=60,
+                cigar=[(l, "M")], mate_refid=0, mate_pos=pos + 200, tlen=351,
+                seq=seq, qual=qual,
+                tags=[("NM", "i", rng.randint(0, 4)), ("RG", "Z", "rg0")])
+            w.write_record_bytes(rec)
+    os.replace(BENCH_BAM + ".tmp", BENCH_BAM)
+    return BENCH_BAM
+
+
+def baseline_single_thread(path: str) -> float:
+    """records/sec: single-thread zlib + NumPy full fixed-field decode."""
+    import zlib
+
+    from hadoop_bam_tpu.formats import bgzf
+    from hadoop_bam_tpu.formats.bam import BamBatch, SAMHeader, walk_record_offsets
+
+    raw = open(path, "rb").read()
+    t0 = time.perf_counter()
+    chunks = []
+    for info in bgzf.scan_blocks(raw):
+        if info.isize:
+            chunks.append(zlib.decompress(
+                raw[info.cdata_offset:info.cdata_offset + info.cdata_size],
+                wbits=-15))
+    data = b"".join(chunks)
+    _, after = SAMHeader.from_bam_bytes(data)
+    offs = walk_record_offsets(data, start=after)
+    batch = BamBatch(np.frombuffer(data, dtype=np.uint8), offs)
+    # force full fixed-field decode (the htsjdk-decode-equivalent work)
+    for name in ("refid", "pos", "flag", "mapq", "l_seq", "mate_refid",
+                 "mate_pos", "tlen", "bin", "n_cigar", "l_read_name"):
+        getattr(batch, name)
+    n = len(batch)
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def measured_pipeline(path: str) -> float:
+    """records/sec/chip: threaded native inflate + device unpack/flagstat."""
+    import jax
+
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.parallel.pipeline import (
+        DecodeGeometry, flagstat_file,
+    )
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh()
+    geometry = DecodeGeometry(bytes_cap=1 << 25, records_cap=1 << 17)
+    header, _ = read_bam_header(path)
+
+    # warmup (compile)
+    stats = flagstat_file(path, mesh=mesh, geometry=geometry, header=header)
+    n_records = stats["total"]
+    # timed runs
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        stats = flagstat_file(path, mesh=mesh, geometry=geometry,
+                              header=header)
+    dt = (time.perf_counter() - t0) / reps
+    return stats["total"] / dt / n_dev
+
+
+def main() -> None:
+    path = build_fixture()
+    base = baseline_single_thread(path)
+    meas = measured_pipeline(path)
+    print(json.dumps({
+        "metric": "bam_decode_records_per_sec_per_chip",
+        "value": round(meas, 1),
+        "unit": "records/s",
+        "vs_baseline": round(meas / base, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""User surface: datasets, format dispatch, writers.
+
+The rebuild of the reference's L4/L5 adapter layer (SURVEY.md section 1) —
+InputFormats become datasets yielding SoA batches; OutputFormats become shard
+writers + mergers; AnySAM/VCF format sniffing becomes ``sniff_*`` dispatch.
+"""
+from hadoop_bam_tpu.api.dispatch import (  # noqa: F401
+    SAMContainer, VCFContainer, sniff_sam_container, sniff_vcf_container,
+)
+from hadoop_bam_tpu.api.dataset import (  # noqa: F401
+    open_bam, open_sam, open_any_sam, BamDataset, SamDataset,
+)

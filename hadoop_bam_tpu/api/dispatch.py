@@ -1,0 +1,120 @@
+"""Format dispatch: extension + magic-byte sniffing.
+
+Rebuild of hb/SAMFormat.java (enum SAM/BAM/CRAM with ``inferFromFilePath`` /
+``inferFromData``), hb/VCFFormat.java (VCF/BCF), and the per-path resolution
++ trust-exts semantics of hb/AnySAMInputFormat.java / hb/VCFInputFormat.java.
+
+Magics [SPEC]: BAM = BGZF block whose inflated payload starts "BAM\\1";
+CRAM = "CRAM"; BCF = "BCF" (optionally inside BGZF); text VCF starts
+"##fileformat="; otherwise SAM (text with @header or alignment lines).
+"""
+from __future__ import annotations
+
+import enum
+import os
+from typing import Dict, Optional
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.utils.seekable import as_byte_source
+
+
+class SAMContainer(enum.Enum):
+    SAM = "sam"
+    BAM = "bam"
+    CRAM = "cram"
+
+
+class VCFContainer(enum.Enum):
+    VCF = "vcf"       # plain text
+    VCF_BGZF = "vcf.gz"
+    BCF = "bcf"
+
+
+_SAM_EXT = {".sam": SAMContainer.SAM, ".bam": SAMContainer.BAM,
+            ".cram": SAMContainer.CRAM}
+_VCF_EXT = {".vcf": VCFContainer.VCF, ".bcf": VCFContainer.BCF}
+
+# per-path sniff cache, as in hb/AnySAMInputFormat (formatMap)
+_sam_cache: Dict[str, SAMContainer] = {}
+_vcf_cache: Dict[str, VCFContainer] = {}
+
+
+def sniff_sam_container(path: str, config: HBamConfig = DEFAULT_CONFIG,
+                        data: Optional[bytes] = None) -> SAMContainer:
+    """Resolve SAM/BAM/CRAM for a path (extension first when trusted, magic
+    bytes otherwise) — hb/AnySAMInputFormat.getFormat semantics."""
+    if path in _sam_cache:
+        return _sam_cache[path]
+    ext = os.path.splitext(path)[1].lower()
+    if config.trust_exts and ext in _SAM_EXT:
+        fmt = _SAM_EXT[ext]
+    else:
+        fmt = _sniff_sam_data(path, data)
+    _sam_cache[path] = fmt
+    return fmt
+
+
+def _sniff_sam_data(path: str, data: Optional[bytes]) -> SAMContainer:
+    head = data if data is not None else _read_head(path)
+    if head[:4] == b"CRAM":
+        return SAMContainer.CRAM
+    if bgzf.is_bgzf(head):
+        try:
+            payload = bgzf.inflate_block(head)
+        except bgzf.BGZFError:
+            payload = b""
+        if payload[:4] == b"BAM\x01":
+            return SAMContainer.BAM
+    return SAMContainer.SAM
+
+
+def sniff_vcf_container(path: str, config: HBamConfig = DEFAULT_CONFIG,
+                        data: Optional[bytes] = None) -> VCFContainer:
+    """Resolve VCF / VCF-in-BGZF / BCF — hb/VCFFormat + VCFInputFormat."""
+    if path in _vcf_cache:
+        return _vcf_cache[path]
+    lower = path.lower()
+    if config.vcf_trust_exts:
+        if lower.endswith((".vcf.gz", ".vcf.bgz", ".vcf.bgzf")):
+            fmt = VCFContainer.VCF_BGZF
+        elif lower.endswith(".bcf"):
+            fmt = VCFContainer.BCF
+        elif lower.endswith(".vcf"):
+            fmt = VCFContainer.VCF
+        else:
+            fmt = _sniff_vcf_data(path, data)
+    else:
+        fmt = _sniff_vcf_data(path, data)
+    _vcf_cache[path] = fmt
+    return fmt
+
+
+def _sniff_vcf_data(path: str, data: Optional[bytes]) -> VCFContainer:
+    head = data if data is not None else _read_head(path)
+    if head[:3] == b"BCF":
+        return VCFContainer.BCF
+    if bgzf.is_bgzf(head):
+        try:
+            payload = bgzf.inflate_block(head)
+        except bgzf.BGZFError:
+            payload = b""
+        if payload[:3] == b"BCF":
+            return VCFContainer.BCF
+        return VCFContainer.VCF_BGZF
+    if head[:13] == b"##fileformat=":
+        return VCFContainer.VCF
+    raise ValueError(f"cannot determine VCF container of {path!r}")
+
+
+def _read_head(path: str) -> bytes:
+    src = as_byte_source(path)
+    try:
+        return src.pread(0, bgzf.MAX_BLOCK_SIZE)
+    finally:
+        src.close()
+
+
+def clear_sniff_caches() -> None:
+    _sam_cache.clear()
+    _vcf_cache.clear()

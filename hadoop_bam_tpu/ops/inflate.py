@@ -121,7 +121,9 @@ def walk_records(data: np.ndarray, start: int = 0,
     NumPy/Python otherwise.  Returns (offsets, tail_offset) where tail_offset
     is the first incomplete record's offset (== len when exact)."""
     if cap is None:
-        cap = max(16, data.size // 40)  # generous: min plausible record ~40 B
+        # min on-wire record = 4-byte block_size + 32-byte fixed core (the
+        # native walker accepts any bs >= 32), so count can never exceed //36
+        cap = max(16, data.size // 36)
     if native.available():
         return native.walk_bam_records(np.ascontiguousarray(data), start, cap)
     from hadoop_bam_tpu.formats.bam import walk_record_offsets

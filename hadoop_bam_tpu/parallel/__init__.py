@@ -1,0 +1,17 @@
+"""Mesh runtime: the distribution layer the reference delegated to Hadoop.
+
+Hadoop-BAM itself does no networking (SURVEY.md section 2.9) — HDFS places
+blocks, YARN schedules tasks, MR shuffles.  The TPU rebuild owns this layer:
+
+- mesh.py         — device mesh construction (data axis; 1D by default)
+- pipeline.py     — sharded decode steps (shard_map over the data axis) and
+                    the host fetch/inflate -> device unpack pipeline with
+                    prefetch overlap
+- distributed.py  — multi-host init (jax.distributed), single-planner span
+                    broadcast, per-host span assignment
+
+Distributed correctness is tested on a virtual 8-device CPU mesh — the exact
+analog of the reference testing InputFormats against local files with no
+cluster (SURVEY.md section 4).
+"""
+from hadoop_bam_tpu.parallel.mesh import make_mesh  # noqa: F401

@@ -1,0 +1,104 @@
+"""Multi-host coordination: init, single-planner broadcast, span assignment.
+
+The reference's "distributed backend" was Hadoop's (SURVEY.md section 2.9):
+HDFS for placement, YARN for scheduling, one client-side getSplits() whose
+result rode the job config to every task.  The TPU rebuild keeps that shape:
+
+- ``initialize()`` — jax.distributed bootstrap (no-op single-host);
+- ``broadcast_plan()`` — host 0 plans spans (guessers/index probing do real
+  I/O and inflation, so they must run once, not per host — the analog of
+  client-side split planning at job submission), every host receives the
+  JSON-serialized plan over the ICI/DCN collective fabric;
+- ``assign_spans()`` — contiguous per-host slices (locality: each host
+  fetches only its slice's byte ranges), then per-device groups inside
+  parallel/pipeline.py.
+
+Failure recovery mirrors the reference (SURVEY.md section 5): spans are
+self-describing and decode is idempotent/side-effect-free, so any span can be
+re-decoded anywhere; ``retry_span`` is a plain re-invoke.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from hadoop_bam_tpu.split.spans import FileVirtualSpan
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up jax.distributed when configured; safe no-op otherwise."""
+    if coordinator_address is None and num_processes is None:
+        return  # single-host / env-driven auto-init
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def broadcast_plan(spans: Optional[Sequence[FileVirtualSpan]],
+                   max_bytes: int = 1 << 24) -> List[FileVirtualSpan]:
+    """Host 0 passes its plan; other hosts pass None and receive it.
+
+    Uses a fixed-size uint8 buffer through broadcast_one_to_all (the payload
+    must have identical shape on all hosts).
+    """
+    if jax.process_count() == 1:
+        assert spans is not None
+        return list(spans)
+    from jax.experimental import multihost_utils
+
+    if jax.process_index() == 0:
+        payload = json.dumps([s.to_dict() for s in spans]).encode()
+        if len(payload) + 8 > max_bytes:
+            raise ValueError("plan too large for broadcast buffer")
+        buf = np.zeros(max_bytes, dtype=np.uint8)
+        buf[:8] = np.frombuffer(np.int64(len(payload)).tobytes(), np.uint8)
+        buf[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
+    else:
+        buf = np.zeros(max_bytes, dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    out = np.asarray(out)
+    n = int(np.frombuffer(out[:8].tobytes(), np.int64)[0])
+    plan = json.loads(out[8:8 + n].tobytes().decode())
+    return [FileVirtualSpan.from_dict(d) for d in plan]
+
+
+def assign_spans(spans: Sequence[FileVirtualSpan],
+                 index: Optional[int] = None,
+                 count: Optional[int] = None) -> List[FileVirtualSpan]:
+    """Contiguous per-host slice, balanced by compressed size."""
+    index = jax.process_index() if index is None else index
+    count = jax.process_count() if count is None else count
+    if count == 1:
+        return list(spans)
+    sizes = np.asarray([max(s.compressed_size, 1) for s in spans],
+                       dtype=np.float64)
+    cum = np.cumsum(sizes)
+    total = cum[-1]
+    lo, hi = total * index / count, total * (index + 1) / count
+    out = [s for s, c, z in zip(spans, cum, sizes)
+           if lo < c - z / 2 <= hi]  # midpoint rule: every span exactly once
+    return out
+
+
+def retry_span(decode_fn, span: FileVirtualSpan, attempts: int = 3):
+    """Span-level retry — the framework's failure-recovery unit."""
+    last: Exception
+    for _ in range(attempts):
+        try:
+            return decode_fn(span)
+        except Exception as e:  # noqa: BLE001 — deliberate blanket retry
+            last = e
+    raise last

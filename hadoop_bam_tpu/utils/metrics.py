@@ -1,0 +1,64 @@
+"""Stage metrics: counters + timers with a text dump.
+
+The reference exposed only Hadoop task counters and stderr warnings
+(SURVEY.md section 5); here every pipeline stage (plan/fetch/inflate/walk/
+device) ticks named counters and timers, dumpable as text — and
+``jax.profiler`` traces can be layered on via ``trace()``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.timers: Dict[str, float] = defaultdict(float)
+        self.timer_calls: Dict[str, int] = defaultdict(int)
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.timers[name] += dt
+                self.timer_calls[name] += 1
+
+    @contextlib.contextmanager
+    def trace(self, name: str) -> Iterator[None]:
+        """Timer + jax.profiler annotation (shows up in TPU traces)."""
+        import jax.profiler
+        with jax.profiler.TraceAnnotation(name), self.timer(name):
+            yield
+
+    def render(self) -> str:
+        lines = []
+        for k in sorted(self.counters):
+            lines.append(f"counter {k} = {self.counters[k]}")
+        for k in sorted(self.timers):
+            calls = self.timer_calls[k]
+            tot = self.timers[k]
+            lines.append(f"timer   {k} = {tot:.4f}s over {calls} calls "
+                         f"({tot / max(calls, 1) * 1e3:.2f} ms/call)")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.timers.clear()
+            self.timer_calls.clear()
+
+
+METRICS = Metrics()

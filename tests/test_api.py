@@ -1,0 +1,145 @@
+"""API layer tests: dispatch sniffing, datasets, writers, mergers."""
+import io
+import os
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.api.dataset import open_any_sam, open_bam, open_sam
+from hadoop_bam_tpu.api.dispatch import (
+    SAMContainer, VCFContainer, clear_sniff_caches, sniff_sam_container,
+    sniff_vcf_container,
+)
+from hadoop_bam_tpu.api.writers import (
+    BamShardWriter, SamShardWriter, write_records,
+)
+from hadoop_bam_tpu.config import HBamConfig
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bamio import read_bam
+from hadoop_bam_tpu.formats.sam import write_sam_text
+from hadoop_bam_tpu.utils.mergers import merge_bam_shards, merge_sam_shards
+
+from fixtures import make_header, make_records
+
+
+@pytest.fixture
+def files(tmp_path):
+    header = make_header()
+    records = make_records(header, 3000, seed=5)
+    bam = str(tmp_path / "a.bam")
+    sam = str(tmp_path / "a.sam")
+    write_records(bam, header, records)
+    with open(sam, "w") as f:
+        f.write(write_sam_text(header, records))
+    return header, records, bam, sam, tmp_path
+
+
+def test_sniff_sam(files):
+    header, records, bam, sam, tmp = files
+    clear_sniff_caches()
+    # extension-free copies force magic sniffing
+    bam2, sam2 = str(tmp / "noext_b"), str(tmp / "noext_s")
+    os.link(bam, bam2)
+    os.link(sam, sam2)
+    assert sniff_sam_container(bam) is SAMContainer.BAM
+    assert sniff_sam_container(sam) is SAMContainer.SAM
+    assert sniff_sam_container(bam2) is SAMContainer.BAM
+    assert sniff_sam_container(sam2) is SAMContainer.SAM
+    # trust_exts=False must sniff content even with extensions
+    cfg = HBamConfig(trust_exts=False)
+    clear_sniff_caches()
+    assert sniff_sam_container(bam, cfg) is SAMContainer.BAM
+    cram = str(tmp / "c.cram")
+    open(cram, "wb").write(b"CRAM\x03\x00" + b"\x00" * 30)
+    assert sniff_sam_container(cram) is SAMContainer.CRAM
+
+
+def test_sniff_vcf(tmp_path):
+    clear_sniff_caches()
+    vcf = str(tmp_path / "x.vcf")
+    open(vcf, "w").write("##fileformat=VCFv4.2\n#CHROM\tPOS\n")
+    vcfgz = str(tmp_path / "x.vcf.gz")
+    open(vcfgz, "wb").write(bgzf.compress_bytes(b"##fileformat=VCFv4.2\n"))
+    bcf = str(tmp_path / "x.bcf")
+    open(bcf, "wb").write(bgzf.compress_bytes(b"BCF\x02\x02" + b"\x00" * 10))
+    assert sniff_vcf_container(vcf) is VCFContainer.VCF
+    assert sniff_vcf_container(vcfgz) is VCFContainer.VCF_BGZF
+    assert sniff_vcf_container(bcf) is VCFContainer.BCF
+    # content sniffing without trusted extensions
+    cfg = HBamConfig(vcf_trust_exts=False)
+    clear_sniff_caches()
+    assert sniff_vcf_container(bcf, cfg) is VCFContainer.BCF
+    assert sniff_vcf_container(vcfgz, cfg) is VCFContainer.VCF_BGZF
+
+
+def test_bam_dataset_roundtrip(files):
+    header, records, bam, sam, tmp = files
+    ds = open_bam(bam)
+    assert ds.header.ref_names == header.ref_names
+    got = list(ds.records(num_spans=4))
+    assert got == records
+
+
+def test_dataset_checkpoint_resume(files):
+    header, records, bam, sam, tmp = files
+    ds = open_bam(bam)
+    it = ds.batches(num_spans=5)
+    consumed = [next(it), next(it)]
+    state = ds.state_dict()
+    assert state["next_span"] == 2
+    # resume into a fresh dataset: remaining batches continue exactly
+    ds2 = open_bam(bam)
+    ds2.load_state_dict(state)
+    names = []
+    for b in consumed + list(ds2.batches()):
+        names += [b.read_name(i) for i in range(len(b))]
+    assert names == [r.qname for r in records]
+
+
+def test_sam_dataset(files):
+    header, records, bam, sam, tmp = files
+    ds = open_sam(sam)
+    assert ds.header.ref_names == header.ref_names
+    got = list(ds.records(num_spans=3))
+    assert got == records
+    assert open_any_sam(sam).__class__.__name__ == "SamDataset"
+    assert open_any_sam(bam).__class__.__name__ == "BamDataset"
+
+
+def test_shard_merge_bam(files, tmp_path):
+    header, records, bam, sam, tmp = files
+    cfg = HBamConfig(write_header=False, write_terminator=False)
+    shards = []
+    k = 3
+    per = len(records) // k
+    for i in range(k):
+        p = str(tmp_path / f"part-{i:05d}")
+        with BamShardWriter(p, header, cfg) as w:
+            for r in records[i * per:(i + 1) * per if i < k - 1 else None]:
+                w.write_sam_record(r)
+        shards.append(p)
+    out = str(tmp_path / "merged.bam")
+    merge_bam_shards(shards, out, header)
+    hdr, batch = read_bam(out)
+    assert len(batch) == len(records)
+    assert [batch.read_name(i) for i in range(len(batch))] == \
+        [r.qname for r in records]
+    # merged file ends with the EOF terminator [SPEC]
+    assert open(out, "rb").read().endswith(bgzf.EOF_BLOCK)
+
+
+def test_shard_merge_sam(files, tmp_path):
+    header, records, bam, sam, tmp = files
+    shards = []
+    for i in range(2):
+        p = str(tmp_path / f"s-part-{i:05d}")
+        with SamShardWriter(p, header, write_header=False) as w:
+            for r in records[i * 1500:(i + 1) * 1500]:
+                w.write_sam_record(r)
+        shards.append(p)
+    out = str(tmp_path / "merged.sam")
+    merge_sam_shards(shards, out, header)
+    from hadoop_bam_tpu.formats.sam import read_sam_text
+    hdr, got = read_sam_text(open(out).read())
+    assert got == records
+    assert hdr.ref_names == header.ref_names

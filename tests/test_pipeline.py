@@ -1,0 +1,86 @@
+"""Mesh pipeline tests on the virtual 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.formats.bamio import BamWriter
+from hadoop_bam_tpu.parallel.distributed import assign_spans, broadcast_plan
+from hadoop_bam_tpu.parallel.mesh import make_mesh
+from hadoop_bam_tpu.parallel.pipeline import (
+    DecodeGeometry, decode_span_host, flagstat_file, iter_span_groups,
+    make_unpack_step, stack_span_group,
+)
+from hadoop_bam_tpu.split.planners import plan_bam_spans
+
+from fixtures import make_header, make_records
+
+
+@pytest.fixture(scope="module")
+def bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("pipe") / "p.bam")
+    header = make_header()
+    records = make_records(header, 5000, seed=11)
+    with BamWriter(path, header, track_voffsets=True) as w:
+        for r in records:
+            w.write_sam_record(r)
+        voffs = list(w.record_voffsets())
+    return path, header, records, voffs
+
+
+GEOM = DecodeGeometry(bytes_cap=1 << 21, records_cap=1 << 14)
+
+
+def test_decode_span_host_union(bam):
+    """Union-exactly-once for the pipeline's own span decoder."""
+    path, header, records, voffs = bam
+    spans = plan_bam_spans(path, num_spans=7, header=header)
+    got = []
+    for s in spans:
+        d, o, n, v = decode_span_host(path, s, GEOM)
+        got.extend(int(x) for x in v)
+        assert n == v.size
+    assert got == voffs
+
+
+def test_mesh_has_8_devices():
+    mesh = make_mesh()
+    assert int(np.prod(mesh.devices.shape)) == 8
+
+
+def test_flagstat_file_on_mesh(bam):
+    path, header, records, voffs = bam
+    mesh = make_mesh()
+    stats = flagstat_file(path, mesh=mesh, geometry=GEOM, header=header)
+    flags = np.asarray([r.flag for r in records])
+    assert stats["total"] == len(records)
+    assert stats["mapped"] == int(np.sum((flags & 0x4) == 0))
+    assert stats["paired"] == int(np.sum((flags & 0x1) != 0))
+    assert stats["secondary"] == int(np.sum((flags & 0x100) != 0))
+
+
+def test_unpack_step_sharded(bam):
+    path, header, records, voffs = bam
+    mesh = make_mesh()
+    spans = plan_bam_spans(path, num_spans=8, header=header)
+    group = list(iter_span_groups(spans, 8))[0]
+    batch = stack_span_group(path, group, 8, GEOM)
+    step = make_unpack_step(mesh)
+    cols = step(batch.data, batch.offsets, batch.n_records)
+    assert cols["pos"].shape == (8, GEOM.records_cap)
+    # device 0's first records match host decode of span 0
+    d, o, n, v = decode_span_host(path, group[0], GEOM)
+    from hadoop_bam_tpu.formats.bam import BamBatch
+    hb = BamBatch(d, o[:n].astype(np.int64))
+    np.testing.assert_array_equal(np.asarray(cols["pos"])[0, :n], hb.pos)
+    valid = np.asarray(cols["valid"])
+    assert valid[0, :n].all() and not valid[0, n:].any()
+
+
+def test_broadcast_and_assign(bam):
+    path, header, *_ = bam
+    spans = plan_bam_spans(path, num_spans=6, header=header)
+    assert broadcast_plan(spans) == spans
+    # partition over 3 fake hosts: disjoint cover
+    parts = [assign_spans(spans, index=i, count=3) for i in range(3)]
+    flat = [s for p in parts for s in p]
+    assert sorted(flat, key=lambda s: s.start_voffset) == spans
+    assert all(len(p) >= 1 for p in parts)
