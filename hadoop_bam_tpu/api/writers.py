@@ -21,7 +21,9 @@ from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.api.dispatch import SAMContainer
 from hadoop_bam_tpu.formats.bam import SAMHeader
 from hadoop_bam_tpu.formats.bamio import BamWriter
+from hadoop_bam_tpu.formats.bcfio import BcfWriter
 from hadoop_bam_tpu.formats.sam import SamRecord
+from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
 
 
 class BamShardWriter(BamWriter):
@@ -78,6 +80,73 @@ def open_any_sam_writer(path: str, header: SAMHeader,
     if container is SAMContainer.SAM:
         return SamShardWriter(path, header, config)
     raise NotImplementedError(f"writer for {container} (CRAM write: later round)")
+
+
+class VcfShardWriter:
+    """Text VCF shard writer, optionally BGZF-compressed
+    (hb/KeyIgnoringVCFRecordWriter.java)."""
+
+    def __init__(self, sink, header: "VCFHeader",
+                 config: HBamConfig = DEFAULT_CONFIG,
+                 write_header: Optional[bool] = None,
+                 compress: bool = False, level: int = 6):
+        from hadoop_bam_tpu.formats import bgzf
+        self._own = False
+        if isinstance(sink, (str, os.PathLike)):
+            sink = open(sink, "wb")
+            self._own = True
+        self._raw_sink = sink
+        if compress:
+            self._bgzf = bgzf.BGZFWriter(sink, level=level,
+                                         write_eof=config.write_terminator)
+        else:
+            self._bgzf = None
+        self.header = header
+        self.records_written = 0
+        if config.write_header if write_header is None else write_header:
+            self._write(header.to_text().encode())
+
+    def _write(self, data: bytes) -> None:
+        (self._bgzf or self._raw_sink).write(data)
+
+    def write_record(self, rec: "VcfRecord") -> None:
+        self._write((rec.to_line() + "\n").encode())
+        self.records_written += 1
+
+    def close(self) -> None:
+        if self._bgzf is not None:
+            self._bgzf.close()
+        if self._own:
+            self._raw_sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class BcfShardWriter(BcfWriter):
+    """BCF shard writer with reference OutputFormat knobs from config
+    (hb/BCFRecordWriter)."""
+
+    def __init__(self, sink, header: "VCFHeader",
+                 config: HBamConfig = DEFAULT_CONFIG, **kw):
+        kw.setdefault("write_header", config.write_header)
+        kw.setdefault("write_eof", config.write_terminator)
+        super().__init__(sink, header, **kw)
+
+
+def open_vcf_writer(path: str, header: "VCFHeader",
+                    config: HBamConfig = DEFAULT_CONFIG):
+    """hb/VCFOutputFormat: pick VCF vs BCF per extension, falling back to the
+    ``vcf_output_format`` config knob (``hadoopbam.vcf.output-format``)."""
+    lower = path.lower()
+    if lower.endswith(".bcf") or (not lower.endswith((".vcf", ".vcf.gz"))
+                                  and config.vcf_output_format.upper() == "BCF"):
+        return BcfShardWriter(path, header, config)
+    return VcfShardWriter(path, header, config,
+                          compress=lower.endswith((".vcf.gz", ".vcf.bgz")))
 
 
 def write_records(path: str, header: SAMHeader,

@@ -1,0 +1,530 @@
+"""BCF2 binary codec: header, typed values, record encode/decode.
+
+Reference equivalents: htsjdk ``BCF2Codec`` / ``BCF2Encoder`` as consumed by
+hb/BCFRecordReader.java and hb/BCFSplitGuesser.java (SURVEY.md section 2.3),
+plus hb/util/VariantContextCodec.java which reuses this wire format for
+shuffle serialization.
+
+[SPEC] BCF2.2 (hts-specs VCFv4.x section 6):
+
+- file = BGZF-compressed (or raw) stream: magic ``BCF\\2\\2``, header block
+  (l_text u32 + VCF header text, NUL-terminated), then records.
+- record = l_shared u32, l_indiv u32, then the shared block
+  (CHROM i32, POS i32 0-based, rlen i32, QUAL f32, n_info u16, n_allele u16,
+  n_sample u24 | n_fmt<<24, ID, alleles, FILTER, INFO key/value pairs)
+  and the per-sample block (n_fmt × (FORMAT key, per-sample vectors)).
+- typed values: one descriptor byte ``(count << 4) | type``; count 15 means
+  the real count follows as a typed scalar int.  Types: 1=int8, 2=int16,
+  3=int32, 5=float32, 7=char, 0=MISSING (no payload — used for Flag).
+- sentinel values: int8 0x80 missing / 0x81 end-of-vector (and the int16/
+  int32/float equivalents); string dictionary + contig dictionary derived
+  from the header (formats/vcf.py ``VCFHeader.string_dictionary``).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from hadoop_bam_tpu.formats.vcf import (
+    MISSING, VCFError, VCFHeader, VcfRecord,
+)
+
+BCF_MAGIC = b"BCF\x02\x02"
+BCF_MAGIC_21 = b"BCF\x02\x01"
+
+# typed-value type codes [SPEC]
+T_MISSING, T_INT8, T_INT16, T_INT32, T_FLOAT, T_CHAR = 0, 1, 2, 3, 5, 7
+
+INT8_MISSING, INT8_EOV = -128, -127
+INT16_MISSING, INT16_EOV = -32768, -32767
+INT32_MISSING, INT32_EOV = -2147483648, -2147483647
+FLOAT_MISSING_BITS, FLOAT_EOV_BITS = 0x7F800001, 0x7F800002
+
+# NB: the sentinels are NaNs with a specific payload; they must be written as
+# raw bits (a float64 round-trip would quiet the NaN and corrupt the payload).
+FLOAT_MISSING_BYTES = struct.pack("<I", FLOAT_MISSING_BITS)
+FLOAT_EOV_BYTES = struct.pack("<I", FLOAT_EOV_BITS)
+
+
+class BCFError(VCFError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# typed-value primitives
+# ---------------------------------------------------------------------------
+
+def _descriptor(count: int, typ: int) -> bytes:
+    if count < 15:
+        return bytes([(count << 4) | typ])
+    return bytes([(15 << 4) | typ]) + encode_typed_ints([count])
+
+
+def _int_type_for(values: Sequence[int]) -> int:
+    """Smallest int type whose non-reserved domain holds every value.
+    [SPEC] reserves the bottom 8 values of each width for sentinels."""
+    lo = min(values, default=0)
+    hi = max(values, default=0)
+    if lo >= -120 and hi <= 127:
+        return T_INT8
+    if lo >= -32760 and hi <= 32767:
+        return T_INT16
+    return T_INT32
+
+
+_INT_FMT = {T_INT8: "b", T_INT16: "<h", T_INT32: "<i"}
+_INT_MISSING = {T_INT8: INT8_MISSING, T_INT16: INT16_MISSING,
+                T_INT32: INT32_MISSING}
+_INT_EOV = {T_INT8: INT8_EOV, T_INT16: INT16_EOV, T_INT32: INT32_EOV}
+_INT_SIZE = {T_INT8: 1, T_INT16: 2, T_INT32: 4}
+
+
+def encode_typed_ints(values: Sequence[Optional[int]],
+                      pad_to: Optional[int] = None) -> bytes:
+    """Typed int vector; None encodes MISSING; padding uses END_OF_VECTOR."""
+    concrete = [v for v in values if v is not None]
+    typ = _int_type_for(concrete)
+    n = len(values) if pad_to is None else pad_to
+    out = bytearray(_descriptor(n, typ))
+    fmt, miss, eov = _INT_FMT[typ], _INT_MISSING[typ], _INT_EOV[typ]
+    for v in values:
+        out += struct.pack(fmt, miss if v is None else v)
+    for _ in range(n - len(values)):
+        out += struct.pack(fmt, eov)
+    return bytes(out)
+
+
+def encode_typed_floats(values: Sequence[Optional[float]],
+                        pad_to: Optional[int] = None) -> bytes:
+    n = len(values) if pad_to is None else pad_to
+    out = bytearray(_descriptor(n, T_FLOAT))
+    for v in values:
+        out += FLOAT_MISSING_BYTES if v is None else struct.pack("<f", v)
+    for _ in range(n - len(values)):
+        out += FLOAT_EOV_BYTES
+    return bytes(out)
+
+
+def encode_typed_string(s: Optional[str], pad_to: Optional[int] = None) -> bytes:
+    data = b"" if s is None else s.encode()
+    if s is None:
+        data = b"."
+    n = len(data) if pad_to is None else pad_to
+    return _descriptor(n, T_CHAR) + data + b"\x00" * (n - len(data))
+
+
+def encode_typed_int_scalar(v: int) -> bytes:
+    return encode_typed_ints([v])
+
+
+def read_typed(buf: bytes, off: int) -> Tuple[int, List, int]:
+    """Read one typed value: returns (type, values list, new offset).
+    Chars come back as one Python str; sentinels as None (missing) with
+    EOV padding stripped."""
+    desc = buf[off]
+    off += 1
+    count, typ = desc >> 4, desc & 0x0F
+    if count == 15:
+        _, cv, off = read_typed(buf, off)
+        count = int(cv[0])
+    if typ == T_MISSING:
+        return typ, [], off
+    if typ == T_CHAR:
+        raw = buf[off:off + count]
+        off += count
+        return typ, [raw.rstrip(b"\x00").decode()], off
+    if typ == T_FLOAT:
+        vals: List = []
+        for i in range(count):
+            bits = struct.unpack_from("<I", buf, off + 4 * i)[0]
+            if bits == FLOAT_EOV_BITS:
+                vals.append(Ellipsis)
+            elif bits == FLOAT_MISSING_BITS:
+                vals.append(None)
+            else:
+                vals.append(struct.unpack_from("<f", buf, off + 4 * i)[0])
+        off += 4 * count
+        while vals and vals[-1] is Ellipsis:
+            vals.pop()
+        vals = [None if v is Ellipsis else v for v in vals]
+        return typ, vals, off
+    if typ in _INT_FMT:
+        fmt, size = _INT_FMT[typ], _INT_SIZE[typ]
+        miss, eov = _INT_MISSING[typ], _INT_EOV[typ]
+        vals = []
+        for i in range(count):
+            v = struct.unpack_from(fmt, buf, off + size * i)[0]
+            vals.append(Ellipsis if v == eov else (None if v == miss else v))
+        off += size * count
+        while vals and vals[-1] is Ellipsis:
+            vals.pop()
+        vals = [None if v is Ellipsis else v for v in vals]
+        return typ, vals, off
+    raise BCFError(f"unknown typed-value type {typ}")
+
+
+# ---------------------------------------------------------------------------
+# header block
+# ---------------------------------------------------------------------------
+
+def encode_header(header: VCFHeader) -> bytes:
+    text = header.to_text().encode() + b"\x00"
+    return BCF_MAGIC + struct.pack("<I", len(text)) + text
+
+
+def decode_header(buf: bytes, off: int = 0) -> Tuple[VCFHeader, int]:
+    magic = buf[off:off + 5]
+    if magic not in (BCF_MAGIC, BCF_MAGIC_21):
+        raise BCFError(f"bad BCF magic {magic!r}")
+    l_text = struct.unpack_from("<I", buf, off + 5)[0]
+    start = off + 9
+    text = bytes(buf[start:start + l_text]).rstrip(b"\x00").decode()
+    return VCFHeader.from_text(text), start + l_text
+
+
+# ---------------------------------------------------------------------------
+# per-field typing from the header
+# ---------------------------------------------------------------------------
+
+def _field_type(header: VCFHeader, table: str, key: str) -> str:
+    defs = header.infos if table == "INFO" else header.formats
+    line = defs.get(key)
+    if line is not None and line.type:
+        return line.type
+    return "String"
+
+
+def _parse_values(raw: Union[str, bool], vtype: str
+                  ) -> Tuple[int, List]:
+    """Split a raw VCF value string into typed values per header Type."""
+    if raw is True or vtype == "Flag":
+        return T_MISSING, []
+    items = str(raw).split(",")
+    if vtype == "Integer":
+        vals = [None if x == MISSING else int(x) for x in items]
+        return T_INT32, vals
+    if vtype == "Float":
+        vals = [None if x == MISSING else float(x) for x in items]
+        return T_FLOAT, vals
+    return T_CHAR, [str(raw)]
+
+
+def _format_values(typ: int, vals: List, vtype: str) -> Union[str, bool]:
+    if typ == T_MISSING:
+        return True
+    if typ == T_CHAR:
+        return vals[0] if vals else MISSING
+    parts = []
+    for v in vals:
+        if v is None:
+            parts.append(MISSING)
+        elif typ == T_FLOAT:
+            parts.append(_fmt_float(v))
+        else:
+            parts.append(str(int(v)))
+    return ",".join(parts)
+
+
+def _fmt_float(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    # shortest text that round-trips the float32 the wire format stores
+    return np.format_float_positional(np.float32(v), unique=True, trim="0")
+
+
+# ---------------------------------------------------------------------------
+# genotype (GT) packing
+# ---------------------------------------------------------------------------
+
+def _encode_gt(gt: str) -> List[Optional[int]]:
+    """'0/1' -> [2, 4]; '.' -> [0]; phased '0|1' -> [2, 5] [SPEC]:
+    allele value = (index + 1) << 1, bit 0 = phased-with-previous."""
+    out: List[Optional[int]] = []
+    tok = ""
+    phased_next = False
+    for ch in gt + "/":
+        if ch in "/|":
+            if tok == MISSING or tok == "":
+                val = 0
+            else:
+                val = (int(tok) + 1) << 1
+            if phased_next:
+                val |= 1
+            out.append(val)
+            phased_next = ch == "|"
+            tok = ""
+        else:
+            tok += ch
+    return out
+
+
+def _decode_gt(vals: List[Optional[int]]) -> str:
+    parts: List[str] = []
+    seps: List[str] = []
+    for i, v in enumerate(vals):
+        if v is None:
+            continue  # EOV padding for mixed ploidy
+        allele = (int(v) >> 1) - 1
+        parts.append(MISSING if allele < 0 else str(allele))
+        if i > 0:
+            seps.append("|" if int(v) & 1 else "/")
+    if not parts:
+        return MISSING
+    out = parts[0]
+    for sep, p in zip(seps, parts[1:]):
+        out += sep + p
+    return out
+
+
+# ---------------------------------------------------------------------------
+# record encode
+# ---------------------------------------------------------------------------
+
+class BCFRecordCodec:
+    """Encode/decode VcfRecord <-> BCF2 record bytes against one header."""
+
+    def __init__(self, header: VCFHeader):
+        self.header = header
+        self.strings = header.string_dictionary()
+        self.string_idx = {s: i for i, s in enumerate(self.strings) if s}
+
+    def _sidx(self, key: str) -> int:
+        idx = self.string_idx.get(key)
+        if idx is None:
+            raise BCFError(f"{key!r} not in header dictionary — add a "
+                           f"##INFO/##FORMAT/##FILTER line for it")
+        return idx
+
+    def encode(self, rec: VcfRecord) -> bytes:
+        h = self.header
+        chrom_idx = h.contig_index(rec.chrom)
+        if chrom_idx < 0:
+            raise BCFError(f"contig {rec.chrom!r} not in header "
+                           f"(##contig lines are mandatory for BCF)")
+        shared = bytearray()
+        shared += struct.pack("<iii", chrom_idx, rec.pos - 1, rec.rlen)
+        shared += (FLOAT_MISSING_BYTES if rec.qual is None
+                   else struct.pack("<f", rec.qual))
+        n_fmt = len(rec.fmt)
+        n_sample = len(rec.genotypes)
+        shared += struct.pack("<HH", len(rec.info), rec.n_allele)
+        shared += struct.pack("<I", (n_sample & 0xFFFFFF) | (n_fmt << 24))
+        shared += encode_typed_string(rec.id)
+        shared += encode_typed_string(rec.ref)
+        for alt in rec.alts:
+            shared += encode_typed_string(alt)
+        if rec.filters is None:
+            shared += encode_typed_ints([])
+        else:
+            shared += encode_typed_ints([self._sidx(f) if f != "PASS" else 0
+                                         for f in rec.filters])
+        for key, raw in rec.info.items():
+            shared += encode_typed_int_scalar(self._sidx(key))
+            typ, vals = _parse_values(raw, _field_type(h, "INFO", key))
+            shared += self._encode_vals(typ, vals)
+
+        indiv = bytearray()
+        if n_fmt:
+            per_sample = [g.split(":") for g in rec.genotypes]
+            for fi, key in enumerate(rec.fmt):
+                indiv += encode_typed_int_scalar(self._sidx(key))
+                vtype = _field_type(h, "FORMAT", key)
+                if key == "GT":
+                    vecs = [_encode_gt(s[fi] if fi < len(s) else MISSING)
+                            for s in per_sample]
+                    width = max((len(v) for v in vecs), default=1)
+                    flat: List[Optional[int]] = []
+                    ints: List[int] = []
+                    for v in vecs:
+                        ints += [x for x in v if x is not None]
+                    typ = _int_type_for(ints)
+                    fmtc, eov = _INT_FMT[typ], _INT_EOV[typ]
+                    indiv += _descriptor(width, typ)
+                    for v in vecs:
+                        for x in v:
+                            indiv += struct.pack(fmtc, x)
+                        for _ in range(width - len(v)):
+                            indiv += struct.pack(fmtc, eov)
+                else:
+                    raws = [s[fi] if fi < len(s) else MISSING
+                            for s in per_sample]
+                    indiv += self._encode_sample_matrix(raws, vtype)
+        return (struct.pack("<II", len(shared), len(indiv))
+                + bytes(shared) + bytes(indiv))
+
+    def _encode_vals(self, typ: int, vals: List) -> bytes:
+        if typ == T_MISSING:
+            return bytes([T_MISSING])
+        if typ == T_CHAR:
+            return encode_typed_string(vals[0] if vals else None)
+        if typ == T_FLOAT:
+            return encode_typed_floats(vals)
+        return encode_typed_ints(vals)
+
+    def _encode_sample_matrix(self, raws: List[str], vtype: str) -> bytes:
+        """FORMAT field across samples: one shared descriptor, fixed width,
+        short vectors padded with EOV (ints/floats) or NULs (chars)."""
+        if vtype == "Integer":
+            vecs = [[None if x == MISSING else int(x)
+                     for x in (r.split(",") if r != MISSING else [MISSING])]
+                    for r in raws]
+            width = max((len(v) for v in vecs), default=1)
+            ints = [x for v in vecs for x in v if x is not None]
+            typ = _int_type_for(ints)
+            fmtc, miss, eov = _INT_FMT[typ], _INT_MISSING[typ], _INT_EOV[typ]
+            out = bytearray(_descriptor(width, typ))
+            for v in vecs:
+                for x in v:
+                    out += struct.pack(fmtc, miss if x is None else x)
+                for _ in range(width - len(v)):
+                    out += struct.pack(fmtc, eov)
+            return bytes(out)
+        if vtype == "Float":
+            vecs = [[None if x == MISSING else float(x)
+                     for x in (r.split(",") if r != MISSING else [MISSING])]
+                    for r in raws]
+            width = max((len(v) for v in vecs), default=1)
+            out = bytearray(_descriptor(width, T_FLOAT))
+            for v in vecs:
+                for x in v:
+                    out += (FLOAT_MISSING_BYTES if x is None
+                        else struct.pack("<f", x))
+                for _ in range(width - len(v)):
+                    out += FLOAT_EOV_BYTES
+            return bytes(out)
+        # Character/String: fixed-width char matrix, NUL-padded
+        datas = [r.encode() for r in raws]
+        width = max((len(d) for d in datas), default=1)
+        out = bytearray(_descriptor(width, T_CHAR))
+        for d in datas:
+            out += d + b"\x00" * (width - len(d))
+        return bytes(out)
+
+    # -- decode --------------------------------------------------------------
+    def decode(self, buf: bytes, off: int = 0) -> Tuple[VcfRecord, int]:
+        l_shared, l_indiv = struct.unpack_from("<II", buf, off)
+        base = off + 8
+        end_shared = base + l_shared
+        end = end_shared + l_indiv
+        if end > len(buf):
+            raise BCFError("truncated BCF record")
+        chrom_idx, pos0, rlen = struct.unpack_from("<iii", buf, base)
+        qual_bits = struct.unpack_from("<I", buf, base + 12)[0]
+        qual = struct.unpack_from("<f", buf, base + 12)[0]
+        n_info, n_allele = struct.unpack_from("<HH", buf, base + 16)
+        ns_nf = struct.unpack_from("<I", buf, base + 20)[0]
+        n_sample, n_fmt = ns_nf & 0xFFFFFF, ns_nf >> 24
+        p = base + 24
+        _, idv, p = read_typed(buf, p)
+        rid = idv[0] if idv else None
+        alleles: List[str] = []
+        for _ in range(n_allele):
+            _, av, p = read_typed(buf, p)
+            alleles.append(av[0] if av else "")
+        _, fv, p = read_typed(buf, p)
+        filters: Optional[Tuple[str, ...]]
+        if not fv:
+            filters = None
+        else:
+            filters = tuple(self.strings[int(i)] if int(i) else "PASS"
+                            for i in fv)
+        info: Dict[str, Union[str, bool]] = {}
+        for _ in range(n_info):
+            _, kv, p = read_typed(buf, p)
+            key = self.strings[int(kv[0])]
+            typ, vals, p = read_typed(buf, p)
+            info[key] = _format_values(typ, vals,
+                                       _field_type(self.header, "INFO", key))
+        if p != end_shared:
+            p = end_shared  # tolerate writer padding
+        fmt_keys: List[str] = []
+        sample_fields: List[List[str]] = [[] for _ in range(n_sample)]
+        while p < end and len(fmt_keys) < n_fmt:
+            _, kv, p = read_typed(buf, p)
+            key = self.strings[int(kv[0])]
+            fmt_keys.append(key)
+            desc = buf[p]
+            count, typ = desc >> 4, desc & 0x0F
+            p += 1
+            if count == 15:
+                _, cv, p = read_typed(buf, p)
+                count = int(cv[0])
+            vtype = _field_type(self.header, "FORMAT", key)
+            for s in range(n_sample):
+                if typ == T_CHAR:
+                    raw = buf[p:p + count]
+                    p += count
+                    sample_fields[s].append(
+                        raw.rstrip(b"\x00").decode() or MISSING)
+                else:
+                    fmtc = _INT_FMT.get(typ)
+                    size = _INT_SIZE.get(typ, 4)
+                    vals: List = []
+                    for i in range(count):
+                        if typ == T_FLOAT:
+                            bits = struct.unpack_from("<I", buf, p)[0]
+                            if bits == FLOAT_EOV_BITS:
+                                v: object = Ellipsis
+                            elif bits == FLOAT_MISSING_BITS:
+                                v = None
+                            else:
+                                v = struct.unpack_from("<f", buf, p)[0]
+                        else:
+                            iv = struct.unpack_from(fmtc, buf, p)[0]
+                            v = (Ellipsis if iv == _INT_EOV[typ]
+                                 else None if iv == _INT_MISSING[typ] else iv)
+                        vals.append(v)
+                        p += size
+                    while vals and vals[-1] is Ellipsis:
+                        vals.pop()
+                    vals = [None if v is Ellipsis else v for v in vals]
+                    if key == "GT":
+                        sample_fields[s].append(_decode_gt(vals))
+                    else:
+                        sample_fields[s].append(
+                            str(_format_values(typ, vals, vtype)))
+        rec = VcfRecord(
+            chrom=(self.header.contigs[chrom_idx]
+                   if 0 <= chrom_idx < len(self.header.contigs)
+                   else str(chrom_idx)),
+            pos=pos0 + 1,
+            id=rid,
+            ref=alleles[0] if alleles else "N",
+            alts=tuple(alleles[1:]),
+            qual=None if qual_bits == FLOAT_MISSING_BITS else float(qual),
+            filters=filters, info=info,
+            fmt=tuple(fmt_keys),
+            genotypes=[":".join(f) for f in sample_fields],
+        )
+        return rec, end
+
+
+def peek_record_sizes(buf: bytes, off: int) -> Tuple[int, int]:
+    l_shared, l_indiv = struct.unpack_from("<II", buf, off)
+    return l_shared, l_indiv
+
+
+def plausible_record_start(buf: bytes, off: int, n_contigs: int,
+                           max_len: int = 1 << 24) -> bool:
+    """Cheap plausibility check for a candidate BCF record start — the
+    validation core of hb/BCFSplitGuesser.java: sane block lengths, CHROM
+    within the contig dictionary, non-negative 0-based POS (or -1 for
+    telomere), sane counts."""
+    if off + 32 > len(buf):
+        return False
+    l_shared, l_indiv = struct.unpack_from("<II", buf, off)
+    if l_shared < 24 or l_shared > max_len or l_indiv > max_len:
+        return False
+    chrom_idx, pos0, rlen = struct.unpack_from("<iii", buf, off + 8)
+    if not (0 <= chrom_idx < max(n_contigs, 1)):
+        return False
+    if pos0 < -1 or rlen < 0:
+        return False
+    n_info, n_allele = struct.unpack_from("<HH", buf, off + 24)
+    if n_allele == 0 and n_info == 0 and l_shared == 24:
+        return True
+    if n_allele > 1024:
+        return False
+    return True

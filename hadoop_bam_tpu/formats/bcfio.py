@@ -1,0 +1,173 @@
+"""Whole-file BCF read/write helpers (BGZF-wrapped or raw streams).
+
+Host-side single-stream paths for BCF, mirroring formats/bamio.py: fixture
+generation, golden tests, the CLI, and writers.  The scaled path (BCF span
+planning + guesser) lives in split/.
+
+Reference equivalents: htsjdk BCF2 reader/writer plumbing as used by
+hb/BCFRecordReader.java and hb/BCFRecordWriter (SURVEY.md section 2.3/2.4).
+BCF files come in two containers [SPEC]: BGZF-compressed (the default,
+extension .bcf) and raw/uncompressed streams; both start with the
+``BCF\\2\\2`` magic in the *inflated* byte stream.
+"""
+from __future__ import annotations
+
+import io
+import struct
+from typing import Iterable, Iterator, List, Optional, Tuple, Union
+
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bcf import (
+    BCFRecordCodec, decode_header, encode_header,
+)
+from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+from hadoop_bam_tpu.formats.virtual_offset import make_voffset
+from hadoop_bam_tpu.utils.seekable import as_byte_source
+
+
+def is_bgzf_bcf(head: bytes) -> bool:
+    return bgzf.is_bgzf(head)
+
+
+class BcfWriter:
+    """Streaming BCF writer (BGZF by default, raw with ``compress=False``).
+
+    hb/BCFRecordWriter semantics: header emission and the BGZF EOF terminator
+    are optional so headerless shards can be concatenated by the merger
+    (hb/util/VCFFileMerger.java)."""
+
+    def __init__(self, sink, header: VCFHeader, *, write_header: bool = True,
+                 write_eof: bool = True, compress: bool = True,
+                 level: int = 6, track_voffsets: bool = False):
+        self._own = False
+        if isinstance(sink, (str, bytes)):
+            sink = open(sink, "wb")
+            self._own = True
+        self._sink = sink
+        self.header = header
+        self.codec = BCFRecordCodec(header)
+        self._compress = compress
+        self._voffsets: List[int] = []
+        self._track = track_voffsets
+        self.records_written = 0
+        if compress:
+            self._w = bgzf.BGZFWriter(sink, level=level, write_eof=write_eof)
+        else:
+            self._w = None
+            self._raw_pos = 0
+        if write_header:
+            self._write_bytes(encode_header(header))
+
+    def _write_bytes(self, data: bytes) -> None:
+        if self._w is not None:
+            self._w.write(data)
+        else:
+            self._sink.write(data)
+            self._raw_pos += len(data)
+
+    def tell_voffset(self) -> int:
+        if self._w is not None:
+            return self._w.tell_voffset()
+        return self._raw_pos << 16
+
+    def write_record(self, rec: VcfRecord) -> int:
+        v = self.tell_voffset()
+        if self._track:
+            self._voffsets.append(v)
+        self._write_bytes(self.codec.encode(rec))
+        self.records_written += 1
+        return v
+
+    def record_voffsets(self) -> List[int]:
+        return self._voffsets
+
+    def close(self) -> None:
+        if self._w is not None:
+            self._w.close()
+        if self._own:
+            self._sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_bcf(path_or_sink, header: VCFHeader,
+              records: Iterable[VcfRecord], **kw) -> None:
+    with BcfWriter(path_or_sink, header, **kw) as w:
+        for r in records:
+            w.write_record(r)
+
+
+def read_bcf_header(source) -> Tuple[VCFHeader, int, bool]:
+    """Read the header of a BCF file (either container).
+
+    Returns (header, first-record virtual offset, is_bgzf) — the BCF
+    equivalent of hb/util/VCFHeaderReader.java.  For raw streams the
+    "virtual offset" is ``byte_offset << 16`` (uoffset always 0)."""
+    src = as_byte_source(source)
+    head = src.pread(0, bgzf.MAX_BLOCK_SIZE)
+    if bgzf.is_bgzf(head):
+        r = bgzf.BGZFReader(src)
+        size = 1 << 16
+        while True:
+            r.seek_voffset(0)
+            buf = r.read(size)
+            try:
+                header, after = decode_header(buf, 0)
+                break
+            except Exception:
+                if len(buf) < size:
+                    raise
+                size *= 4
+        # plain inflated offset -> virtual offset (walk the blocks)
+        coff, remaining = 0, after
+        while True:
+            bh = src.pread(coff, bgzf.MAX_BLOCK_SIZE)
+            info = bgzf.parse_block_header(bh, 0)
+            if remaining < info.isize or (remaining == info.isize
+                                          and info.isize > 0):
+                if remaining == info.isize:
+                    return header, make_voffset(coff + info.block_size, 0), True
+                return header, make_voffset(coff, remaining), True
+            remaining -= info.isize
+            coff += info.block_size
+    else:
+        buf = head
+        off = 0
+        while True:
+            try:
+                header, after = decode_header(buf, 0)
+                return header, after << 16, False
+            except Exception:
+                more = src.pread(len(buf), 1 << 20)
+                if not more:
+                    raise
+                buf += more
+
+
+def read_bcf(source) -> Tuple[VCFHeader, List[VcfRecord]]:
+    """Decode a whole BCF file into (header, records)."""
+    src = as_byte_source(source)
+    head = src.pread(0, bgzf.MAX_BLOCK_SIZE)
+    if bgzf.is_bgzf(head):
+        data = bgzf.BGZFReader(src).read_all_from(0)
+    else:
+        chunks = []
+        off = 0
+        while True:
+            got = src.pread(off, 1 << 22)
+            if not got:
+                break
+            chunks.append(got)
+            off += len(got)
+        data = b"".join(chunks)
+    header, off = decode_header(data, 0)
+    codec = BCFRecordCodec(header)
+    records: List[VcfRecord] = []
+    while off < len(data):
+        rec, off = codec.decode(data, off)
+        records.append(rec)
+    return header, records

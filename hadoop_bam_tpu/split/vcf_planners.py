@@ -1,0 +1,215 @@
+"""VCF/BCF span planning + span readers: the getSplits layer for variants.
+
+Rebuild of hb/VCFInputFormat.java's split behavior (SURVEY.md section 3.4):
+
+- text ``.vcf``: plain byte splits, line-aligned at read time (LineRecordReader
+  semantics — split/planners.read_text_span).
+- ``.vcf.gz`` (BGZF): splittable via BGZF block alignment — the
+  hb/util/BGZFCodec.java [VER? 7.8] + LineRecordReader path.  Spans are
+  *compressed* byte ranges snapped to confirmed BGZF block starts; ownership
+  of a line that starts exactly on a block boundary is resolved by probing the
+  previous block's final byte, so the union of all spans yields each line
+  exactly once at every possible boundary.
+- ``.bcf`` (BGZF or raw): record-aligned virtual-offset spans via
+  hb/BCFSplitGuesser (split/bcf_guesser.py).
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bcf import BCFRecordCodec
+from hadoop_bam_tpu.formats.bcfio import read_bcf_header
+from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+from hadoop_bam_tpu.split.bcf_guesser import BCFSplitGuesser
+from hadoop_bam_tpu.split.bgzf_guesser import BGZFSplitGuesser
+from hadoop_bam_tpu.split.planners import plan_byte_ranges
+from hadoop_bam_tpu.split.spans import FileByteSpan, FileVirtualSpan
+from hadoop_bam_tpu.utils.seekable import as_byte_source
+
+
+# ---------------------------------------------------------------------------
+# BGZF-compressed text (.vcf.gz): block-aligned spans
+# ---------------------------------------------------------------------------
+
+def plan_bgzf_text_spans(path: str, *, num_spans: Optional[int] = None,
+                         span_bytes: Optional[int] = None,
+                         config: HBamConfig = DEFAULT_CONFIG
+                         ) -> List[FileByteSpan]:
+    """Compressed byte ranges snapped to confirmed BGZF block starts."""
+    src = as_byte_source(path)
+    try:
+        size = src.size
+        ranges = plan_byte_ranges(size, num_spans=num_spans,
+                                  span_bytes=span_bytes if span_bytes
+                                  else (None if num_spans else config.split_size))
+        guesser = BGZFSplitGuesser(src)
+        bounds: List[int] = []
+        for (bstart, _bend) in ranges:
+            if bstart == 0:
+                bounds.append(0)
+                continue
+            b = guesser.guess_next_block_start(bstart)
+            bounds.append(size if b is None else b)
+        bounds.append(size)
+        spans = []
+        for i in range(len(bounds) - 1):
+            s, e = bounds[i], bounds[i + 1]
+            if s < e:
+                spans.append(FileByteSpan(path, s, e))
+        return spans
+    finally:
+        src.close()
+
+
+def _prev_block_last_byte(src, coffset: int) -> Optional[int]:
+    """Final inflated byte of the BGZF block that ends exactly at
+    ``coffset`` (None when it cannot be located or is empty)."""
+    lo = max(0, coffset - bgzf.MAX_BLOCK_SIZE)
+    win = src.pread(lo, coffset - lo + bgzf.HEADER_SIZE)
+    arr = np.frombuffer(win[:coffset - lo], dtype=np.uint8)
+    for cand in bgzf.find_block_starts_numpy(arr):
+        c = lo + int(cand)
+        try:
+            info = bgzf.parse_block_header(win, int(cand))
+        except bgzf.BGZFError:
+            continue
+        if c + info.block_size == coffset:
+            try:
+                data = bgzf.inflate_block(win, info, check_crc=False)
+            except bgzf.BGZFError:
+                continue
+            return data[-1] if data else None
+    return None
+
+
+def read_bgzf_text_span(source, span: FileByteSpan) -> bytes:
+    """All text lines *starting* within the span's compressed block range.
+
+    A line starts in the span iff its first inflated byte lies in a block
+    whose compressed offset is in [span.start, span.end) — with the partial
+    line carried over a boundary owned by the previous span."""
+    src = as_byte_source(source)
+    start, end = span.start, span.end
+
+    chunks: List[bytes] = []
+    base_len = 0          # inflated bytes belonging to in-span blocks
+    coffset = start
+    while coffset < min(end, src.size):
+        head = src.pread(coffset, bgzf.MAX_BLOCK_SIZE)
+        info = bgzf.parse_block_header(head, 0)
+        chunks.append(bgzf.inflate_block(head, info, check_crc=False))
+        base_len += len(chunks[-1])
+        coffset += info.block_size
+    buf = b"".join(chunks)
+    # extend past the end until the final in-span line is complete
+    while (len(buf) == 0 or not buf.endswith(b"\n")) and coffset < src.size:
+        head = src.pread(coffset, bgzf.MAX_BLOCK_SIZE)
+        info = bgzf.parse_block_header(head, 0)
+        ext = bgzf.inflate_block(head, info, check_crc=False)
+        coffset += info.block_size
+        if not ext:
+            continue
+        nl = ext.find(b"\n")
+        if nl >= 0:
+            buf += ext[:nl + 1]
+            break
+        buf += ext
+
+    skip_first = False
+    if start > 0:
+        prev = _prev_block_last_byte(src, start)
+        skip_first = prev is not None and prev != 0x0A
+    out = bytearray()
+    pos = 0
+    n = len(buf)
+    first = True
+    while pos < base_len and pos < n:
+        nl = buf.find(b"\n", pos)
+        line_end = n if nl < 0 else nl + 1
+        if not (first and skip_first):
+            out += buf[pos:line_end]
+        first = False
+        pos = line_end
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# BCF: record-aligned virtual-offset spans
+# ---------------------------------------------------------------------------
+
+def plan_bcf_spans(path: str, *, num_spans: Optional[int] = None,
+                   config: HBamConfig = DEFAULT_CONFIG,
+                   header: Optional[VCFHeader] = None,
+                   ) -> List[FileVirtualSpan]:
+    """hb/VCFInputFormat BCF path: BCFSplitGuesser-aligned virtual spans."""
+    src = as_byte_source(path)
+    try:
+        size = src.size
+        hdr, first_voffset, is_bgzf = read_bcf_header(src)
+        if header is None:
+            header = hdr
+        ranges = plan_byte_ranges(size, num_spans=num_spans,
+                                  span_bytes=None if num_spans
+                                  else config.split_size)
+        guesser = BCFSplitGuesser(src, header, is_bgzf=is_bgzf)
+        boundaries: List[int] = []
+        for (bstart, _bend) in ranges:
+            if bstart == 0:
+                boundaries.append(first_voffset)
+                continue
+            v = guesser.guess_next_record_start(bstart)
+            boundaries.append(size << 16 if v is None
+                              else max(v, first_voffset))
+        boundaries.append(size << 16)
+        spans: List[FileVirtualSpan] = []
+        for i in range(len(boundaries) - 1):
+            s, e = boundaries[i], boundaries[i + 1]
+            if s < e:
+                spans.append(FileVirtualSpan(path, s, e))
+        return spans
+    finally:
+        src.close()
+
+
+def read_bcf_span(source, span: FileVirtualSpan,
+                  header: Optional[VCFHeader] = None,
+                  is_bgzf: Optional[bool] = None) -> List[VcfRecord]:
+    """hb/BCFRecordReader semantics: every record whose start virtual offset
+    is in [span.start_voffset, span.end_voffset)."""
+    src = as_byte_source(source)
+    if header is None or is_bgzf is None:
+        header, _, is_bgzf = read_bcf_header(src)
+    codec = BCFRecordCodec(header)
+    out: List[VcfRecord] = []
+    if is_bgzf:
+        r = bgzf.BGZFReader(src)
+        r.seek_voffset(span.start_voffset)
+        while True:
+            v = r.voffset()
+            if v >= span.end_voffset:
+                break
+            head = r.read(8)
+            if len(head) < 8:
+                break
+            l_shared, l_indiv = struct.unpack("<II", head)
+            body = r.read(l_shared + l_indiv)
+            rec, _ = codec.decode(head + body, 0)
+            out.append(rec)
+    else:
+        pos = span.start[0]
+        end_byte = span.end[0]
+        while pos < min(end_byte, src.size):
+            head = src.pread(pos, 8)
+            if len(head) < 8:
+                break
+            l_shared, l_indiv = struct.unpack("<II", head)
+            body = src.pread(pos + 8, l_shared + l_indiv)
+            rec, _ = codec.decode(head + body, 0)
+            out.append(rec)
+            pos += 8 + l_shared + l_indiv
+    return out

@@ -59,6 +59,46 @@ def merge_sam_shards(shard_paths: Sequence[str], out_path: str,
                         out.write(line)
 
 
+def merge_vcf_shards(shard_paths: Sequence[str], out_path: str,
+                     header: "VCFHeader", compress: bool = False,
+                     level: int = 6) -> None:
+    """hb/util/VCFFileMerger.java: header once + headerless text shards; for
+    BGZF output the header gets its own member(s) and shards concatenate as
+    legal BGZF members, terminated by the EOF block."""
+    if compress:
+        with open(out_path, "wb") as out:
+            w = bgzf.BGZFWriter(out, level=level, write_eof=False)
+            w.write(header.to_text().encode())
+            w.close()
+            for p in shard_paths:
+                with open(p, "rb") as f:
+                    out.write(_strip_trailing_eof(f.read()))
+            out.write(bgzf.EOF_BLOCK)
+    else:
+        with open(out_path, "wb") as out:
+            out.write(header.to_text().encode())
+            for p in shard_paths:
+                with open(p, "rb") as f:
+                    for line in f:
+                        if not line.startswith(b"#"):
+                            out.write(line)
+
+
+def merge_bcf_shards(shard_paths: Sequence[str], out_path: str,
+                     header: "VCFHeader", level: int = 6) -> None:
+    """Header block once (BGZF member) + concatenated headerless BCF shards
+    + EOF terminator -> one legal BCF."""
+    from hadoop_bam_tpu.formats.bcf import encode_header
+    with open(out_path, "wb") as out:
+        w = bgzf.BGZFWriter(out, level=level, write_eof=False)
+        w.write(encode_header(header))
+        w.close()
+        for p in shard_paths:
+            with open(p, "rb") as f:
+                out.write(_strip_trailing_eof(f.read()))
+        out.write(bgzf.EOF_BLOCK)
+
+
 def shard_paths_in_dir(dir_path: str, pattern: str = "part-*") -> List[str]:
     """Sorted shard discovery (the reference merges MR part-r-NNNNN files)."""
     return sorted(glob.glob(os.path.join(dir_path, pattern)))
