@@ -32,4 +32,24 @@ in docstrings use upstream paths, e.g.
 
 __version__ = "0.1.0"
 
-from hadoop_bam_tpu.config import HBamConfig, ValidationStringency  # noqa: F401
+from hadoop_bam_tpu.config import (  # noqa: F401
+    BaseQualityEncoding, HBamConfig, ValidationStringency,
+)
+
+
+def __getattr__(name):
+    # Lazy top-level API (keeps `import hadoop_bam_tpu` JAX-free and fast).
+    _lazy = {
+        "open_bam": ("hadoop_bam_tpu.api.dataset", "open_bam"),
+        "open_sam": ("hadoop_bam_tpu.api.dataset", "open_sam"),
+        "open_any_sam": ("hadoop_bam_tpu.api.dataset", "open_any_sam"),
+        "open_vcf": ("hadoop_bam_tpu.api.vcf_dataset", "open_vcf"),
+        "open_fastq": ("hadoop_bam_tpu.api.read_datasets", "open_fastq"),
+        "open_qseq": ("hadoop_bam_tpu.api.read_datasets", "open_qseq"),
+        "open_fasta": ("hadoop_bam_tpu.api.read_datasets", "open_fasta"),
+    }
+    if name in _lazy:
+        import importlib
+        mod, attr = _lazy[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,148 @@
+"""Read datasets: the FASTQ/QSEQ/FASTA InputFormat surface, iterator-shaped.
+
+Rebuild of hb/FastqInputFormat.java, hb/QseqInputFormat.java,
+hb/FastaInputFormat.java (SURVEY.md section 2.3) in dataset clothes, plus a
+padded-array bridge that feeds device pipelines the same way BamBatch does.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats.fasta import ReferenceFragment, parse_fasta
+from hadoop_bam_tpu.formats.fastq import SequencedFragment, parse_fastq
+from hadoop_bam_tpu.formats.qseq import parse_qseq
+from hadoop_bam_tpu.split.planners import plan_text_spans, read_text_span
+from hadoop_bam_tpu.split.read_planners import (
+    plan_fasta_spans, read_fasta_span, read_fastq_span,
+)
+from hadoop_bam_tpu.split.spans import FileByteSpan
+
+
+class _SpannedDataset:
+    """Shared span bookkeeping + checkpoint/resume."""
+
+    def __init__(self, path: str, config: HBamConfig):
+        self.path = path
+        self.config = config
+        self._plan: Optional[List[FileByteSpan]] = None
+        self._next_span = 0
+
+    def read_span(self, span: FileByteSpan) -> List:
+        raise NotImplementedError
+
+    def _iter_spans(self, num_spans: Optional[int]) -> Iterator:
+        """Span-granular resumable iteration (state = spans delivered)."""
+        plan = self.spans(num_spans)
+        while self._next_span < len(plan):
+            recs = self.read_span(plan[self._next_span])
+            self._next_span += 1
+            yield from recs
+
+    def _plan_spans(self, num_spans: Optional[int]) -> List[FileByteSpan]:
+        return plan_text_spans(self.path, num_spans=num_spans,
+                               span_bytes=None if num_spans
+                               else self.config.split_size)
+
+    def spans(self, num_spans: Optional[int] = None) -> List[FileByteSpan]:
+        if self._plan is None:
+            self._plan = self._plan_spans(num_spans)
+        return self._plan
+
+    def state_dict(self) -> Dict:
+        return {"path": self.path,
+                "plan": [s.to_dict() for s in (self._plan or [])],
+                "next_span": self._next_span}
+
+    def load_state_dict(self, state: Dict) -> None:
+        assert state["path"] == self.path
+        self._plan = [FileByteSpan.from_dict(d) for d in state["plan"]] or None
+        self._next_span = int(state["next_span"])
+
+
+class FastqDataset(_SpannedDataset):
+    """Splittable FASTQ: record-quadruple alignment at every span boundary."""
+
+    def read_span(self, span: FileByteSpan) -> List[SequencedFragment]:
+        text = read_fastq_span(self.path, span)
+        return parse_fastq(text,
+                           encoding=self.config.fastq_base_quality_encoding,
+                           filter_failed_qc=self.config.fastq_filter_failed_qc)
+
+    def records(self, num_spans: Optional[int] = None
+                ) -> Iterator[SequencedFragment]:
+        return self._iter_spans(num_spans)
+
+
+class QseqDataset(_SpannedDataset):
+    """Illumina qseq: one record per line."""
+
+    def read_span(self, span: FileByteSpan) -> List[SequencedFragment]:
+        text = read_text_span(self.path, span)
+        return parse_qseq(text,
+                          encoding=self.config.qseq_base_quality_encoding,
+                          filter_failed_qc=self.config.qseq_filter_failed_qc)
+
+    def records(self, num_spans: Optional[int] = None
+                ) -> Iterator[SequencedFragment]:
+        return self._iter_spans(num_spans)
+
+
+class FastaDataset(_SpannedDataset):
+    """Reference FASTA: spans hold whole contigs (snapped to '>')."""
+
+    def _plan_spans(self, num_spans: Optional[int]) -> List[FileByteSpan]:
+        return plan_fasta_spans(self.path, num_spans=num_spans,
+                                config=self.config)
+
+    def read_span(self, span: FileByteSpan) -> List[ReferenceFragment]:
+        return parse_fasta(read_fasta_span(self.path, span))
+
+    def fragments(self, num_spans: Optional[int] = None
+                  ) -> Iterator[ReferenceFragment]:
+        return self._iter_spans(num_spans)
+
+
+def open_fastq(path: str, config: HBamConfig = DEFAULT_CONFIG) -> FastqDataset:
+    return FastqDataset(path, config)
+
+
+def open_qseq(path: str, config: HBamConfig = DEFAULT_CONFIG) -> QseqDataset:
+    return QseqDataset(path, config)
+
+
+def open_fasta(path: str, config: HBamConfig = DEFAULT_CONFIG) -> FastaDataset:
+    return FastaDataset(path, config)
+
+
+# ---------------------------------------------------------------------------
+# device bridge: fragments -> fixed-shape arrays
+# ---------------------------------------------------------------------------
+
+# Unknown/ambiguity characters (IUPAC codes, gaps) map to N (4), never to a
+# confident base; 5 is reserved for padding.
+_BASE_CODE = np.full(256, 4, dtype=np.uint8)
+for i, c in enumerate("ACGT"):
+    _BASE_CODE[ord(c)] = i
+    _BASE_CODE[ord(c.lower())] = i
+
+
+def fragments_to_arrays(frags: List[SequencedFragment], max_len: int
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad/truncate reads into fixed shapes for the device:
+    (bases [n, max_len] uint8 codes A0 C1 G2 T3 N4 pad5,
+     quals [n, max_len] uint8 Phred values, lengths [n] int32)."""
+    n = len(frags)
+    bases = np.full((n, max_len), 5, dtype=np.uint8)
+    quals = np.zeros((n, max_len), dtype=np.uint8)
+    lengths = np.zeros(n, dtype=np.int32)
+    for i, f in enumerate(frags):
+        l = min(len(f.sequence), max_len)
+        lengths[i] = l
+        seq = np.frombuffer(f.sequence[:l].encode("latin-1"), dtype=np.uint8)
+        bases[i, :l] = _BASE_CODE[seq]
+        q = np.frombuffer(f.quality[:l].encode("latin-1"), dtype=np.uint8)
+        quals[i, :l] = q - 33
+    return bases, quals, lengths

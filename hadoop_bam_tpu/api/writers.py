@@ -149,6 +149,75 @@ def open_vcf_writer(path: str, header: "VCFHeader",
                           compress=lower.endswith((".vcf.gz", ".vcf.bgz")))
 
 
+class FastqShardWriter:
+    """4-line FASTQ emitter (hb/FastqOutputFormat.java); optional BGZF
+    compression mirrors the reference's optional Hadoop codec; qualities are
+    emitted in the configured base-quality encoding."""
+
+    def __init__(self, sink, config: HBamConfig = DEFAULT_CONFIG,
+                 compress: bool = False, level: int = 6):
+        from hadoop_bam_tpu.formats import bgzf
+        self._encoding = config.fastq_base_quality_encoding
+        self._own = False
+        if isinstance(sink, (str, os.PathLike)):
+            sink = open(sink, "wb")
+            self._own = True
+        self._raw_sink = sink
+        self._bgzf = bgzf.BGZFWriter(sink, level=level) if compress else None
+        self.records_written = 0
+
+    def write_record(self, frag) -> None:
+        from hadoop_bam_tpu.config import BaseQualityEncoding
+        from hadoop_bam_tpu.formats.fastq import convert_quality
+        text = frag.to_fastq()
+        if self._encoding is not BaseQualityEncoding.SANGER:
+            q = convert_quality(frag.quality, BaseQualityEncoding.SANGER,
+                                self._encoding)
+            text = f"@{frag.name}\n{frag.sequence}\n+\n{q}\n"
+        (self._bgzf or self._raw_sink).write(text.encode())
+        self.records_written += 1
+
+    def close(self) -> None:
+        if self._bgzf is not None:
+            self._bgzf.close()
+        if self._own:
+            self._raw_sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class QseqShardWriter:
+    """Tab-line qseq emitter (hb/QseqOutputFormat.java)."""
+
+    def __init__(self, sink, config: HBamConfig = DEFAULT_CONFIG):
+        self._encoding = config.qseq_base_quality_encoding
+        self._own = False
+        if isinstance(sink, (str, os.PathLike)):
+            sink = open(sink, "w")
+            self._own = True
+        self._sink = sink
+        self.records_written = 0
+
+    def write_record(self, frag) -> None:
+        from hadoop_bam_tpu.formats.qseq import format_qseq_line
+        self._sink.write(format_qseq_line(frag, self._encoding) + "\n")
+        self.records_written += 1
+
+    def close(self) -> None:
+        if self._own:
+            self._sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def write_records(path: str, header: SAMHeader,
                   records: Iterable[Union[SamRecord, bytes]],
                   config: HBamConfig = DEFAULT_CONFIG) -> int:

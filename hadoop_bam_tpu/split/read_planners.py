@@ -1,0 +1,113 @@
+"""Span planning + readers for read formats: FASTQ, QSEQ, FASTA.
+
+Rebuild of the getSplits/RecordReader behavior of hb/FastqInputFormat.java,
+hb/QseqInputFormat.java, hb/FastaInputFormat.java (SURVEY.md section 2.3):
+
+- FASTQ: plain byte splits; record alignment at read time via the @/+ record
+  heuristic (formats/fastq.find_fastq_record_start) — each record belongs to
+  the span its first byte starts in.
+- QSEQ: one record per line; LineRecordReader semantics
+  (split/planners.read_text_span).
+- FASTA: splits snapped to ``>`` sequence starts at plan time, so every span
+  holds whole contigs and per-fragment positions are well-defined.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats.fasta import find_sequence_start
+from hadoop_bam_tpu.formats.fastq import find_fastq_record_start
+from hadoop_bam_tpu.split.planners import plan_byte_ranges
+from hadoop_bam_tpu.split.spans import FileByteSpan
+from hadoop_bam_tpu.utils.seekable import as_byte_source, scoped_byte_source
+
+_CHUNK = 1 << 20
+
+
+def read_fastq_span(source, span: FileByteSpan) -> bytes:
+    """Bytes of all FASTQ records *starting* in [span.start, span.end)."""
+    with scoped_byte_source(source) as src:
+        start, end = span.start, span.end
+        size = src.size
+
+        # Window from start-1 (line-start context) extended until it contains
+        # a record start past `end` (the stop boundary) or EOF.
+        lo = max(0, start - 1)
+        buf = bytearray()
+        fetch_pos = lo
+        first_rel: Optional[int] = None
+        stop_rel: Optional[int] = None
+        while True:
+            got = src.pread(fetch_pos, _CHUNK)
+            buf += got
+            fetch_pos += len(got)
+            at_eof = fetch_pos >= size or not got
+            if first_rel is None:
+                first_rel = find_fastq_record_start(buf, start - lo)
+            if first_rel is not None and fetch_pos >= end:
+                stop_rel = find_fastq_record_start(buf,
+                                                   max(end - lo, first_rel))
+                if stop_rel is not None or at_eof:
+                    break
+            if at_eof:
+                break
+        if first_rel is None or first_rel >= end - lo:
+            return b""
+        if stop_rel is None:
+            out = bytes(buf[first_rel:])
+            if not out.endswith(b"\n"):
+                out += b"\n"
+            return out
+        return bytes(buf[first_rel:stop_rel])
+
+
+def plan_fasta_spans(path: str, *, num_spans: Optional[int] = None,
+                     span_bytes: Optional[int] = None,
+                     config: HBamConfig = DEFAULT_CONFIG) -> List[FileByteSpan]:
+    """Byte ranges snapped forward to ``>`` header-line starts."""
+    src = as_byte_source(path)
+    try:
+        size = src.size
+        ranges = plan_byte_ranges(size, num_spans=num_spans,
+                                  span_bytes=span_bytes if span_bytes
+                                  else (None if num_spans else config.split_size))
+        bounds: List[int] = []
+        for (bstart, _bend) in ranges:
+            if bstart == 0:
+                bounds.append(0)
+                continue
+            # scan forward for "\n>" (whole-file read windows)
+            snapped = size
+            pos = bstart
+            while pos < size:
+                win = src.pread(max(0, pos - 1), _CHUNK + 1)
+                rel = find_sequence_start(win, pos - max(0, pos - 1))
+                if rel is not None:
+                    snapped = max(0, pos - 1) + rel
+                    break
+                pos += _CHUNK
+            bounds.append(snapped)
+        bounds.append(size)
+        spans = []
+        for i in range(len(bounds) - 1):
+            s, e = bounds[i], bounds[i + 1]
+            if s < e:
+                spans.append(FileByteSpan(path, s, e))
+        return spans
+    finally:
+        src.close()
+
+
+def read_fasta_span(source, span: FileByteSpan) -> bytes:
+    """Raw bytes of a sequence-aligned FASTA span (whole contigs)."""
+    with scoped_byte_source(source) as src:
+        out = bytearray()
+        pos = span.start
+        while pos < span.end:
+            got = src.pread(pos, min(_CHUNK, span.end - pos))
+            if not got:
+                break
+            out += got
+            pos += len(got)
+        return bytes(out)

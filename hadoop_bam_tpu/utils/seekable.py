@@ -41,6 +41,7 @@ class FileByteSource(ByteSource):
 
     def __init__(self, path: Union[str, os.PathLike]):
         self.path = os.fspath(path)
+        self._fd = -1  # set first so __del__ is safe if os.open raises
         self._fd = os.open(self.path, os.O_RDONLY)
         self.size = os.fstat(self._fd).st_size
 
@@ -53,6 +54,12 @@ class FileByteSource(ByteSource):
         if self._fd >= 0:
             os.close(self._fd)
             self._fd = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except OSError:
+            pass
 
 
 class BytesByteSource(ByteSource):
@@ -75,3 +82,20 @@ def as_byte_source(obj) -> ByteSource:
     if isinstance(obj, (str, os.PathLike)):
         return FileByteSource(obj)
     raise TypeError(f"cannot make a ByteSource from {type(obj)!r}")
+
+
+class scoped_byte_source:
+    """``with scoped_byte_source(obj) as src``: closes ``src`` on exit only
+    when this call created it (an already-open ByteSource passes through
+    untouched — the caller owns its lifetime)."""
+
+    def __init__(self, obj):
+        self._owned = not isinstance(obj, ByteSource)
+        self.src = as_byte_source(obj)
+
+    def __enter__(self) -> ByteSource:
+        return self.src
+
+    def __exit__(self, *exc):
+        if self._owned:
+            self.src.close()

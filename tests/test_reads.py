@@ -1,0 +1,224 @@
+"""FASTQ/QSEQ/FASTA family tests.
+
+Mirrors test/TestFastqInputFormat.java, test/TestQseqInputFormat.java,
+test/TestFastaInputFormat.java (SURVEY.md section 4): codec round-trips,
+metadata parsing, and the critical every-boundary split-robustness property —
+including '@' appearing as the first character of quality strings, the case
+the FASTQ record heuristic exists for.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.config import BaseQualityEncoding, HBamConfig
+from hadoop_bam_tpu.api.read_datasets import (
+    fragments_to_arrays, open_fasta, open_fastq, open_qseq,
+)
+from hadoop_bam_tpu.api.writers import FastqShardWriter, QseqShardWriter
+from hadoop_bam_tpu.formats.fasta import parse_fasta
+from hadoop_bam_tpu.formats.fastq import (
+    SequencedFragment, convert_quality, find_fastq_record_start, parse_fastq,
+)
+from hadoop_bam_tpu.formats.qseq import format_qseq_line, parse_qseq_line
+from hadoop_bam_tpu.split.read_planners import read_fastq_span
+from hadoop_bam_tpu.split.spans import FileByteSpan
+
+
+def make_fragments(n: int, seed: int = 0):
+    rng = random.Random(seed)
+    frags = []
+    for i in range(n):
+        l = rng.randint(30, 120)
+        seq = "".join(rng.choice("ACGTN") for _ in range(l))
+        # qualities deliberately include '@' (64) and '+' (43) as first chars
+        qual = "".join(chr(rng.choice([33 + rng.randint(0, 60), 64, 43]))
+                       for _ in range(l))
+        name = (f"M0:{i % 4}:FC1:1:{1000 + i}:{rng.randint(0, 9999)}:"
+                f"{rng.randint(0, 9999)}")
+        f = SequencedFragment.from_name(name, seq, qual)
+        frags.append(f)
+    return frags
+
+
+@pytest.fixture(scope="module")
+def fastq_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("reads")
+    frags = make_fragments(300, seed=11)
+    path = str(d / "r.fastq")
+    with FastqShardWriter(path) as w:
+        for f in frags:
+            w.write_record(f)
+    return path, frags
+
+
+def test_name_metadata_casava18():
+    f = SequencedFragment.from_name(
+        "EAS139:136:FC706VJ:2:2104:15343:197393 1:Y:18:ATCACG")
+    assert f.instrument == "EAS139" and f.run_number == 136
+    assert f.flowcell_id == "FC706VJ" and f.lane == 2 and f.tile == 2104
+    assert f.xpos == 15343 and f.ypos == 197393
+    assert f.read == 1 and f.filter_passed is False
+    assert f.control_number == 18 and f.index_sequence == "ATCACG"
+
+
+def test_name_metadata_pre18():
+    f = SequencedFragment.from_name("HWUSI-EAS100R:6:73:941:1973#ATCG/1")
+    assert f.instrument == "HWUSI-EAS100R" and f.lane == 6 and f.tile == 73
+    assert f.xpos == 941 and f.ypos == 1973
+    assert f.index_sequence == "ATCG" and f.read == 1
+
+
+def test_quality_conversion():
+    sanger = "II?5+#"
+    illumina = convert_quality(sanger, BaseQualityEncoding.SANGER,
+                               BaseQualityEncoding.ILLUMINA)
+    assert convert_quality(illumina, BaseQualityEncoding.ILLUMINA) == sanger
+    assert ord(illumina[0]) - ord(sanger[0]) == 31
+
+
+def test_fastq_roundtrip(fastq_file):
+    path, frags = fastq_file
+    text = open(path, "rb").read()
+    parsed = parse_fastq(text)
+    assert len(parsed) == len(frags)
+    for a, b in zip(parsed, frags):
+        assert a.name == b.name
+        assert a.sequence == b.sequence
+        assert a.quality == b.quality
+
+
+def test_record_start_heuristic_vs_quality_at():
+    # quality line starting with '@' must not be mistaken for a record start
+    text = (b"@r1\nACGT\n+\n@@@@\n"
+            b"@r2\nTTTT\n+\nIIII\n")
+    # from inside the first quality line, the next record is r2 at offset 16
+    start = find_fastq_record_start(text, 9)
+    assert text[start:start + 3] == b"@r2"
+    assert find_fastq_record_start(text, 0) == 0
+
+
+@pytest.mark.parametrize("num_spans", [1, 2, 5, 9])
+def test_fastq_span_union(fastq_file, num_spans):
+    path, frags = fastq_file
+    ds = open_fastq(path)
+    got = [f.name for f in ds.records(num_spans=num_spans)]
+    assert got == [f.name for f in frags]
+
+
+def test_fastq_every_boundary(fastq_file):
+    """Two-span split at many byte offsets: union must be exact."""
+    path, frags = fastq_file
+    size = len(open(path, "rb").read())
+    want = [f.name for f in frags]
+    rng = random.Random(5)
+    cuts = sorted({1, 7, size // 2, size - 3} |
+                  {rng.randrange(1, size) for _ in range(60)})
+    for cut in cuts:
+        a = parse_fastq(read_fastq_span(path, FileByteSpan(path, 0, cut)))
+        b = parse_fastq(read_fastq_span(path, FileByteSpan(path, cut, size)))
+        got = [f.name for f in a] + [f.name for f in b]
+        assert got == want, f"cut={cut}"
+
+
+def test_fastq_filter_failed_qc(tmp_path):
+    frags = []
+    for i, filt in enumerate("YNYN"):
+        f = SequencedFragment.from_name(
+            f"M:1:F:1:1:{i}:{i} 1:{filt}:0:AAA", "ACGT", "IIII")
+        frags.append(f)
+    p = str(tmp_path / "f.fastq")
+    with FastqShardWriter(p) as w:
+        for f in frags:
+            w.write_record(f)
+    ds = open_fastq(p, HBamConfig(fastq_filter_failed_qc=True))
+    got = list(ds.records(num_spans=1))
+    assert len(got) == 2
+    assert all(f.filter_passed for f in got)
+
+
+# ---------------------------------------------------------------------------
+# QSEQ
+# ---------------------------------------------------------------------------
+
+def test_qseq_line_roundtrip():
+    line = ("M001\t5\t1\t1101\t100\t200\tACGTAC\t1\t"
+            "ACGTN.AC\tabcdefgh\t1")
+    f = parse_qseq_line(line)
+    assert f.sequence == "ACGTNNAC"  # '.' -> 'N'
+    assert f.filter_passed is True
+    assert f.read == 1 and f.lane == 1 and f.tile == 1101
+    # qualities arrived Illumina(+64); canonical form is Sanger(+33)
+    assert ord(f.quality[0]) == ord("a") - 31
+    back = format_qseq_line(f)
+    assert back.split("\t")[9] == "abcdefgh"
+    assert back.split("\t")[8] == "ACGT..AC"  # N -> '.' on emit
+
+
+def test_qseq_span_union(tmp_path):
+    rng = random.Random(3)
+    frags = make_fragments(120, seed=4)
+    p = str(tmp_path / "r.qseq")
+    with QseqShardWriter(p) as w:
+        for f in frags:
+            w.write_record(f)
+    ds = open_qseq(p)
+    for num_spans in (1, 3, 7):
+        ds2 = open_qseq(p)
+        got = [f.sequence for f in ds2.records(num_spans=num_spans)]
+        assert got == [f.sequence for f in frags]
+
+
+# ---------------------------------------------------------------------------
+# FASTA
+# ---------------------------------------------------------------------------
+
+FASTA_TEXT = b""">chr1 test contig
+ACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGT
+TTTTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTTTTT
+ACGT
+>chr2
+GGGGACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTACGTCCCC
+AAAA
+>chr3
+CCCC
+"""
+
+
+def test_fasta_parse_positions():
+    frags = parse_fasta(FASTA_TEXT)
+    assert [f.contig for f in frags] == ["chr1"] * 3 + ["chr2"] * 2 + ["chr3"]
+    assert [f.position for f in frags] == [1, 61, 121, 1, 61, 1]
+    merged = parse_fasta(FASTA_TEXT, line_fragments=False)
+    assert len(merged) == 3
+    assert merged[0].sequence.startswith("ACGT") and len(merged[0]) == 124
+
+
+def test_fasta_span_union(tmp_path):
+    p = str(tmp_path / "r.fa")
+    open(p, "wb").write(FASTA_TEXT)
+    want = [(f.contig, f.position, f.sequence)
+            for f in parse_fasta(FASTA_TEXT)]
+    for num_spans in (1, 2, 3, 5):
+        ds = open_fasta(p)
+        got = [(f.contig, f.position, f.sequence)
+               for f in ds.fragments(num_spans=num_spans)]
+        assert got == want, f"num_spans={num_spans}"
+
+
+# ---------------------------------------------------------------------------
+# device bridge
+# ---------------------------------------------------------------------------
+
+def test_fragments_to_arrays():
+    frags = make_fragments(10, seed=9)
+    bases, quals, lengths = fragments_to_arrays(frags, max_len=64)
+    assert bases.shape == (10, 64) and quals.shape == (10, 64)
+    for i, f in enumerate(frags):
+        l = min(len(f.sequence), 64)
+        assert lengths[i] == l
+        assert (bases[i, l:] == 5).all()
+        code = {"A": 0, "C": 1, "G": 2, "T": 3, "N": 4}
+        assert [code[c] for c in f.sequence[:l]] == list(bases[i, :l])
