@@ -1,0 +1,4 @@
+from hadoop_bam_tpu.tools.cli import main
+import sys
+
+sys.exit(main())

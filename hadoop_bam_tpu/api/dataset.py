@@ -51,8 +51,11 @@ class BamDataset:
         return read_bam_span(self.path, span, header=self.header)
 
     def batches(self, num_spans: Optional[int] = None) -> Iterator[BamBatch]:
-        """Yield one SoA batch per span, resumable via state_dict()."""
+        """Yield one SoA batch per span, resumable via state_dict();
+        a fresh call after exhaustion restarts from the beginning."""
         plan = self.spans(num_spans)
+        if self._next_span >= len(plan):
+            self._next_span = 0
         while self._next_span < len(plan):
             span = plan[self._next_span]
             batch = self.read_span(span)

@@ -19,6 +19,7 @@ from hadoop_bam_tpu.split.read_planners import (
     plan_fasta_spans, read_fasta_span, read_fastq_span,
 )
 from hadoop_bam_tpu.split.spans import FileByteSpan
+from hadoop_bam_tpu.utils.seekable import scoped_byte_source
 
 
 class _SpannedDataset:
@@ -28,14 +29,19 @@ class _SpannedDataset:
         self.path = path
         self.config = config
         self._plan: Optional[List[FileByteSpan]] = None
+        self._plan_num_spans: Optional[int] = None
         self._next_span = 0
 
     def read_span(self, span: FileByteSpan) -> List:
         raise NotImplementedError
 
     def _iter_spans(self, num_spans: Optional[int]) -> Iterator:
-        """Span-granular resumable iteration (state = spans delivered)."""
+        """Span-granular resumable iteration (state = spans delivered).
+        A fresh call after exhaustion restarts from the beginning; a call
+        after load_state_dict resumes mid-plan."""
         plan = self.spans(num_spans)
+        if self._next_span >= len(plan):
+            self._next_span = 0
         while self._next_span < len(plan):
             recs = self.read_span(plan[self._next_span])
             self._next_span += 1
@@ -47,8 +53,14 @@ class _SpannedDataset:
                                else self.config.split_size)
 
     def spans(self, num_spans: Optional[int] = None) -> List[FileByteSpan]:
+        if self._plan is not None and num_spans is not None \
+                and num_spans != self._plan_num_spans:
+            raise ValueError(
+                f"span plan already built with num_spans="
+                f"{self._plan_num_spans}; open a new dataset to re-plan")
         if self._plan is None:
             self._plan = self._plan_spans(num_spans)
+            self._plan_num_spans = num_spans
         return self._plan
 
     def state_dict(self) -> Dict:
@@ -63,10 +75,28 @@ class _SpannedDataset:
 
 
 class FastqDataset(_SpannedDataset):
-    """Splittable FASTQ: record-quadruple alignment at every span boundary."""
+    """Splittable FASTQ: record-quadruple alignment at every span boundary.
+
+    Compressed inputs (.gz / BGZF) are read as ONE span over the inflated
+    stream — the reference's behavior for non-splittable Hadoop codecs."""
+
+    def _is_compressed(self) -> bool:
+        with scoped_byte_source(self.path) as src:
+            return src.pread(0, 2) == b"\x1f\x8b"
+
+    def _plan_spans(self, num_spans: Optional[int]) -> List[FileByteSpan]:
+        if self._is_compressed():
+            with scoped_byte_source(self.path) as src:
+                return [FileByteSpan(self.path, 0, src.size)]
+        return super()._plan_spans(num_spans)
 
     def read_span(self, span: FileByteSpan) -> List[SequencedFragment]:
-        text = read_fastq_span(self.path, span)
+        if span.start == 0 and self._is_compressed():
+            import gzip
+            with open(self.path, "rb") as f:
+                text = gzip.decompress(f.read())
+        else:
+            text = read_fastq_span(self.path, span)
         return parse_fastq(text,
                            encoding=self.config.fastq_base_quality_encoding,
                            filter_failed_qc=self.config.fastq_filter_failed_qc)

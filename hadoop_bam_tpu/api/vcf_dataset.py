@@ -100,6 +100,8 @@ class VcfDataset:
 
     def records(self, num_spans: Optional[int] = None) -> Iterator[VcfRecord]:
         plan = self.spans(num_spans)
+        if self._next_span >= len(plan):
+            self._next_span = 0
         while self._next_span < len(plan):
             span = plan[self._next_span]
             recs = self.read_span(span)
@@ -109,6 +111,8 @@ class VcfDataset:
     def batches(self, num_spans: Optional[int] = None
                 ) -> Iterator[VariantBatch]:
         plan = self.spans(num_spans)
+        if self._next_span >= len(plan):
+            self._next_span = 0
         while self._next_span < len(plan):
             span = plan[self._next_span]
             recs = self.read_span(span)

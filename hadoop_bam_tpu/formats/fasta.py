@@ -43,7 +43,10 @@ def parse_fasta(text: bytes, line_fragments: bool = True
         if line.startswith(b">"):
             if contig is not None and not line_fragments and merged:
                 out.append(ReferenceFragment("".join(merged), contig, 1))
-            contig = line[1:].split()[0].decode()
+            name_parts = line[1:].split()
+            if not name_parts:
+                raise FastaError("empty contig name in FASTA header")
+            contig = name_parts[0].decode()
             position = 1
             merged = []
             continue

@@ -118,6 +118,7 @@ _SEQ_CHARS = frozenset(b"ACGTNUKSYMWRBDHVacgtnuksymwrbdhv.-=")
 
 
 def _is_seq_line(line: bytes) -> bool:
+    line = line.rstrip(b"\r")  # tolerate CRLF files
     return len(line) > 0 and all(c in _SEQ_CHARS for c in line)
 
 
@@ -126,7 +127,7 @@ def parse_fastq(text: bytes,
                 filter_failed_qc: bool = False) -> List[SequencedFragment]:
     """Strict 4-line FASTQ parse of a span's text (hb/FastqRecordReader)."""
     out: List[SequencedFragment] = []
-    lines = text.split(b"\n")
+    lines = [l.rstrip(b"\r") for l in text.split(b"\n")]  # CRLF-safe
     if lines and lines[-1] == b"":
         lines.pop()
     if len(lines) % 4:
@@ -186,3 +187,19 @@ def find_fastq_record_start(buf: bytes, offset: int = 0) -> Optional[int]:
                 return line_start
         pos = lines[0][0] + len(l0) + 1
     return None
+
+
+def record_fully_visible(buf, pos: int) -> bool:
+    """True when 4 complete lines (record-sized evidence) follow ``pos`` in
+    ``buf`` — callers must not trust a candidate record start validated on a
+    truncated tail unless the buffer reaches EOF."""
+    n = len(buf)
+    seen = 0
+    p = pos
+    while seen < 4:
+        nl = buf.find(b"\n", p)
+        if nl < 0:
+            return False
+        seen += 1
+        p = nl + 1
+    return True

@@ -213,42 +213,43 @@ def read_text_span(source, span: FileByteSpan, *, skip_prefix_lines_at_zero=0,
     LineRecordReader contract: if start > 0, the (possibly partial) line in
     progress at ``start`` belongs to the previous span — skip to the first
     newline; read past ``end`` to complete the final line."""
-    src = as_byte_source(source)
-    start, end = span.start, span.end
-    if start > 0:
-        # Find the first newline at/after start-1: a line starting exactly at
-        # ``start`` is ours only if byte start-1 is a newline, which this
-        # probe handles uniformly.
-        probe_off = start - 1
-        probe = b""
-        while True:
-            got = src.pread(probe_off + len(probe), chunk)
+    from hadoop_bam_tpu.utils.seekable import scoped_byte_source
+    with scoped_byte_source(source) as src:
+        start, end = span.start, span.end
+        if start > 0:
+            # Find the first newline at/after start-1: a line starting
+            # exactly at ``start`` is ours only if byte start-1 is a newline,
+            # which this probe handles uniformly.
+            probe_off = start - 1
+            probe = b""
+            while True:
+                got = src.pread(probe_off + len(probe), chunk)
+                if not got:
+                    return b""
+                probe += got
+                nl = probe.find(b"\n")
+                if nl >= 0:
+                    start = probe_off + nl + 1
+                    break
+        if start >= end:
+            return b""  # no line *starts* inside this span
+        out = bytearray()
+        pos = start
+        while pos < end:
+            got = src.pread(pos, min(chunk, end - pos))
             if not got:
-                return b""
-            probe += got
-            nl = probe.find(b"\n")
-            if nl >= 0:
-                start = probe_off + nl + 1
                 break
-    if start >= end:
-        return b""  # no line *starts* inside this span
-    out = bytearray()
-    pos = start
-    while pos < end:
-        got = src.pread(pos, min(chunk, end - pos))
-        if not got:
-            break
-        out += got
-        pos += len(got)
-    # finish the final line
-    while not out.endswith(b"\n") and pos < src.size:
-        got = src.pread(pos, chunk)
-        if not got:
-            break
-        nl = got.find(b"\n")
-        if nl >= 0:
-            out += got[:nl + 1]
-            break
-        out += got
-        pos += len(got)
-    return bytes(out)
+            out += got
+            pos += len(got)
+        # finish the final line
+        while not out.endswith(b"\n") and pos < src.size:
+            got = src.pread(pos, chunk)
+            if not got:
+                break
+            nl = got.find(b"\n")
+            if nl >= 0:
+                out += got[:nl + 1]
+                break
+            out += got
+            pos += len(got)
+        return bytes(out)

@@ -17,7 +17,9 @@ from typing import List, Optional
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.fasta import find_sequence_start
-from hadoop_bam_tpu.formats.fastq import find_fastq_record_start
+from hadoop_bam_tpu.formats.fastq import (
+    find_fastq_record_start, record_fully_visible,
+)
 from hadoop_bam_tpu.split.planners import plan_byte_ranges
 from hadoop_bam_tpu.split.spans import FileByteSpan
 from hadoop_bam_tpu.utils.seekable import as_byte_source, scoped_byte_source
@@ -44,10 +46,21 @@ def read_fastq_span(source, span: FileByteSpan) -> bytes:
             fetch_pos += len(got)
             at_eof = fetch_pos >= size or not got
             if first_rel is None:
-                first_rel = find_fastq_record_start(buf, start - lo)
+                cand = find_fastq_record_start(buf, start - lo)
+                # trust a candidate only once its record is fully in view
+                # (a truncated tail can validate a false start) — unless EOF
+                if cand is not None and (at_eof
+                                         or record_fully_visible(buf, cand)):
+                    first_rel = cand
+                elif not at_eof:
+                    continue
             if first_rel is not None and fetch_pos >= end:
                 stop_rel = find_fastq_record_start(buf,
                                                    max(end - lo, first_rel))
+                if stop_rel is not None and not at_eof \
+                        and not record_fully_visible(buf, stop_rel):
+                    stop_rel = None
+                    continue  # fetch more before trusting the stop boundary
                 if stop_rel is not None or at_eof:
                     break
             if at_eof:

@@ -1,0 +1,7 @@
+"""CLI tools — the rebuild of the reference command-line frontend.
+
+Upstream <= 6.x shipped ``fi.tkk.ics.hadoop.bam.cli`` (Frontend + plugin
+verbs: cat, index, sort, summarize, view, fixmate, vcf-sort — SURVEY.md
+section 2.7; upstream 7.0.0 removed it).  We keep the verb set: each verb is
+both a user tool and a benchmark driver for the decode pipeline.
+"""

@@ -1,0 +1,361 @@
+"""``python -m hadoop_bam_tpu <verb>`` — the CLI frontend.
+
+Verb parity with the reference CLI (SURVEY.md section 2.7):
+
+- ``view``      print records as SAM/VCF text (optionally header-only/count)
+- ``index``     build a .splitting-bai / .sbi sidecar (SplittingBAMIndexer)
+- ``cat``       concatenate same-header BAMs into one
+- ``summarize`` distributed flagstat over the mesh pipeline
+- ``sort``      coordinate- (or name-) sort a BAM
+- ``fixmate``   fill mate fields on name-grouped records
+- ``vcf-sort``  sort a VCF/BCF by (contig, position)
+
+Each verb works on local paths and prints to stdout; exit code != 0 on error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional, Tuple
+
+
+def _parse_region(region: str) -> Tuple[str, int, int]:
+    """'chr20:1,000-2,000' -> (chr20, 1000, 2000); open ends allowed."""
+    if ":" not in region:
+        return region, 1, 1 << 60
+    name, rng = region.rsplit(":", 1)
+    rng = rng.replace(",", "")
+    if "-" in rng:
+        lo, hi = rng.split("-", 1)
+        return name, int(lo or 1), int(hi or 1 << 60)
+    return name, int(rng), 1 << 60
+
+
+# ---------------------------------------------------------------------------
+# view
+# ---------------------------------------------------------------------------
+
+def cmd_view(args) -> int:
+    from hadoop_bam_tpu.api.dispatch import sniff_sam_container, SAMContainer
+    path = args.path
+    if path.endswith((".vcf", ".vcf.gz", ".bcf")):
+        return _view_vcf(args)
+    fmt = sniff_sam_container(path)
+    if fmt is SAMContainer.CRAM:
+        from hadoop_bam_tpu.api.dataset import open_any_sam
+        ds = open_any_sam(path)
+        if args.header_only:
+            sys.stdout.write(ds.header.to_sam_text())
+            return 0
+        n = 0
+        for rec in ds.records():
+            if not args.count:
+                sys.stdout.write(rec.to_line() + "\n")
+            n += 1
+        if args.count:
+            print(n)
+        return 0
+    return _view_sam(args, fmt)
+
+
+def _view_sam(args, fmt) -> int:
+    from hadoop_bam_tpu.api.dataset import open_any_sam
+    ds = open_any_sam(args.path)
+    header = ds.header
+    if args.header_only:
+        sys.stdout.write(header.to_sam_text())
+        return 0
+    region = _parse_region(args.region) if args.region else None
+    rid = header.ref_id(region[0]) if region else -2
+    if region and rid < 0:
+        print(f"unknown reference {region[0]!r}", file=sys.stderr)
+        return 1
+    n = 0
+    if not args.count and not args.no_header:
+        sys.stdout.write(header.to_sam_text())
+    from hadoop_bam_tpu.api.dataset import BamDataset
+    if isinstance(ds, BamDataset):
+        for batch in ds.batches():
+            import numpy as np
+            idx = np.arange(len(batch))
+            if region:
+                pos = batch.pos + 1
+                keep = (batch.refid == rid) & (pos <= region[2]) & \
+                       (pos + 400 >= region[1])  # overlap window pre-filter
+                idx = idx[keep]
+            for i in idx:
+                line = batch.to_sam_line(int(i))
+                if region:
+                    # exact overlap check on the decoded line's pos
+                    p = int(line.split("\t", 4)[3])
+                    if not (p <= region[2]):
+                        continue
+                if args.count:
+                    n += 1
+                else:
+                    sys.stdout.write(line + "\n")
+    else:
+        for rec in ds.records():
+            if region and (rec.rname != region[0]
+                           or not (region[1] <= rec.pos <= region[2])):
+                continue
+            if args.count:
+                n += 1
+            else:
+                sys.stdout.write(rec.to_line() + "\n")
+    if args.count:
+        print(n)
+    return 0
+
+
+def _view_vcf(args) -> int:
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    ds = open_vcf(args.path)
+    if args.header_only:
+        sys.stdout.write(ds.header.to_text())
+        return 0
+    region = _parse_region(args.region) if args.region else None
+    n = 0
+    if not args.count and not args.no_header:
+        sys.stdout.write(ds.header.to_text())
+    for rec in ds.records():
+        if region and (rec.chrom != region[0]
+                       or not (region[1] <= rec.pos <= region[2])):
+            continue
+        if args.count:
+            n += 1
+        else:
+            sys.stdout.write(rec.to_line() + "\n")
+    if args.count:
+        print(n)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# index
+# ---------------------------------------------------------------------------
+
+def cmd_index(args) -> int:
+    from hadoop_bam_tpu.split.splitting_index import write_splitting_index
+    for path in args.paths:
+        out = write_splitting_index(path, granularity=args.granularity,
+                                    flavor=args.flavor)
+        print(f"wrote {out}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# cat
+# ---------------------------------------------------------------------------
+
+def cmd_cat(args) -> int:
+    """Concatenate BAMs sharing a header (reference CLI `cat`): header from
+    the first input, record bytes streamed through, one EOF terminator."""
+    from hadoop_bam_tpu.formats.bamio import BamWriter, read_bam_header
+    from hadoop_bam_tpu.api.dataset import open_bam
+
+    header, _ = read_bam_header(args.inputs[0])
+    with BamWriter(args.output, header) as w:
+        for path in args.inputs:
+            ds = open_bam(path)
+            for batch in ds.batches():
+                for i in range(len(batch)):
+                    w.write_record_bytes(batch.record_bytes(i))
+    print(f"wrote {args.output} ({w.records_written} records)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# summarize
+# ---------------------------------------------------------------------------
+
+def cmd_summarize(args) -> int:
+    from hadoop_bam_tpu.ops.flagstat import format_flagstat
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+    stats = flagstat_file(args.path)
+    sys.stdout.write(format_flagstat(stats))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def cmd_sort(args) -> int:
+    import numpy as np
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+
+    ds = open_bam(args.input)
+    header = ds.header
+    batches = list(ds.batches())
+    recs: List[bytes] = []
+    keys = []
+    for b in batches:
+        if args.by_name:
+            for i in range(len(b)):
+                keys.append((b.read_name(i), i))
+                recs.append(b.record_bytes(i))
+        else:
+            refid = b.refid.astype(np.int64)
+            # unmapped (-1) sorts last, as in coordinate order [SPEC]
+            refkey = np.where(refid < 0, np.int64(1 << 40), refid)
+            pos = b.pos.astype(np.int64)
+            for i in range(len(b)):
+                keys.append((int(refkey[i]), int(pos[i])))
+                recs.append(b.record_bytes(i))
+    order = sorted(range(len(recs)), key=lambda i: keys[i])
+    text = header.text
+    so = "queryname" if args.by_name else "coordinate"
+    if "@HD" in text:
+        import re
+        text = re.sub(r"(@HD[^\n]*?)(\tSO:\S+)?(\n)",
+                      lambda m: m.group(1) + f"\tSO:{so}" + m.group(3),
+                      text, count=1)
+    else:
+        text = f"@HD\tVN:1.6\tSO:{so}\n" + text
+    header2 = type(header)(text=text, ref_names=header.ref_names,
+                           ref_lengths=header.ref_lengths)
+    with BamWriter(args.output, header2) as w:
+        for i in order:
+            w.write_record_bytes(recs[i])
+    print(f"wrote {args.output} ({len(recs)} records, {so})")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# fixmate
+# ---------------------------------------------------------------------------
+
+def cmd_fixmate(args) -> int:
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.formats.sam import SamRecord
+
+    ds = open_bam(args.input)
+    recs = [SamRecord.from_line(b.to_sam_line(i))
+            for b in ds.batches() for i in range(len(b))]
+    i = 0
+    while i < len(recs):
+        a = recs[i]
+        if i + 1 < len(recs) and recs[i + 1].qname == a.qname \
+                and (a.flag & 0x1):
+            b = recs[i + 1]
+            a.rnext = "=" if b.rname == a.rname else b.rname
+            b.rnext = "=" if a.rname == b.rname else a.rname
+            a.pnext, b.pnext = b.pos, a.pos
+            if a.rname == b.rname and a.pos and b.pos:
+                span = max(a.pos + _alen(a), b.pos + _alen(b)) \
+                    - min(a.pos, b.pos)
+                sign = 1 if a.pos <= b.pos else -1
+                a.tlen, b.tlen = sign * span, -sign * span
+            # mate-unmapped/reverse flags [SPEC 0x8, 0x20]
+            for x, y in ((a, b), (b, a)):
+                x.flag = (x.flag & ~0x28) | (0x8 if y.flag & 0x4 else 0) \
+                    | (0x20 if y.flag & 0x10 else 0)
+            i += 2
+        else:
+            i += 1
+    with BamWriter(args.output, ds.header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    print(f"wrote {args.output} ({len(recs)} records)")
+    return 0
+
+
+def _alen(r) -> int:
+    """Alignment span on the reference from the CIGAR (M/D/N/=/X)."""
+    import re
+    if r.cigar in ("*", ""):
+        return len(r.seq) if r.seq != "*" else 0
+    return sum(int(n) for n, op in re.findall(r"(\d+)([MIDNSHP=X])", r.cigar)
+               if op in "MDN=X")
+
+
+# ---------------------------------------------------------------------------
+# vcf-sort
+# ---------------------------------------------------------------------------
+
+def cmd_vcf_sort(args) -> int:
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+
+    ds = open_vcf(args.input)
+    header = ds.header
+    recs = list(ds.records())
+    contig_order = {c: i for i, c in enumerate(header.contigs)}
+    recs.sort(key=lambda r: (contig_order.get(r.chrom, 1 << 30), r.pos))
+    w = open_vcf_writer(args.output, header)
+    for r in recs:
+        w.write_record(r)
+    w.close()
+    print(f"wrote {args.output} ({len(recs)} records)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# frontend
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hadoop_bam_tpu",
+        description="TPU-native splittable genomics I/O — CLI verbs "
+                    "(reference parity: cat, index, sort, summarize, view, "
+                    "fixmate, vcf-sort)")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    v = sub.add_parser("view", help="print records as SAM/VCF text")
+    v.add_argument("path")
+    v.add_argument("region", nargs="?", default=None,
+                   help="chr[:start-end] filter")
+    v.add_argument("-H", "--header-only", action="store_true")
+    v.add_argument("-c", "--count", action="store_true")
+    v.add_argument("--no-header", action="store_true")
+    v.set_defaults(fn=cmd_view)
+
+    i = sub.add_parser("index", help="build splitting index sidecar(s)")
+    i.add_argument("paths", nargs="+")
+    i.add_argument("-g", "--granularity", type=int, default=4096)
+    i.add_argument("--flavor", choices=["splitting-bai", "sbi"],
+                   default="splitting-bai")
+    i.set_defaults(fn=cmd_index)
+
+    c = sub.add_parser("cat", help="concatenate same-header BAMs")
+    c.add_argument("output")
+    c.add_argument("inputs", nargs="+")
+    c.set_defaults(fn=cmd_cat)
+
+    s = sub.add_parser("summarize", help="distributed flagstat")
+    s.add_argument("path")
+    s.set_defaults(fn=cmd_summarize)
+
+    so = sub.add_parser("sort", help="sort a BAM")
+    so.add_argument("input")
+    so.add_argument("output")
+    so.add_argument("-n", "--by-name", action="store_true")
+    so.set_defaults(fn=cmd_sort)
+
+    f = sub.add_parser("fixmate", help="fill mate fields on name-grouped BAM")
+    f.add_argument("input")
+    f.add_argument("output")
+    f.set_defaults(fn=cmd_fixmate)
+
+    vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos)")
+    vs.add_argument("input")
+    vs.add_argument("output")
+    vs.set_defaults(fn=cmd_vcf_sort)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,3 +222,47 @@ def test_fragments_to_arrays():
         assert (bases[i, l:] == 5).all()
         code = {"A": 0, "C": 1, "G": 2, "T": 3, "N": 4}
         assert [code[c] for c in f.sequence[:l]] == list(bases[i, :l])
+
+
+# ---------------------------------------------------------------------------
+# review-regression cases
+# ---------------------------------------------------------------------------
+
+def test_crlf_fastq(tmp_path):
+    frags = make_fragments(5, seed=1)
+    text = "".join(f.to_fastq() for f in frags).replace("\n", "\r\n")
+    p = str(tmp_path / "crlf.fastq")
+    open(p, "wb").write(text.encode())
+    got = list(open_fastq(p).records(num_spans=2))
+    assert [g.name for g in got] == [f.name for f in frags]
+    assert got[0].sequence == frags[0].sequence
+
+
+def test_compressed_fastq_single_span(tmp_path):
+    import gzip
+    frags = make_fragments(20, seed=2)
+    p = str(tmp_path / "c.fastq.gz")
+    open(p, "wb").write(gzip.compress(
+        "".join(f.to_fastq() for f in frags).encode()))
+    ds = open_fastq(p)
+    assert len(ds.spans()) == 1  # non-splittable, like Hadoop gzip codecs
+    got = list(ds.records())
+    assert [g.name for g in got] == [f.name for f in frags]
+
+
+def test_dataset_reiteration_and_plan_conflict(fastq_file):
+    path, frags = fastq_file
+    ds = open_fastq(path)
+    a = list(ds.records(num_spans=3))
+    b = list(ds.records())  # fresh iteration after exhaustion
+    assert len(a) == len(b) == len(frags)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        ds.spans(num_spans=8)  # conflicting re-plan must be loud
+
+
+def test_bare_fasta_header_raises():
+    from hadoop_bam_tpu.formats.fasta import FastaError
+    import pytest as _pytest
+    with _pytest.raises(FastaError):
+        parse_fasta(b">\nACGT\n")
