@@ -43,6 +43,7 @@ def __getattr__(name):
         "open_bam": ("hadoop_bam_tpu.api.dataset", "open_bam"),
         "open_sam": ("hadoop_bam_tpu.api.dataset", "open_sam"),
         "open_any_sam": ("hadoop_bam_tpu.api.dataset", "open_any_sam"),
+        "open_cram": ("hadoop_bam_tpu.api.cram_dataset", "open_cram"),
         "open_vcf": ("hadoop_bam_tpu.api.vcf_dataset", "open_vcf"),
         "open_fastq": ("hadoop_bam_tpu.api.read_datasets", "open_fastq"),
         "open_qseq": ("hadoop_bam_tpu.api.read_datasets", "open_qseq"),

@@ -1,4 +1,6 @@
-from hadoop_bam_tpu.tools.cli import main
 import sys
 
-sys.exit(main())
+from hadoop_bam_tpu.tools.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

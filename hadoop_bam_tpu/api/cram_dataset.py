@@ -1,0 +1,73 @@
+"""CramDataset: record-aligned access to one CRAM file.
+
+The dataset face of hb/CRAMInputFormat.java + hb/CRAMRecordReader.java
+(SURVEY.md section 2.3, [VER? 7.1+]): spans align to container boundaries,
+each span decodes independently, and the reference source is resolved from
+config (``cram_reference_source_path`` — the analog of
+``hadoopbam.cram.reference-source-path``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.cram_decode import (
+    FastaReferenceSource, ReferenceSource,
+)
+from hadoop_bam_tpu.formats.cramio import read_cram_header
+from hadoop_bam_tpu.formats.sam import SamRecord
+from hadoop_bam_tpu.split.cram_planner import plan_cram_spans, read_cram_span
+from hadoop_bam_tpu.split.spans import FileByteSpan
+
+
+class CramDataset:
+    def __init__(self, path: str, config: HBamConfig = DEFAULT_CONFIG):
+        self.path = path
+        self.config = config
+        self.header, self._first_container = read_cram_header(path)
+        self._plan: Optional[List[FileByteSpan]] = None
+        self._next_span = 0
+        self._ref_source: Optional[ReferenceSource] = None
+        if config.cram_reference_source_path:
+            self._ref_source = FastaReferenceSource(
+                config.cram_reference_source_path)
+
+    def spans(self, num_spans: Optional[int] = None) -> List[FileByteSpan]:
+        from hadoop_bam_tpu.api.dataset import _check_replan
+        _check_replan(self, num_spans)
+        if self._plan is None:
+            self._plan = plan_cram_spans(self.path, num_spans=num_spans,
+                                         config=self.config)
+            self._plan_num_spans = num_spans
+        return self._plan
+
+    def read_span(self, span: FileByteSpan) -> List[SamRecord]:
+        return read_cram_span(self.path, span, header=self.header,
+                              ref_source=self._ref_source)
+
+    def records(self, num_spans: Optional[int] = None) -> Iterator[SamRecord]:
+        plan = self.spans(num_spans)
+        if self._next_span >= len(plan):
+            self._next_span = 0
+        while self._next_span < len(plan):
+            span = plan[self._next_span]
+            recs = self.read_span(span)
+            self._next_span += 1
+            yield from recs
+
+    # -- checkpoint / resume (same contract as BamDataset) --
+    def state_dict(self) -> Dict:
+        return {"path": self.path,
+                "plan": [s.to_dict() for s in (self._plan or [])],
+                "next_span": self._next_span}
+
+    def load_state_dict(self, state: Dict) -> None:
+        assert state["path"] == self.path
+        self._plan = [FileByteSpan.from_dict(d) for d in state["plan"]] \
+            or None
+        self._next_span = int(state["next_span"])
+
+
+def open_cram(path: str, config: HBamConfig = DEFAULT_CONFIG) -> CramDataset:
+    return CramDataset(path, config)

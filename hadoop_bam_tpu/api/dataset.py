@@ -30,6 +30,17 @@ from hadoop_bam_tpu.split.spans import FileByteSpan, FileVirtualSpan
 from hadoop_bam_tpu.utils.seekable import as_byte_source
 
 
+def _check_replan(ds, num_spans) -> None:
+    """Guard against silently reusing a plan built with a different
+    num_spans (same contract as read_datasets._SpannedDataset.spans)."""
+    cached = getattr(ds, "_plan_num_spans", None)
+    if getattr(ds, "_plan", None) is not None and num_spans is not None \
+            and num_spans != cached:
+        raise ValueError(
+            f"span plan already built with num_spans={cached}; "
+            "open a new dataset to re-plan")
+
+
 class BamDataset:
     """Record-aligned access to one BAM file (hb/BAMInputFormat +
     hb/BAMRecordReader in dataset clothes)."""
@@ -42,9 +53,11 @@ class BamDataset:
         self._next_span = 0
 
     def spans(self, num_spans: Optional[int] = None) -> List[FileVirtualSpan]:
+        _check_replan(self, num_spans)
         if self._plan is None:
             self._plan = plan_bam_spans(self.path, num_spans=num_spans,
                                         config=self.config, header=self.header)
+            self._plan_num_spans = num_spans
         return self._plan
 
     def read_span(self, span: FileVirtualSpan) -> BamBatch:

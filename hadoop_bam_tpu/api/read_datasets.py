@@ -81,8 +81,12 @@ class FastqDataset(_SpannedDataset):
     stream — the reference's behavior for non-splittable Hadoop codecs."""
 
     def _is_compressed(self) -> bool:
-        with scoped_byte_source(self.path) as src:
-            return src.pread(0, 2) == b"\x1f\x8b"
+        cached = getattr(self, "_compressed", None)
+        if cached is None:
+            with scoped_byte_source(self.path) as src:
+                cached = src.pread(0, 2) == b"\x1f\x8b"
+            self._compressed = cached
+        return cached
 
     def _plan_spans(self, num_spans: Optional[int]) -> List[FileByteSpan]:
         if self._is_compressed():

@@ -63,7 +63,10 @@ class VcfDataset:
 
     # -- planning (hb/VCFInputFormat.getSplits) ------------------------------
     def spans(self, num_spans: Optional[int] = None) -> List[Span]:
+        from hadoop_bam_tpu.api.dataset import _check_replan
+        _check_replan(self, num_spans)
         if self._plan is None:
+            self._plan_num_spans = num_spans
             if self.container is VCFContainer.VCF:
                 self._plan = plan_text_spans(
                     self.path, num_spans=num_spans,

@@ -79,7 +79,38 @@ def open_any_sam_writer(path: str, header: SAMHeader,
         return BamShardWriter(path, header, config)
     if container is SAMContainer.SAM:
         return SamShardWriter(path, header, config)
-    raise NotImplementedError(f"writer for {container} (CRAM write: later round)")
+    if container is SAMContainer.CRAM:
+        return CramShardWriter(path, header, config)
+    raise ValueError(f"no writer for container {container}")
+
+
+class CramShardWriter:
+    """CRAM shard writer (hb/KeyIgnoringCRAMOutputFormat.java /
+    hb/KeyIgnoringCRAMRecordWriter.java, [VER? 7.3+]): reference-free CRAM
+    3.0 containers (formats/cram_encode.py); headerless / terminator-less
+    shards concatenate via utils/mergers.merge_cram_shards."""
+
+    def __init__(self, sink, header: SAMHeader,
+                 config: HBamConfig = DEFAULT_CONFIG, **kw):
+        from hadoop_bam_tpu.formats.cramio import CramWriter
+        kw.setdefault("write_header", config.write_header)
+        kw.setdefault("write_eof", config.write_terminator)
+        self._w = CramWriter(sink, header, **kw)
+        self.header = header
+        self.records_written = 0
+
+    def write_sam_record(self, rec: SamRecord) -> None:
+        self._w.write_record(rec)
+        self.records_written += 1
+
+    def close(self) -> None:
+        self._w.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class VcfShardWriter:

@@ -1,0 +1,385 @@
+"""rANS 4x8 entropy codec (CRAM 3.0 block method 4).
+
+[SPEC] CRAMcodecs section "rANS codec": byte-wise range asymmetric numeral
+system with four interleaved 32-bit states, 12-bit normalized frequencies
+(total 4096), lower renormalization bound 0x800000.  Two flavours:
+
+- order-0: one frequency table; state j decodes output positions j mod 4.
+- order-1: 256 context tables keyed on the previous byte; each state decodes
+  one quarter of the output (contexts start at 0 per quarter).
+
+Stream layout::
+
+    order (1) | compressed size of everything after this 9-byte prefix (u32 LE)
+    | uncompressed size (u32 LE) | frequency table | 4 initial states (u32 LE
+    each) interleaved with renormalization bytes
+
+Frequency tables use the spec's run-length symbol encoding (a run byte follows
+the second of two consecutive symbols) and 1-or-2-byte frequencies (values ≥
+128 stored big-endian-ish as ``0x80|hi, lo``).
+
+Reference-side equivalent: htsjdk/htslib's rANS implementations, reached from
+Hadoop-BAM through htsjdk CRAM decode (SURVEY.md section 2.8: "Pallas rANS
+decode kernel" is the TPU goal; ops/rans.py builds the batched device decode
+on top of the table layout produced here).
+
+The hot decode loop is vectorized with NumPy across the 4 states (order-0)
+and across the 4 quarters (order-1); Python-level iteration is only over
+output positions / 4.
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+RANS_ORDER_0 = 0
+RANS_ORDER_1 = 1
+
+TF_SHIFT = 12
+TOTFREQ = 1 << TF_SHIFT          # 4096
+RANS_LOW = 1 << 23               # renormalization lower bound
+
+
+class RansError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Frequency normalization + table serialization
+# ---------------------------------------------------------------------------
+
+def _normalize_freqs(counts: np.ndarray, total: int = TOTFREQ) -> np.ndarray:
+    """Scale raw counts so they sum to exactly ``total``, keeping every
+    present symbol's frequency >= 1."""
+    counts = counts.astype(np.int64)
+    n = int(counts.sum())
+    if n == 0:
+        return np.zeros(256, dtype=np.int64)
+    freqs = (counts * total) // n
+    freqs[(counts > 0) & (freqs == 0)] = 1
+    # fix rounding drift by adjusting the largest bucket
+    drift = total - int(freqs.sum())
+    if drift != 0:
+        j = int(np.argmax(freqs))
+        if freqs[j] + drift < 1:
+            raise RansError("cannot normalize frequency table")
+        freqs[j] += drift
+    return freqs
+
+
+def _write_freq(f: int) -> bytes:
+    if f < 128:
+        return bytes([f])
+    return bytes([0x80 | (f >> 8), f & 0xFF])
+
+
+def _read_freq(buf: bytes, pos: int) -> Tuple[int, int]:
+    b = buf[pos]
+    if b < 0x80:
+        return b, pos + 1
+    return ((b & 0x7F) << 8) | buf[pos + 1], pos + 2
+
+
+def _write_symbol_table(freqs: np.ndarray, emit_freq=True) -> bytes:
+    """Symbols present, ascending, with the spec's RLE: after two consecutive
+    present symbols, a run byte counts how many MORE consecutive follow."""
+    out = bytearray()
+    syms = [j for j in range(256) if freqs[j] > 0]
+    rle = 0
+    for idx, j in enumerate(syms):
+        if rle > 0:
+            rle -= 1
+        else:
+            out.append(j)
+            if j > 0 and freqs[j - 1] > 0:
+                # count consecutive symbols after j
+                rle = 0
+                k = j + 1
+                while k < 256 and freqs[k] > 0:
+                    rle += 1
+                    k += 1
+                out.append(rle)
+        if emit_freq:
+            out += _write_freq(int(freqs[j]))
+    out.append(0)
+    return bytes(out)
+
+
+def _read_symbol_table(buf: bytes, pos: int, read_value) -> Tuple[dict, int]:
+    """Inverse of _write_symbol_table; ``read_value(sym, pos) -> pos`` consumes
+    the per-symbol payload and records it."""
+    values = {}
+    rle = 0
+    j = buf[pos]
+    pos += 1
+    while True:
+        pos = read_value(j, pos)
+        values[j] = True
+        if rle > 0:
+            rle -= 1
+            j += 1
+        else:
+            nxt = buf[pos]
+            pos += 1
+            if nxt == j + 1:
+                rle = buf[pos]
+                pos += 1
+                j = nxt
+            elif nxt == 0:
+                break
+            else:
+                j = nxt
+    return values, pos
+
+
+# ---------------------------------------------------------------------------
+# Order-0
+# ---------------------------------------------------------------------------
+
+def _enc_put(x: int, freq: int, cum: int, out: bytearray) -> int:
+    x_max = ((RANS_LOW >> TF_SHIFT) << 8) * freq
+    while x >= x_max:
+        out.append(x & 0xFF)
+        x >>= 8
+    return ((x // freq) << TF_SHIFT) + (x % freq) + cum
+
+
+def _encode_order0(data: bytes) -> bytes:
+    counts = np.bincount(np.frombuffer(data, dtype=np.uint8), minlength=256)
+    freqs = _normalize_freqs(counts)
+    cum = np.zeros(257, dtype=np.int64)
+    np.cumsum(freqs, out=cum[1:])
+    table = _write_symbol_table(freqs)
+
+    n = len(data)
+    states = [RANS_LOW] * 4
+    rev = bytearray()
+    # encode in reverse; state j%4 owns position j
+    for i in range(n - 1, -1, -1):
+        s = data[i]
+        states[i & 3] = _enc_put(states[i & 3], int(freqs[s]), int(cum[s]), rev)
+    body = b"".join(struct.pack("<I", st) for st in states) + bytes(rev[::-1])
+    return bytes([RANS_ORDER_0]) + struct.pack(
+        "<II", len(table) + len(body), n) + table + body
+
+
+def _read_freq_table_order0(buf: bytes, pos: int
+                            ) -> Tuple[np.ndarray, int]:
+    freqs = np.zeros(256, dtype=np.int64)
+
+    def read_value(sym, p):
+        f, p = _read_freq(buf, p)
+        freqs[sym] = f
+        return p
+
+    _, pos = _read_symbol_table(buf, pos, read_value)
+    return freqs, pos
+
+
+def _decode_order0(buf: bytes, pos: int, out_size: int) -> bytes:
+    freqs, pos = _read_freq_table_order0(buf, pos)
+    cum = np.zeros(257, dtype=np.int64)
+    np.cumsum(freqs, out=cum[1:])
+    # dense lookup: 12-bit slot -> symbol
+    slot2sym = np.zeros(TOTFREQ, dtype=np.uint8)
+    for s in range(256):
+        if freqs[s]:
+            slot2sym[cum[s]:cum[s + 1]] = s
+
+    data = np.frombuffer(buf, dtype=np.uint8)
+    states = np.frombuffer(buf[pos:pos + 16], dtype="<u4").astype(np.int64)
+    ptr = pos + 16
+    out = np.zeros(out_size, dtype=np.uint8)
+    freqs_i = freqs
+    cum_i = cum[:256]
+
+    # vectorized over the 4 interleaved states; serial over positions/4
+    i = 0
+    while i + 4 <= out_size:
+        m = states & (TOTFREQ - 1)
+        syms = slot2sym[m]
+        out[i:i + 4] = syms
+        states = freqs_i[syms] * (states >> TF_SHIFT) + m - cum_i[syms]
+        # renormalize: each state consumes bytes until >= RANS_LOW
+        for j in range(4):
+            x = states[j]
+            while x < RANS_LOW:
+                x = (x << 8) | data[ptr]
+                ptr += 1
+            states[j] = x
+        i += 4
+    for j in range(out_size - i):
+        x = states[j]
+        m = x & (TOTFREQ - 1)
+        s = slot2sym[m]
+        out[i + j] = s
+        x = freqs_i[s] * (x >> TF_SHIFT) + m - cum_i[s]
+        while x < RANS_LOW:
+            x = (x << 8) | data[ptr]
+            ptr += 1
+        states[j] = x
+    return out.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Order-1
+# ---------------------------------------------------------------------------
+
+def _encode_order1(data: bytes) -> bytes:
+    n = len(data)
+    arr = np.frombuffer(data, dtype=np.uint8)
+    q = n >> 2
+    # quarter starts; context of each quarter's first byte is 0
+    starts = [0, q, 2 * q, 3 * q]
+    counts = np.zeros((256, 256), dtype=np.int64)
+    prev = np.concatenate([[0], arr[:-1]])
+    for st in starts:
+        prev[st] = 0
+    np.add.at(counts, (prev, arr), 1)
+
+    freqs = np.zeros((256, 256), dtype=np.int64)
+    cums = np.zeros((256, 257), dtype=np.int64)
+    for c in range(256):
+        if counts[c].sum():
+            freqs[c] = _normalize_freqs(counts[c])
+            np.cumsum(freqs[c], out=cums[c][1:])
+
+    # serialize: outer RLE over contexts, inner order-0-style table per ctx
+    out = bytearray()
+    ctx_present = counts.sum(axis=1) > 0
+
+    def write_ctx_tables() -> bytes:
+        buf = bytearray()
+        rle = 0
+        ctxs = [c for c in range(256) if ctx_present[c]]
+        for c in ctxs:
+            if rle > 0:
+                rle -= 1
+            else:
+                buf.append(c)
+                if c > 0 and ctx_present[c - 1]:
+                    rle = 0
+                    k = c + 1
+                    while k < 256 and ctx_present[k]:
+                        rle += 1
+                        k += 1
+                    buf.append(rle)
+            buf += _write_symbol_table(freqs[c])
+        buf.append(0)
+        return bytes(buf)
+
+    table = write_ctx_tables()
+
+    # encode the 4 quarters in reverse, one state per quarter; the last
+    # quarter (state 3) also covers the tail remainder
+    ends = [q, 2 * q, 3 * q, n]
+    states = [RANS_LOW] * 4
+    rev = bytearray()
+    # interleaved emission in reverse over the longest quarter
+    lens = [ends[j] - starts[j] for j in range(4)]
+    maxlen = max(lens) if n else 0
+    for step in range(maxlen - 1, -1, -1):
+        for j in (3, 2, 1, 0):
+            if step < lens[j]:
+                i = starts[j] + step
+                ctx = int(prev[i])
+                s = int(arr[i])
+                states[j] = _enc_put(states[j], int(freqs[ctx][s]),
+                                     int(cums[ctx][s]), rev)
+    body = b"".join(struct.pack("<I", st) for st in states) + bytes(rev[::-1])
+    return bytes([RANS_ORDER_1]) + struct.pack(
+        "<II", len(table) + len(body), n) + table + body
+
+
+def _decode_order1(buf: bytes, pos: int, out_size: int) -> bytes:
+    freqs = np.zeros((256, 256), dtype=np.int64)
+    cums = np.zeros((256, 257), dtype=np.int64)
+    slot2sym = np.zeros((256, TOTFREQ), dtype=np.uint8)
+
+    # outer context table with the same RLE grammar
+    rle = 0
+    c = buf[pos]
+    pos += 1
+    while True:
+        f, pos2 = _read_freq_table_order0(buf, pos)
+        freqs[c] = f
+        np.cumsum(f, out=cums[c][1:])
+        for s in range(256):
+            if f[s]:
+                slot2sym[c, cums[c][s]:cums[c][s + 1]] = s
+        pos = pos2
+        if rle > 0:
+            rle -= 1
+            c += 1
+        else:
+            nxt = buf[pos]
+            pos += 1
+            if nxt == c + 1:
+                rle = buf[pos]
+                pos += 1
+                c = nxt
+            elif nxt == 0:
+                break
+            else:
+                c = nxt
+    data = np.frombuffer(buf, dtype=np.uint8)
+    states = np.frombuffer(buf[pos:pos + 16], dtype="<u4").astype(np.int64)
+    ptr = pos + 16
+
+    q = out_size >> 2
+    starts = [0, q, 2 * q, 3 * q]
+    ends = [q, 2 * q, 3 * q, out_size]
+    out = np.zeros(out_size, dtype=np.uint8)
+    ctxs = [0, 0, 0, 0]
+    idx = list(starts)
+    # serial over the longest quarter; 4 states stepped together
+    done = [idx[j] >= ends[j] for j in range(4)]
+    while not all(done):
+        for j in range(4):
+            if done[j]:
+                continue
+            x = int(states[j])
+            m = x & (TOTFREQ - 1)
+            ctx = ctxs[j]
+            s = int(slot2sym[ctx, m])
+            out[idx[j]] = s
+            x = int(freqs[ctx][s]) * (x >> TF_SHIFT) + m - int(cums[ctx][s])
+            while x < RANS_LOW:
+                x = (x << 8) | int(data[ptr])
+                ptr += 1
+            states[j] = x
+            ctxs[j] = s
+            idx[j] += 1
+            if idx[j] >= ends[j]:
+                done[j] = True
+    return out.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def rans4x8_encode(data: bytes, order: int = 0) -> bytes:
+    if len(data) == 0:
+        return bytes([order]) + struct.pack("<II", 0, 0)
+    if order == RANS_ORDER_1 and len(data) >= 4:
+        return _encode_order1(data)
+    return _encode_order0(data)
+
+
+def rans4x8_decode(payload: bytes) -> bytes:
+    if len(payload) < 9:
+        raise RansError("rANS stream shorter than its 9-byte prefix")
+    order = payload[0]
+    comp_size, out_size = struct.unpack_from("<II", payload, 1)
+    if out_size == 0:
+        return b""
+    if len(payload) < 9 + comp_size:
+        raise RansError("truncated rANS stream")
+    if order == RANS_ORDER_0:
+        return _decode_order0(payload, 9, out_size)
+    if order == RANS_ORDER_1:
+        return _decode_order1(payload, 9, out_size)
+    raise RansError(f"unknown rANS order {order}")

@@ -1,0 +1,327 @@
+"""CRAM 3.0 slice/container encoder: SAM records → CRAM bytes.
+
+Writer policy (all spec-legal choices, [SPEC] CRAM 3.0 sections 8, 10):
+
+- one slice per container; landmarks = [0]; no embedded reference;
+- reference-free encoding (``RR=false``): match stretches of the CIGAR are
+  stored verbatim through the ``b`` (bases) feature, insertions/soft-clips
+  through ``I``/``S``, so decode needs no FASTA — the same policy htslib uses
+  when writing CRAM without a reference;
+- every record is mate-detached (CF bit 0x2): NS/NP/TS carried explicitly,
+  giving exact RNEXT/PNEXT/TLEN round-trips;
+- read names preserved (``RN=true``), absolute alignment positions
+  (``AP=false``);
+- integer series as EXTERNAL/ITF8 blocks, byte-array series as
+  BYTE_ARRAY_LEN(EXTERNAL, EXTERNAL), read names as BYTE_ARRAY_STOP(0x00);
+- block compression: gzip, except quality scores which go through our
+  rANS-4x8 order-1 codec (cram_codecs.py) like htslib's default profile.
+
+Reference-side equivalent: htsjdk's CRAM writer as driven by
+hb/KeyIgnoringCRAMOutputFormat.java / hb/KeyIgnoringCRAMRecordWriter.java
+(SURVEY.md section 2.4, [VER? 7.3+]).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_bam_tpu.formats.bam import (
+    SAMHeader, encode_tag, parse_cigar_string,
+)
+from hadoop_bam_tpu.formats.cram import (
+    Block, CRAMError, COMPRESSION_HEADER, CORE_DATA, EXTERNAL_DATA, GZIP,
+    MAPPED_SLICE_HEADER, RANS4x8, RAW, build_container, write_itf8,
+)
+from hadoop_bam_tpu.formats.cram_decode import (
+    ByteArrayLenEncoding, ByteArrayStopEncoding, CF_DETACHED, CF_QUAL_STORED,
+    CF_UNKNOWN_BASES, CompressionHeader, ExternalEncoding, MATE_REVERSE,
+    MATE_UNMAPPED, SliceHeader, tag_key,
+)
+from hadoop_bam_tpu.formats.sam import SamRecord
+
+# content-id assignments for this writer (any distinct ids are legal)
+_INT_SERIES = ["BF", "CF", "RI", "RL", "AP", "RG", "MF", "NS", "NP", "TS",
+               "TL", "FN", "FP", "MQ", "DL", "RS", "PD", "HC"]
+_BYTE_SERIES = ["FC", "BA", "QS", "BS"]
+_ARRAY_SERIES = ["BB", "IN", "SC"]   # BYTE_ARRAY_LEN(len ext, val ext)
+_RN_STOP = 0x00
+
+
+class _Streams:
+    """Per-series byte accumulators for one slice."""
+
+    def __init__(self):
+        self.ints: Dict[str, bytearray] = {k: bytearray() for k in _INT_SERIES}
+        self.bytes_: Dict[str, bytearray] = {k: bytearray()
+                                             for k in _BYTE_SERIES}
+        self.arr_len: Dict[str, bytearray] = {k: bytearray()
+                                              for k in _ARRAY_SERIES}
+        self.arr_val: Dict[str, bytearray] = {k: bytearray()
+                                              for k in _ARRAY_SERIES}
+        self.names = bytearray()
+        self.tag_len: Dict[int, bytearray] = {}
+        self.tag_val: Dict[int, bytearray] = {}
+
+    def put_int(self, key: str, v: int):
+        self.ints[key] += write_itf8(v)
+
+    def put_byte(self, key: str, v: int):
+        self.bytes_[key].append(v & 0xFF)
+
+    def put_array(self, key: str, data: bytes):
+        self.arr_len[key] += write_itf8(len(data))
+        self.arr_val[key] += data
+
+    def put_name(self, name: bytes):
+        if bytes([_RN_STOP]) in name:
+            raise CRAMError("read name contains the RN stop byte")
+        self.names += name + bytes([_RN_STOP])
+
+    def put_tag(self, key: int, raw: bytes):
+        self.tag_len.setdefault(key, bytearray())
+        self.tag_val.setdefault(key, bytearray())
+        self.tag_len[key] += write_itf8(len(raw))
+        self.tag_val[key] += raw
+
+
+def _ref_span(cigar: List[Tuple[int, str]]) -> int:
+    return sum(n for n, op in cigar if op in "MDN=X")
+
+
+def encode_container(records: List[SamRecord], header: SAMHeader,
+                     record_counter: int) -> bytes:
+    """Encode one container holding one slice of ``records``."""
+    name_to_id = {n: i for i, n in enumerate(header.ref_names)}
+    rg_ids = _read_group_ids(header)
+
+    def rid_of(rname: str) -> int:
+        if rname == "*":
+            return -1
+        if rname not in name_to_id:
+            raise CRAMError(f"record reference {rname!r} not in header")
+        return name_to_id[rname]
+
+    streams = _Streams()
+    tag_dict: List[bytes] = []
+    tag_dict_index: Dict[bytes, int] = {}
+    mapped = [r for r in records if not r.flag & 0x4 and r.pos > 0]
+    multi_ref = len({rid_of(r.rname) for r in records}) > 1
+    if multi_ref:
+        slice_ref = -2
+        slice_start = slice_span = 0
+    elif records and rid_of(records[0].rname) >= 0:
+        slice_ref = rid_of(records[0].rname)
+        starts = [r.pos for r in mapped] or [0]
+        ends = [r.pos + max(0, _ref_span(parse_cigar_string(r.cigar))
+                            if r.cigar != "*" else len(r.seq)) - 1
+                for r in mapped] or [0]
+        slice_start = min(starts)
+        slice_span = max(ends) - slice_start + 1 if mapped else 0
+    else:
+        slice_ref, slice_start, slice_span = -1, 0, 0
+
+    n_bases = 0
+    for rec in records:
+        n_bases += _encode_record(rec, streams, rid_of, rg_ids, multi_ref,
+                                  tag_dict, tag_dict_index)
+
+    comp = _build_compression_header(streams, tag_dict)
+
+    # blocks: compression header, slice header, core, externals
+    ext_blocks: List[Block] = []
+    content_ids: List[int] = []
+    for cid, data, method in _external_payloads(streams):
+        if data:
+            ext_blocks.append(Block(EXTERNAL_DATA, cid, bytes(data), method))
+            content_ids.append(cid)
+
+    slice_hdr = SliceHeader(
+        ref_seq_id=slice_ref, start=slice_start, span=slice_span,
+        n_records=len(records), record_counter=record_counter,
+        n_blocks=1 + len(ext_blocks), content_ids=content_ids,
+        embedded_ref_id=-1)
+    comp_block = Block(COMPRESSION_HEADER, 0, comp.to_bytes(), GZIP)
+    slice_block = Block(MAPPED_SLICE_HEADER, 0, slice_hdr.to_bytes(), RAW)
+    core_block = Block(CORE_DATA, 0, b"", RAW)
+
+    comp_bytes = comp_block.to_bytes()
+    blocks = [comp_block, slice_block, core_block] + ext_blocks
+    return build_container(
+        blocks, ref_seq_id=slice_ref, start=slice_start, span=slice_span,
+        n_records=len(records), record_counter=record_counter, bases=n_bases,
+        landmarks=[len(comp_bytes)])
+
+
+def _read_group_ids(header: SAMHeader) -> List[str]:
+    ids = []
+    for line in header.text.splitlines():
+        if line.startswith("@RG"):
+            for f in line.split("\t")[1:]:
+                if f.startswith("ID:"):
+                    ids.append(f[3:])
+    return ids
+
+
+def _encode_record(rec: SamRecord, s: _Streams, rid_of, rg_ids: List[str],
+                   multi_ref: bool, tag_dict: List[bytes],
+                   tag_dict_index: Dict[bytes, int]) -> int:
+    """Append one record to the slice streams; returns its base count."""
+    flag = rec.flag
+    bf = flag & ~(MATE_REVERSE | MATE_UNMAPPED)
+    has_qual = rec.qual != "*" and rec.qual != ""
+    has_seq = rec.seq != "*" and rec.seq != ""
+    rl = len(rec.seq) if has_seq else 0
+    cf = CF_DETACHED
+    if has_qual:
+        cf |= CF_QUAL_STORED
+    if not has_seq and not flag & 0x4:
+        cf |= CF_UNKNOWN_BASES
+    s.put_int("BF", bf)
+    s.put_int("CF", cf)
+    if multi_ref:
+        s.put_int("RI", rid_of(rec.rname))
+    s.put_int("RL", rl)
+    s.put_int("AP", rec.pos)
+    rg = -1
+    for tag, typ, val in rec.tags:
+        if tag == "RG" and typ == "Z" and val in rg_ids:
+            rg = rg_ids.index(val)
+    s.put_int("RG", rg)
+    s.put_name(rec.qname.encode("ascii"))
+    # detached mate fields
+    mf = ((1 if flag & MATE_REVERSE else 0)
+          | (2 if flag & MATE_UNMAPPED else 0))
+    s.put_int("MF", mf)
+    if rec.rnext == "=":
+        s.put_int("NS", rid_of(rec.rname))
+    else:
+        s.put_int("NS", rid_of(rec.rnext))
+    s.put_int("NP", rec.pnext)
+    s.put_int("TS", rec.tlen)
+    # tags (RG kept inline too when it was an inline tag: we re-emit all tags
+    # except RG which rides its series when resolvable)
+    out_tags = [(t, ty, v) for (t, ty, v) in rec.tags
+                if not (t == "RG" and ty == "Z" and rg >= 0)]
+    sig = b"".join(t.encode() + ty.encode() for t, ty, v in out_tags)
+    if sig not in tag_dict_index:
+        tag_dict_index[sig] = len(tag_dict)
+        tag_dict.append(sig)
+    s.put_int("TL", tag_dict_index[sig])
+    for t, ty, v in out_tags:
+        raw = encode_tag(t, ty, v)[3:]
+        s.put_tag(tag_key(t, ty), raw)
+
+    if not flag & 0x4:
+        _encode_mapped(rec, s, has_seq, has_qual, rl)
+    else:
+        if has_seq:
+            for ch in rec.seq:
+                s.put_byte("BA", ord(ch))
+        if has_qual:
+            for ch in rec.qual:
+                s.put_byte("QS", ord(ch) - 33)
+    return rl
+
+
+def _encode_mapped(rec: SamRecord, s: _Streams, has_seq: bool,
+                   has_qual: bool, rl: int) -> None:
+    features: List[Tuple[int, str, object]] = []
+    if has_seq and rec.cigar != "*":
+        rp = 1
+        for n, op in parse_cigar_string(rec.cigar):
+            if op in "M=X":
+                features.append((rp, "b",
+                                 rec.seq[rp - 1:rp - 1 + n].encode()))
+                rp += n
+            elif op == "I":
+                features.append((rp, "I",
+                                 rec.seq[rp - 1:rp - 1 + n].encode()))
+                rp += n
+            elif op == "S":
+                features.append((rp, "S",
+                                 rec.seq[rp - 1:rp - 1 + n].encode()))
+                rp += n
+            elif op == "D":
+                features.append((rp, "D", n))
+            elif op == "N":
+                features.append((rp, "N", n))
+            elif op == "P":
+                features.append((rp, "P", n))
+            elif op == "H":
+                features.append((rp, "H", n))
+            else:
+                raise CRAMError(f"unsupported CIGAR op {op!r}")
+        if rp - 1 != rl:
+            raise CRAMError(
+                f"CIGAR consumes {rp - 1} read bases but SEQ has {rl}")
+    elif has_seq:
+        # mapped record with '*' CIGAR: store bases as one stretch
+        features.append((1, "b", rec.seq.encode()))
+    s.put_int("FN", len(features))
+    prev = 0
+    for fpos, code, val in features:
+        s.put_byte("FC", ord(code))
+        s.put_int("FP", fpos - prev)
+        prev = fpos
+        if code in ("b", "I", "S"):
+            s.put_array({"b": "BB", "I": "IN", "S": "SC"}[code], val)
+        else:
+            s.put_int({"D": "DL", "N": "RS", "P": "PD", "H": "HC"}[code], val)
+    s.put_int("MQ", rec.mapq)
+    if has_qual:
+        for ch in rec.qual:
+            s.put_byte("QS", ord(ch) - 33)
+
+
+# content-id layout: ints 1..18, bytes 20..23, array len 30../val 40..,
+# names 50, tags 100+k
+_CID_INT = {k: 1 + i for i, k in enumerate(_INT_SERIES)}
+_CID_BYTE = {k: 20 + i for i, k in enumerate(_BYTE_SERIES)}
+_CID_ALEN = {k: 30 + i for i, k in enumerate(_ARRAY_SERIES)}
+_CID_AVAL = {k: 40 + i for i, k in enumerate(_ARRAY_SERIES)}
+_CID_NAMES = 50
+
+
+def _tag_cids(key: int) -> Tuple[int, int]:
+    return 100 + 2 * key, 101 + 2 * key
+
+
+def _external_payloads(s: _Streams):
+    for k, data in s.ints.items():
+        yield _CID_INT[k], data, GZIP
+    for k, data in s.bytes_.items():
+        # qualities through rANS like htslib's default; rest gzip
+        yield _CID_BYTE[k], data, (RANS4x8 if k == "QS" else GZIP)
+    for k in _ARRAY_SERIES:
+        yield _CID_ALEN[k], s.arr_len[k], GZIP
+        yield _CID_AVAL[k], s.arr_val[k], GZIP
+    yield _CID_NAMES, s.names, GZIP
+    for key in s.tag_len:
+        lo, hi = _tag_cids(key)
+        yield lo, s.tag_len[key], GZIP
+        yield hi, s.tag_val[key], GZIP
+
+
+def _build_compression_header(s: _Streams, tag_dict: List[bytes]
+                              ) -> CompressionHeader:
+    comp = CompressionHeader(
+        read_names_included=True, ap_delta=False, reference_required=False,
+        tag_dict=[_sig_to_line(sig) for sig in tag_dict] or [[]])
+    for k in _INT_SERIES:
+        comp.data_series[k] = ExternalEncoding(_CID_INT[k])
+    for k in _BYTE_SERIES:
+        comp.data_series[k] = ExternalEncoding(_CID_BYTE[k])
+    for k in _ARRAY_SERIES:
+        comp.data_series[k] = ByteArrayLenEncoding(
+            ExternalEncoding(_CID_ALEN[k]), ExternalEncoding(_CID_AVAL[k]))
+    comp.data_series["RN"] = ByteArrayStopEncoding(_RN_STOP, _CID_NAMES)
+    for key in s.tag_len:
+        lo, hi = _tag_cids(key)
+        comp.tag_encodings[key] = ByteArrayLenEncoding(
+            ExternalEncoding(lo), ExternalEncoding(hi))
+    return comp
+
+
+def _sig_to_line(sig: bytes) -> List[Tuple[str, str]]:
+    return [(sig[i:i + 2].decode(), chr(sig[i + 2]))
+            for i in range(0, len(sig), 3)]

@@ -1,0 +1,95 @@
+"""CRAM split planning: container-boundary-aligned spans.
+
+Rebuild of hb/CRAMInputFormat.java's ``getSplits``: the reference scans CRAM
+container headers (htsjdk ``CramContainerIterator``) and snaps Hadoop's byte
+splits to container starts, because containers are CRAM's independently
+decodable unit (SURVEY.md sections 2.3 and 5 — the long-context analog: the
+container grid is the parallelism axis).  Same idea here: one cheap header
+scan yields every container offset; spans are container runs balanced by
+compressed size.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.cram import (
+    FileDefinition, read_container, scan_container_offsets,
+)
+from hadoop_bam_tpu.formats.cramio import decode_container, read_cram_header
+from hadoop_bam_tpu.split.spans import FileByteSpan
+
+
+def scan_cram_containers(source) -> List[Tuple[int, int, int]]:
+    """[(offset, byte length, n_records)] for every data container (header
+    container included with n_records=0; EOF container excluded)."""
+    if isinstance(source, (bytes, bytearray)):
+        buf = bytes(source)
+    else:
+        with open(source, "rb") as f:
+            buf = f.read()
+    FileDefinition.from_bytes(buf)
+    out = []
+    for off, hdr in scan_container_offsets(buf):
+        if hdr.is_eof:
+            break
+        # container total size = header size + block section length
+        end = _container_end(buf, off, hdr)
+        out.append((off, end - off, hdr.n_records))
+    return out
+
+
+def _container_end(buf: bytes, off: int, hdr) -> int:
+    from hadoop_bam_tpu.formats.cram import ContainerHeader
+    _, after = ContainerHeader.from_buffer(buf, off)
+    return after + hdr.length
+
+
+def plan_cram_spans(path: str, *, num_spans: Optional[int] = None,
+                    config: HBamConfig = DEFAULT_CONFIG
+                    ) -> List[FileByteSpan]:
+    """Group data containers into spans; each span starts and ends exactly on
+    container boundaries (the hb/CRAMInputFormat.java contract)."""
+    containers = scan_cram_containers(path)
+    data = [(off, size) for off, size, n_rec in containers[1:]]
+    if not data:
+        return []
+    total = sum(s for _, s in data)
+    if num_spans is None:
+        span_bytes = config.split_size
+        num_spans = max(1, -(-total // span_bytes))
+    num_spans = min(num_spans, len(data))
+    target = total / num_spans
+    spans: List[FileByteSpan] = []
+    cur_start = data[0][0]
+    acc = 0
+    for i, (off, size) in enumerate(data):
+        acc += size
+        last = i == len(data) - 1
+        if acc >= target * (len(spans) + 1) - 1e-9 or last:
+            end = off + size
+            spans.append(FileByteSpan(path, cur_start, end))
+            if not last:
+                cur_start = data[i + 1][0]
+    return spans
+
+
+def read_cram_span(source, span: FileByteSpan, *, header: SAMHeader,
+                   ref_source=None):
+    """Decode every container whose start lies in [span.start, span.end) —
+    the per-span idempotent unit of work (hb/CRAMRecordReader.java)."""
+    from hadoop_bam_tpu.formats.sam import SamRecord  # noqa: F401
+    if isinstance(source, (bytes, bytearray)):
+        buf = bytes(source)
+    else:
+        with open(source, "rb") as f:
+            buf = f.read()
+    out = []
+    pos = span.start
+    while pos < min(span.end, len(buf)):
+        cont, pos = read_container(buf, pos)
+        if cont.header.is_eof:
+            break
+        out.extend(decode_container(cont, header, ref_source))
+    return out

@@ -41,21 +41,14 @@ def cmd_view(args) -> int:
     if path.endswith((".vcf", ".vcf.gz", ".bcf")):
         return _view_vcf(args)
     fmt = sniff_sam_container(path)
-    if fmt is SAMContainer.CRAM:
-        from hadoop_bam_tpu.api.dataset import open_any_sam
-        ds = open_any_sam(path)
-        if args.header_only:
-            sys.stdout.write(ds.header.to_sam_text())
-            return 0
-        n = 0
-        for rec in ds.records():
-            if not args.count:
-                sys.stdout.write(rec.to_line() + "\n")
-            n += 1
-        if args.count:
-            print(n)
-        return 0
     return _view_sam(args, fmt)
+
+
+def _overlaps_region(rec, region) -> bool:
+    """True iff the alignment's reference span intersects [start, end]."""
+    if rec.rname != region[0]:
+        return False
+    return rec.pos <= region[2] and rec.pos + max(1, _alen(rec)) - 1 >= region[1]
 
 
 def _view_sam(args, fmt) -> int:
@@ -74,30 +67,28 @@ def _view_sam(args, fmt) -> int:
     if not args.count and not args.no_header:
         sys.stdout.write(header.to_sam_text())
     from hadoop_bam_tpu.api.dataset import BamDataset
+    from hadoop_bam_tpu.formats.sam import SamRecord
     if isinstance(ds, BamDataset):
         for batch in ds.batches():
             import numpy as np
             idx = np.arange(len(batch))
             if region:
-                pos = batch.pos + 1
-                keep = (batch.refid == rid) & (pos <= region[2]) & \
-                       (pos + 400 >= region[1])  # overlap window pre-filter
+                # conservative vectorized pre-filter (start bound only; the
+                # exact CIGAR-span overlap check runs on the decoded line)
+                keep = (batch.refid == rid) & (batch.pos + 1 <= region[2])
                 idx = idx[keep]
             for i in idx:
                 line = batch.to_sam_line(int(i))
-                if region:
-                    # exact overlap check on the decoded line's pos
-                    p = int(line.split("\t", 4)[3])
-                    if not (p <= region[2]):
-                        continue
+                if region and not _overlaps_region(SamRecord.from_line(line),
+                                                   region):
+                    continue
                 if args.count:
                     n += 1
                 else:
                     sys.stdout.write(line + "\n")
     else:
         for rec in ds.records():
-            if region and (rec.rname != region[0]
-                           or not (region[1] <= rec.pos <= region[2])):
+            if region and not _overlaps_region(rec, region):
                 continue
             if args.count:
                 n += 1
@@ -155,6 +146,13 @@ def cmd_cat(args) -> int:
     from hadoop_bam_tpu.api.dataset import open_bam
 
     header, _ = read_bam_header(args.inputs[0])
+    for path in args.inputs[1:]:
+        other, _ = read_bam_header(path)
+        if (other.ref_names != header.ref_names
+                or other.ref_lengths != header.ref_lengths):
+            print(f"error: {path} has a different reference dictionary than "
+                  f"{args.inputs[0]}; refusing to concatenate", file=sys.stderr)
+            return 1
     with BamWriter(args.output, header) as w:
         for path in args.inputs:
             ds = open_bam(path)
@@ -194,7 +192,7 @@ def cmd_sort(args) -> int:
     for b in batches:
         if args.by_name:
             for i in range(len(b)):
-                keys.append((b.read_name(i), i))
+                keys.append(b.read_name(i))
                 recs.append(b.record_bytes(i))
         else:
             refid = b.refid.astype(np.int64)
@@ -209,9 +207,9 @@ def cmd_sort(args) -> int:
     so = "queryname" if args.by_name else "coordinate"
     if "@HD" in text:
         import re
-        text = re.sub(r"(@HD[^\n]*?)(\tSO:\S+)?(\n)",
-                      lambda m: m.group(1) + f"\tSO:{so}" + m.group(3),
-                      text, count=1)
+        # drop any existing SO tag, then append the new one to the @HD line
+        text = re.sub(r"(@HD[^\n]*?)\tSO:\S*", r"\1", text, count=1)
+        text = re.sub(r"(@HD[^\n]*)", rf"\1\tSO:{so}", text, count=1)
     else:
         text = f"@HD\tVN:1.6\tSO:{so}\n" + text
     header2 = type(header)(text=text, ref_names=header.ref_names,

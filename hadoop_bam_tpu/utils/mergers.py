@@ -102,3 +102,25 @@ def merge_bcf_shards(shard_paths: Sequence[str], out_path: str,
 def shard_paths_in_dir(dir_path: str, pattern: str = "part-*") -> List[str]:
     """Sorted shard discovery (the reference merges MR part-r-NNNNN files)."""
     return sorted(glob.glob(os.path.join(dir_path, pattern)))
+
+
+def merge_cram_shards(shard_paths: Sequence[str], out_path: str,
+                      header: SAMHeader) -> None:
+    """CRAM flavor of hb/util/SAMFileMerger.java: file definition + header
+    container once, concatenated headerless shard containers (containers are
+    self-contained, so they concatenate legally), one EOF container."""
+    from hadoop_bam_tpu.formats.cram import EOF_CONTAINER, FileDefinition
+    from hadoop_bam_tpu.formats.cramio import _header_container_bytes
+
+    def _strip_cram_eof(data: bytes) -> bytes:
+        while data.endswith(EOF_CONTAINER):
+            data = data[:-len(EOF_CONTAINER)]
+        return data
+
+    with open(out_path, "wb") as out:
+        out.write(FileDefinition().to_bytes())
+        out.write(_header_container_bytes(header))
+        for p in shard_paths:
+            with open(p, "rb") as f:
+                out.write(_strip_cram_eof(f.read()))
+        out.write(EOF_CONTAINER)

@@ -1,0 +1,279 @@
+"""CRAM 3.0 family tests: varints, rANS, round-trips, splits, mergers.
+
+Mirrors the reference's test strategy for CRAM (SURVEY.md section 4,
+test/TestCRAMInputFormat.java): round-trip through our writer/reader, split
+spans over container boundaries yielding every record exactly once."""
+import io
+import random
+
+import pytest
+
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.cram import (
+    EOF_CONTAINER, FileDefinition, read_container, read_itf8, read_ltf8,
+    scan_container_offsets, write_itf8, write_ltf8,
+)
+from hadoop_bam_tpu.formats.cram_codecs import rans4x8_decode, rans4x8_encode
+from hadoop_bam_tpu.formats.cram_decode import (
+    substitute_base, substitution_code,
+)
+from hadoop_bam_tpu.formats.cramio import (
+    CramWriter, iter_cram_records, read_cram, read_cram_header, write_cram,
+)
+from hadoop_bam_tpu.formats.sam import SamRecord
+from hadoop_bam_tpu.split.cram_planner import (
+    plan_cram_spans, read_cram_span, scan_cram_containers,
+)
+
+from fixtures import make_header, make_records
+
+
+# the canonical CRAM 3.0 EOF marker, fixed by the spec [SPEC section 9]
+CANONICAL_EOF = bytes.fromhex(
+    "0f000000ffffffff0fe0454f4600000000010005bdd94f000100060601000100"
+    "0100ee63014b")
+
+
+def test_eof_container_is_canonical():
+    assert EOF_CONTAINER == CANONICAL_EOF
+
+
+@pytest.mark.parametrize("v", [0, 1, 127, 128, 0x3FFF, 0x4000, 0x1FFFFF,
+                               0x200000, 0xFFFFFFF, 0x10000000, 0x7FFFFFFF,
+                               -1, -2, -100])
+def test_itf8_roundtrip(v):
+    enc = write_itf8(v)
+    got, pos = read_itf8(enc, 0)
+    assert got == v and pos == len(enc)
+
+
+@pytest.mark.parametrize("v", [0, 127, 128, 1 << 14, 1 << 21, 1 << 28,
+                               1 << 35, 1 << 42, 1 << 49, 1 << 56,
+                               (1 << 62) - 3, -1, -5])
+def test_ltf8_roundtrip(v):
+    enc = write_ltf8(v)
+    got, pos = read_ltf8(enc, 0)
+    assert got == v and pos == len(enc)
+
+
+@pytest.mark.parametrize("order", [0, 1])
+def test_rans_roundtrip(order):
+    rng = random.Random(7)
+    cases = [b"", b"x", b"AAAAAAA", bytes(range(256)) * 3,
+             bytes(rng.choice(b"ACGTN") for _ in range(4097)),
+             bytes(rng.randrange(256) for _ in range(1001))]
+    for data in cases:
+        assert rans4x8_decode(rans4x8_encode(data, order=order)) == data
+
+
+def test_rans_compresses_skewed_data():
+    data = bytes(random.Random(3).choice(b"!!!!!####&&+5") for _ in range(8192))
+    assert len(rans4x8_encode(data, order=0)) < len(data) // 2
+
+
+def test_substitution_matrix_inverse():
+    from hadoop_bam_tpu.formats.cram_decode import DEFAULT_SUBS_MATRIX
+    for ref in "ACGTN":
+        for read in "ACGTN":
+            if ref == read:
+                continue
+            code = substitution_code(DEFAULT_SUBS_MATRIX, ref, read)
+            assert substitute_base(DEFAULT_SUBS_MATRIX, ref, code) == read
+
+
+def _tricky_records():
+    return [
+        SamRecord("p1", 99, "chr1", 100, 60, "5M2I3M1D5S", "=", 300, 250,
+                  "ACGTACGTACGTACG", "IIIIIIIIIIIIIII",
+                  [("NM", "i", 2), ("MD", "Z", "8^T0")]),
+        SamRecord("p1", 147, "chr1", 300, 60, "10M5H", "=", 100, -250,
+                  "ACGTACGTAC", "JJJJJJJJJJ", [("NM", "i", 0)]),
+        SamRecord("u1", 4, "*", 0, 0, "*", "*", 0, 0, "ACGTN", "IIIII"),
+        SamRecord("noq", 16, "chr2", 42, 30, "10M", "*", 0, 0,
+                  "ACGTACGTAC", "*", [("XX", "Z", "hello"),
+                                      ("XF", "f", 1.5),
+                                      ("XB", "B", ("i", [1, -2, 300]))]),
+        SamRecord("noseq", 0, "chr2", 50, 20, "*", "*", 0, 0, "*", "*"),
+        SamRecord("skip", 0, "chr3", 10, 55, "4M100N4M2P4M", "*", 0, 0,
+                  "ACGTACGTACGT", "KKKKKKKKKKKK"),
+    ]
+
+
+def test_cram_roundtrip_tricky_records():
+    header = make_header()
+    recs = _tricky_records()
+    sink = io.BytesIO()
+    write_cram(sink, header, recs)
+    h2, out = read_cram(sink.getvalue())
+    assert h2.ref_names == header.ref_names
+    assert [r.to_line() for r in out] == [r.to_line() for r in recs]
+
+
+def test_cram_roundtrip_bulk(tmp_path):
+    header = make_header()
+    recs = make_records(header, 500, seed=11)
+    path = str(tmp_path / "bulk.cram")
+    write_cram(path, header, recs)
+    _, out = read_cram(path)
+    assert [r.to_line() for r in out] == [r.to_line() for r in recs]
+
+
+def test_cram_multi_container_and_scan(tmp_path):
+    header = make_header()
+    recs = make_records(header, 250, seed=5)
+    path = str(tmp_path / "multi.cram")
+    with CramWriter(path, header, records_per_container=40) as w:
+        w.write_records(recs)
+    containers = scan_cram_containers(path)
+    # 1 header container + ceil(250/40) data containers
+    assert len(containers) == 1 + 7
+    assert sum(n for _, _, n in containers) == 250
+    out = list(iter_cram_records(path))
+    assert [r.to_line() for r in out] == [r.to_line() for r in recs]
+
+
+@pytest.mark.parametrize("num_spans", [1, 2, 3, 5, 100])
+def test_cram_spans_cover_exactly_once(tmp_path, num_spans):
+    header = make_header()
+    recs = make_records(header, 300, seed=6)
+    path = str(tmp_path / "spans.cram")
+    with CramWriter(path, header, records_per_container=37) as w:
+        w.write_records(recs)
+    spans = plan_cram_spans(path, num_spans=num_spans)
+    assert len(spans) <= num_spans
+    # spans are disjoint, ordered, container-aligned
+    offsets = {off for off, _, _ in scan_cram_containers(path)}
+    got = []
+    for s in spans:
+        assert s.start in offsets
+        got.extend(read_cram_span(path, s, header=header))
+    assert [r.to_line() for r in got] == [r.to_line() for r in recs]
+
+
+def test_cram_dataset_and_dispatch(tmp_path):
+    import hadoop_bam_tpu as hb
+    header = make_header()
+    recs = make_records(header, 120, seed=9)
+    path = str(tmp_path / "ds.cram")
+    with CramWriter(path, header, records_per_container=30) as w:
+        w.write_records(recs)
+    ds = hb.open_any_sam(path)
+    from hadoop_bam_tpu.api.cram_dataset import CramDataset
+    assert isinstance(ds, CramDataset)
+    out = list(ds.records(num_spans=4))
+    assert [r.to_line() for r in out] == [r.to_line() for r in recs]
+    # checkpoint/resume at span granularity: drain span 0, snapshot, resume
+    ds2 = hb.open_cram(path)
+    spans = ds2.spans(num_spans=4)
+    assert 2 <= len(spans) <= 4
+    n0 = len(ds2.read_span(spans[0]))
+    it = ds2.records(num_spans=4)
+    first = [next(it) for _ in range(n0)]
+    state = ds2.state_dict()
+    ds3 = hb.open_cram(path)
+    ds3.load_state_dict(state)
+    rest = list(ds3.records())
+    assert len(first) + len(rest) == len(recs)
+    assert [r.to_line() for r in rest] == \
+        [r.to_line() for r in recs][n0:]
+
+
+def test_cram_shard_writer_and_merger(tmp_path):
+    from hadoop_bam_tpu.api.writers import CramShardWriter
+    from hadoop_bam_tpu.config import HBamConfig
+    from hadoop_bam_tpu.utils.mergers import merge_cram_shards
+    header = make_header()
+    recs = make_records(header, 90, seed=13)
+    shard_cfg = HBamConfig(write_header=False, write_terminator=False)
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"part-{i:05d}")
+        with CramShardWriter(p, header, shard_cfg) as w:
+            for r in recs[i * 30:(i + 1) * 30]:
+                w.write_sam_record(r)
+        paths.append(p)
+    out_path = str(tmp_path / "merged.cram")
+    merge_cram_shards(paths, out_path, header)
+    _, out = read_cram(out_path)
+    assert [r.to_line() for r in out] == [r.to_line() for r in recs]
+
+
+def test_reference_based_decode_with_substitutions(tmp_path):
+    """Hand-build a slice that uses reference-filled matches + an X
+    substitution + a deletion, and decode it against a FASTA source —
+    the htslib-style CRAM our reader must also understand."""
+    from hadoop_bam_tpu.formats.cram_decode import (
+        CompressionHeader, DEFAULT_SUBS_MATRIX, ExternalEncoding,
+        FastaReferenceSource, SliceHeader, decode_slice_records, tag_key,
+    )
+    from hadoop_bam_tpu.formats.cram import write_itf8
+
+    ref_seq = "ACGTACGTACGTACGTACGT"
+    fasta = f">chr1\n{ref_seq}\n".encode()
+    ref_source = FastaReferenceSource(fasta)
+
+    comp = CompressionHeader(read_names_included=True, ap_delta=True,
+                             reference_required=True,
+                             substitution_matrix=DEFAULT_SUBS_MATRIX)
+    series = ["BF", "CF", "RL", "AP", "RG", "MF", "NS", "NP", "TS", "TL",
+              "FN", "FP", "MQ", "DL", "BS", "FC"]
+    streams = {k: bytearray() for k in series}
+    streams["RN"] = bytearray()
+    for i, k in enumerate(series):
+        comp.data_series[k] = ExternalEncoding(i)
+    from hadoop_bam_tpu.formats.cram_decode import ByteArrayStopEncoding
+    comp.data_series["RN"] = ByteArrayStopEncoding(0, 100)
+
+    def put(k, v):
+        streams[k] += write_itf8(v)
+
+    # one record: 4M from ref, X substitution at 5 (ref A -> read C),
+    # 2D deletion, 5M from ref; read length 10
+    put("BF", 0)
+    put("CF", 2)          # detached, no stored quals
+    put("RL", 10)
+    put("AP", 3)          # delta vs slice start 2 -> pos 5
+    put("RG", -1)
+    streams["RN"] += b"href\x00"
+    put("MF", 0)
+    put("NS", -1)
+    put("NP", 0)
+    put("TS", 0)
+    put("TL", 0)
+    put("FN", 2)
+    streams["FC"].append(ord("X"))
+    put("FP", 5)
+    code = substitution_code(DEFAULT_SUBS_MATRIX, ref_seq[4 + 4], "C")
+    streams["BS"] = bytearray([code])
+    comp.data_series["BS"] = ExternalEncoding(series.index("BS"))
+    streams["FC"].append(ord("D"))
+    put("FP", 1)          # delta: feature pos 6
+    put("DL", 2)
+    put("MQ", 37)
+
+    slice_hdr = SliceHeader(ref_seq_id=0, start=2, span=15, n_records=1,
+                            record_counter=0, n_blocks=0)
+    external = {i: bytes(streams[k]) for i, k in enumerate(series)}
+    external[100] = bytes(streams["RN"])
+    recs = decode_slice_records(comp, slice_hdr, b"", external,
+                                ["chr1"], ref_source)
+    assert len(recs) == 1
+    r = recs[0]
+    assert r.pos == 5
+    assert r.cigar == "5M2D5M"
+    # 4M from ref 5..8, sub C at ref 9, 2D skips ref 10..11, 5M from 12..16
+    expect = ref_seq[4:8] + "C" + ref_seq[11:16]
+    assert r.seq == expect
+    assert r.mapq == 37
+
+
+def test_cram_header_roundtrip(tmp_path):
+    header = make_header()
+    path = str(tmp_path / "h.cram")
+    write_cram(path, header, [])
+    h2, first = read_cram_header(path)
+    assert h2.text == header.text
+    assert h2.ref_names == header.ref_names
+    data = open(path, "rb").read()
+    assert data[:4] == b"CRAM"
+    assert data.endswith(CANONICAL_EOF)
