@@ -51,6 +51,7 @@ class BamDataset:
         self.header, self.first_voffset = read_bam_header(path)
         self._plan: Optional[List[FileVirtualSpan]] = None
         self._next_span = 0
+        self._intervals = None
 
     def spans(self, num_spans: Optional[int] = None) -> List[FileVirtualSpan]:
         _check_replan(self, num_spans)
@@ -61,7 +62,16 @@ class BamDataset:
         return self._plan
 
     def read_span(self, span: FileVirtualSpan) -> BamBatch:
-        return read_bam_span(self.path, span, header=self.header)
+        batch = read_bam_span(self.path, span, header=self.header)
+        if self.config.bam_intervals:
+            from hadoop_bam_tpu.split.intervals import (
+                filter_batch, parse_intervals,
+            )
+            if self._intervals is None:
+                self._intervals = parse_intervals(self.config.bam_intervals,
+                                                  self.header.ref_names)
+            batch = filter_batch(batch, self._intervals, self.header)
+        return batch
 
     def batches(self, num_spans: Optional[int] = None) -> Iterator[BamBatch]:
         """Yield one SoA batch per span, resumable via state_dict();
@@ -96,6 +106,14 @@ class BamDataset:
         self._next_span = int(state["next_span"])
 
     def flagstat(self, mesh=None) -> Dict[str, int]:
+        if self.config.bam_intervals:
+            # the mesh path reads spans directly and would bypass the
+            # interval filter; count over filtered batches instead
+            from hadoop_bam_tpu.ops.flagstat import flagstat_from_batch
+            stats: Dict[str, int] = {}
+            for span in self.spans():
+                flagstat_from_batch(self.read_span(span), stats)
+            return stats
         from hadoop_bam_tpu.parallel.pipeline import flagstat_file
         return flagstat_file(self.path, mesh=mesh, config=self.config,
                              header=self.header)

@@ -65,6 +65,7 @@ _HADOOP_KEY_MAP = {
     "hadoopbam.cram.reference-source-path": "cram_reference_source_path",
     "hadoopbam.vcf.output-format": "vcf_output_format",
     "hadoopbam.bam.intervals": "bam_intervals",
+    "hadoopbam.bam.keep-paired-reads-together": "keep_paired_reads_together",
     "hbam.fastq-input.base-quality-encoding": "fastq_base_quality_encoding",
     "hbam.fastq-input.filter-failed-qc": "fastq_filter_failed_qc",
     "hbam.qseq-input.base-quality-encoding": "qseq_base_quality_encoding",
@@ -97,6 +98,9 @@ class HBamConfig:
     # --- interval filtering (hb/BAMInputFormat.java upstream 7.7+) ---
     # "chr20:1-100000,chr21" style; None = no filtering.
     bam_intervals: Optional[str] = None
+    # keep both reads of a pair in the same span when the BAM is
+    # queryname-grouped (hb/BAMInputFormat.java upstream 7.9+):
+    keep_paired_reads_together: bool = False
 
     # --- split planning ---
     split_size: int = 128 * 1024 * 1024   # analog of HDFS block size splits
@@ -134,7 +138,8 @@ def _coerce(kwargs: dict) -> dict:
             out[k] = BaseQualityEncoding.parse(out[k], default)
     for k in ("trust_exts", "vcf_trust_exts", "fastq_filter_failed_qc",
               "qseq_filter_failed_qc", "write_header", "write_terminator",
-              "use_splitting_index", "use_native"):
+              "use_splitting_index", "use_native",
+              "keep_paired_reads_together"):
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
     return out

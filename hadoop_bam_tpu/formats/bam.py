@@ -287,6 +287,38 @@ class BamBatch:
     @property
     def record_end(self): return self.offsets + 4 + self.block_size
 
+    def reference_span(self) -> np.ndarray:
+        """Per-record alignment span on the reference (bases consumed by
+        M/D/N/=/X CIGAR ops), vectorized over the ragged cigar arrays.
+        Records with '*' CIGAR fall back to l_seq (htsjdk's convention for
+        computing an end when no cigar is present)."""
+        if "ref_span" in self._cache:
+            return self._cache["ref_span"]
+        counts = self.n_cigar.astype(np.int64)
+        total = int(counts.sum())
+        span = np.where(self.l_seq > 0, self.l_seq, 0).astype(np.int64)
+        if total:
+            firsts = np.cumsum(counts) - counts
+            flat = np.arange(total, dtype=np.int64) - np.repeat(firsts, counts)
+            offs = np.repeat(self.cigar_offset, counts) + 4 * flat
+            vals = _gather_le(self.data, offs, 4, False)
+            oplen = vals >> 4
+            op = vals & 0xF
+            consumes = (op == 0) | (op == 2) | (op == 3) | (op == 7) | (op == 8)
+            seg = np.repeat(np.arange(counts.size), counts)
+            cig_span = np.zeros(counts.size, dtype=np.int64)
+            np.add.at(cig_span, seg, (oplen * consumes).astype(np.int64))
+            span = np.where(counts > 0, cig_span, span)
+        self._cache["ref_span"] = span
+        return span
+
+    def select(self, indices: np.ndarray) -> "BamBatch":
+        """Row subset sharing the same byte buffer (zero-copy on data)."""
+        idx = np.asarray(indices)
+        return BamBatch(
+            self.data, self.offsets[idx], header=self.header,
+            voffsets=None if self.voffsets is None else self.voffsets[idx])
+
     # Per-record accessors (scalar paths for tests/CLI; batch paths in ops/)
     def read_name(self, i: int) -> str:
         o = int(self.name_offset[i]); l = int(self.l_read_name[i])

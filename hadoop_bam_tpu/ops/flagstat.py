@@ -99,3 +99,50 @@ def format_flagstat(stats: Dict[str, int]) -> str:
         f"{g['mate_on_different_chr_mapq5']} + 0 with mate mapped to a different chr (mapQ>=5)",
     ]
     return "\n".join(lines)
+
+
+def flagstat_from_batch(batch, stats=None) -> Dict[str, int]:
+    """Host (NumPy) flagstat over one BamBatch — the same counters as the
+    jitted column path, for contexts that already hold a decoded batch
+    (e.g. interval-filtered datasets).  Accumulates into ``stats``."""
+    import numpy as np
+
+    flag = batch.flag.astype(np.int64)
+    refid = batch.refid
+    mate_refid = batch.mate_refid
+    mapq = batch.mapq
+
+    def has(bit):
+        return (flag & bit) != 0
+
+    secondary = has(FSECONDARY)
+    supplementary = has(FSUPPLEMENTARY)
+    primary = ~secondary & ~supplementary
+    mapped = ~has(FUNMAP)
+    paired = has(FPAIRED)
+    mate_mapped = ~has(FMUNMAP)
+    both = paired & mapped & mate_mapped
+    diff_chr = both & (mate_refid != refid) & (refid >= 0) & (mate_refid >= 0)
+    out = {
+        "total": flag.size,
+        "primary": int(primary.sum()),
+        "secondary": int(secondary.sum()),
+        "supplementary": int(supplementary.sum()),
+        "duplicates": int(has(FDUP).sum()),
+        "primary_duplicates": int((primary & has(FDUP)).sum()),
+        "mapped": int(mapped.sum()),
+        "primary_mapped": int((primary & mapped).sum()),
+        "paired": int(paired.sum()),
+        "read1": int((paired & has(FREAD1)).sum()),
+        "read2": int((paired & has(FREAD2)).sum()),
+        "properly_paired": int((paired & has(FPROPER_PAIR) & mapped).sum()),
+        "with_itself_and_mate_mapped": int(both.sum()),
+        "singletons": int((paired & mapped & ~mate_mapped).sum()),
+        "mate_on_different_chr": int(diff_chr.sum()),
+        "mate_on_different_chr_mapq5": int((diff_chr & (mapq >= 5)).sum()),
+    }
+    if stats is not None:
+        for k, v in out.items():
+            stats[k] = stats.get(k, 0) + v
+        return stats
+    return out

@@ -84,6 +84,11 @@ def plan_bam_spans(path: str, *, num_spans: Optional[int] = None,
                 boundaries.append(size << 16 if v is None else
                                   max(v, first_voffset))
         end_sentinel = size << 16
+        if config.keep_paired_reads_together:
+            boundaries = [boundaries[0]] + [
+                _next_name_group_start(path, b, header, first_voffset,
+                                       end_sentinel, index, guesser)
+                for b in boundaries[1:]]
         boundaries.append(end_sentinel)
         spans: List[FileVirtualSpan] = []
         for i in range(len(boundaries) - 1):
@@ -93,6 +98,48 @@ def plan_bam_spans(path: str, *, num_spans: Optional[int] = None,
         return spans
     finally:
         src.close()
+
+
+def _next_name_group_start(path: str, boundary: int, header: SAMHeader,
+                           first_voffset: int, end_sentinel: int,
+                           index, guesser) -> int:
+    """Move a split boundary forward so it never separates records sharing a
+    query name (hb/BAMInputFormat.java keep-paired-reads-together, upstream
+    7.9+): on a queryname-grouped BAM, the record at the boundary stays with
+    its pair when both share the name of the record just before the boundary.
+
+    Strategy: recover the name of the record preceding the boundary by
+    decoding a small window ending at the boundary, then walk forward from
+    the boundary until the name changes.
+    """
+    if boundary <= first_voffset or boundary >= end_sentinel:
+        return boundary
+    coffset = boundary >> 16
+    back_c = max(first_voffset >> 16, coffset - (1 << 18))
+    if index is not None:
+        back_v = index.first_record_at_or_after(back_c)
+    else:
+        back_v = guesser.guess_next_record_start(back_c)
+        back_v = first_voffset if back_v is None else max(back_v,
+                                                          first_voffset)
+    prev_name = None
+    if back_v < boundary:
+        ctx = read_bam_span(path, FileVirtualSpan(path, back_v, boundary),
+                            header=header)
+        if len(ctx):
+            prev_name = ctx.read_name(len(ctx) - 1)
+    if prev_name is None:
+        return boundary
+    # forward window: 256 KiB compressed is far beyond any real name group
+    fwd_end = min(end_sentinel, (coffset + (1 << 18)) << 16)
+    fwd = read_bam_span(path, FileVirtualSpan(path, boundary, fwd_end),
+                        header=header)
+    for i in range(len(fwd)):
+        if fwd.read_name(i) != prev_name:
+            return int(fwd.voffsets[i])
+    if fwd_end >= end_sentinel:
+        return end_sentinel   # the group runs to EOF: merge the tail
+    return boundary   # name group exceeds the window: leave the boundary
 
 
 def read_bam_span(source, span: FileVirtualSpan,

@@ -1,0 +1,165 @@
+"""Interval filtering + keep-paired-reads-together split option.
+
+Reference parity: hadoopbam.bam.intervals (hb/BAMInputFormat.java 7.7+) and
+hadoopbam.bam.keep-paired-reads-together (7.9+)."""
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.config import HBamConfig
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.bamio import BamWriter
+from hadoop_bam_tpu.formats.sam import SamRecord
+from hadoop_bam_tpu.split.intervals import (
+    Interval, IntervalError, parse_interval, parse_intervals,
+)
+
+from fixtures import make_header, make_records
+
+
+@pytest.mark.parametrize("text,expect", [
+    ("chr1", Interval("chr1", 1, (1 << 31) - 1)),
+    ("chr1:500", Interval("chr1", 500, 500)),
+    ("chr1:500-", Interval("chr1", 500, (1 << 31) - 1)),
+    ("chr1:500-900", Interval("chr1", 500, 900)),
+    ("chr1:1,000-2,000", Interval("chr1", 1000, 2000)),
+])
+def test_parse_interval(text, expect):
+    assert parse_interval(text) == expect
+
+
+def test_parse_interval_errors():
+    with pytest.raises(IntervalError):
+        parse_interval("chr1:9-3")
+    assert len(parse_intervals("chr1:1-10, chr2 ,chr3:5")) == 3
+
+
+def test_parse_intervals_resolves_colon_contigs():
+    # GRCh38-style contig containing ':' resolves verbatim when known
+    ivs = parse_intervals("HLA-A*01:01", ref_names=["chr1", "HLA-A*01:01"])
+    assert ivs == [Interval("HLA-A*01:01")]
+
+
+def test_unknown_contig_raises(tmp_path):
+    import hadoop_bam_tpu as hb
+    header = make_header()
+    path = _write(tmp_path, header, make_records(header, 5, seed=1),
+                  "unk.bam")
+    ds = hb.open_bam(path, HBamConfig(bam_intervals="chrX:1-100"))
+    with pytest.raises(IntervalError):
+        list(ds.batches())
+
+
+def test_flagstat_respects_intervals(tmp_path):
+    import hadoop_bam_tpu as hb
+    header = make_header()
+    recs = make_records(header, 200, seed=33)
+    path = _write(tmp_path, header, recs, "fs.bam")
+    ds = hb.open_bam(path, HBamConfig(bam_intervals="chr2"))
+    stats = ds.flagstat()
+    expect = sum(1 for r in recs if r.rname == "chr2")
+    assert stats["total"] == expect
+
+
+def _write(tmp_path, header, recs, name="t.bam"):
+    path = str(tmp_path / name)
+    with BamWriter(path, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    return path
+
+
+def test_interval_filtering_exact_overlap(tmp_path):
+    import hadoop_bam_tpu as hb
+    header = make_header()
+    # reads with known spans: pos 100 len 50 (ends 149), a deletion-extended
+    # one, a soft-clipped one whose span is shorter than its seq
+    recs = [
+        SamRecord("a", 0, "chr1", 100, 60, "50M", "*", 0, 0, "A" * 50, "I" * 50),
+        SamRecord("b", 0, "chr1", 200, 60, "10M30D10M", "*", 0, 0,
+                  "A" * 20, "I" * 20),                     # span 200..249
+        SamRecord("c", 0, "chr1", 300, 60, "40S10M", "*", 0, 0,
+                  "A" * 50, "I" * 50),                     # span 300..309
+        SamRecord("d", 0, "chr2", 100, 60, "50M", "*", 0, 0, "A" * 50, "I" * 50),
+    ]
+    path = _write(tmp_path, header, recs)
+
+    def names(intervals):
+        cfg = HBamConfig(bam_intervals=intervals)
+        ds = hb.open_bam(path, cfg)
+        return [b.read_name(i) for b in ds.batches() for i in range(len(b))]
+
+    assert names("chr1:140-199") == ["a"]          # overlaps a's tail only
+    assert names("chr1:150-199") == []             # gap between a and b
+    assert names("chr1:249-249") == ["b"]          # deletion extends b's span
+    assert names("chr1:310-400") == []             # soft clip does not
+    assert names("chr1:309-400") == ["c"]
+    assert names("chr2") == ["d"]
+    assert sorted(names("chr1:100-300,chr2")) == ["a", "b", "c", "d"]
+
+
+def test_interval_filtering_bulk_matches_bruteforce(tmp_path):
+    import hadoop_bam_tpu as hb
+    from hadoop_bam_tpu.tools.cli import _alen
+    header = make_header()
+    recs = make_records(header, 400, seed=21)
+    path = _write(tmp_path, header, recs)
+    iv = "chr1:200000-600000,chr3:1-50000"
+    cfg = HBamConfig(bam_intervals=iv)
+    got = {b.read_name(i) for b in hb.open_bam(path, cfg).batches()
+           for i in range(len(b))}
+    expect = set()
+    for r in recs:
+        end = r.pos + max(1, _alen(r)) - 1
+        if r.rname == "chr1" and r.pos <= 600000 and end >= 200000:
+            expect.add(r.qname)
+        if r.rname == "chr3" and r.pos <= 50000:
+            expect.add(r.qname)
+    assert got == expect
+
+
+def test_keep_paired_reads_together(tmp_path):
+    import hadoop_bam_tpu as hb
+    header = make_header()
+    # queryname-grouped BAM: every name appears exactly twice, adjacent
+    recs = []
+    for i in range(600):
+        for j, flag in enumerate((99, 147)):
+            l = 100
+            recs.append(SamRecord(
+                f"pair{i:05d}", flag, "chr1", 1000 + i, 60, f"{l}M",
+                "=", 1000 + i, l, "A" * l, "I" * l))
+    path = _write(tmp_path, header, recs)
+    cfg = HBamConfig(keep_paired_reads_together=True, split_size=1 << 16)
+    ds = hb.open_bam(path, cfg)
+    spans = ds.spans(num_spans=7)
+    assert len(spans) >= 2
+    all_names = []
+    for span in spans:
+        b = ds.read_span(span)
+        names = [b.read_name(i) for i in range(len(b))]
+        all_names.extend(names)
+        # no span starts in the middle of a name group
+        counts = {}
+        for n in names:
+            counts[n] = counts.get(n, 0) + 1
+        # every name in this span appears exactly twice (whole pairs only)
+        assert all(c == 2 for c in counts.values()), (span, counts)
+    assert all_names == [r.qname for r in recs]
+
+
+def test_reference_span_column(tmp_path):
+    header = make_header()
+    recs = [
+        SamRecord("a", 0, "chr1", 10, 60, "10M5I10M", "*", 0, 0,
+                  "A" * 25, "I" * 25),
+        SamRecord("b", 0, "chr1", 10, 60, "5S10M100N10M", "*", 0, 0,
+                  "A" * 25, "I" * 25),
+        SamRecord("c", 4, "*", 0, 0, "*", "*", 0, 0, "A" * 30, "I" * 30),
+    ]
+    import hadoop_bam_tpu as hb
+    path = _write(tmp_path, header, recs)
+    ds = hb.open_bam(path)
+    b = next(iter(ds.batches()))
+    assert list(b.reference_span()) == [20, 120, 30]
+    sub = b.select(np.array([2, 0]))
+    assert [sub.read_name(i) for i in range(len(sub))] == ["c", "a"]
