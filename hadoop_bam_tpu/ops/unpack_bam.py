@@ -46,19 +46,58 @@ FIXED_FIELDS: Dict[str, Tuple[int, int, bool]] = {
 
 PREFIX = 36
 
+ALL_FIELDS: Tuple[str, ...] = tuple(FIXED_FIELDS)
 
-def _fields_from_tile(tile: jnp.ndarray) -> Dict[str, jnp.ndarray]:
-    """tile: [N, 36] uint8 -> dict of int32 columns (fused elementwise)."""
+# Pushdown projection for flagstat: only the columns the reduction reads
+# cross the host->device link (11 bytes/record instead of 36).
+FLAGSTAT_PROJECTION: Tuple[str, ...] = ("flag", "refid", "mate_refid", "mapq")
+
+
+def projection_row_bytes(fields: Tuple[str, ...]) -> int:
+    return sum(FIXED_FIELDS[name][1] for name in fields)
+
+
+def projection_ranges(fields: Tuple[str, ...]) -> "list[tuple[int, int]]":
+    """(src_offset, length) copy ranges for the host row packer, with
+    adjacent source ranges merged (the full-field projection collapses to a
+    single 36-byte memcpy)."""
+    ranges: list[tuple[int, int]] = []
+    for name in fields:
+        off, width, _ = FIXED_FIELDS[name]
+        if ranges and ranges[-1][0] + ranges[-1][1] == off:
+            ranges[-1] = (ranges[-1][0], ranges[-1][1] + width)
+        else:
+            ranges.append((off, width))
+    return ranges
+
+
+def unpack_projected_tile(tile: jnp.ndarray, fields: Tuple[str, ...]
+                          ) -> Dict[str, jnp.ndarray]:
+    """tile: [N, row_bytes] uint8, rows packed per ``fields`` order ->
+    dict of int32 columns (fused elementwise, no gather)."""
     t = tile.astype(jnp.uint32)
     out: Dict[str, jnp.ndarray] = {}
-    for name, (off, width, signed) in FIXED_FIELDS.items():
+    off = 0
+    for name in fields:
+        _, width, _signed = FIXED_FIELDS[name]
         acc = t[:, off]
         for k in range(1, width):
             acc = acc | (t[:, off + k] << (8 * k))
-        col = acc.astype(jnp.int32) if (signed or width == 4) else \
-            acc.astype(jnp.int32)
-        out[name] = col
+        out[name] = acc.astype(jnp.int32)
+        off += width
     return out
+
+
+def unpack_fixed_fields_tile(tile: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """tile: [N, 36] uint8 -> dict of int32 columns (fused elementwise).
+
+    The dense-tile entry point: when the host packs each record's 36-byte
+    fixed prefix contiguously (the columnar transfer layout — ~20x fewer
+    bytes over the interconnect than shipping whole inflated spans), field
+    extraction is pure strided slicing, no gather at all.  The fixed prefix
+    is exactly the all-fields projection: FIXED_FIELDS covers bytes 0..35
+    contiguously in declaration order."""
+    return unpack_projected_tile(tile, ALL_FIELDS)
 
 
 @jax.jit
@@ -68,7 +107,7 @@ def unpack_fixed_fields(data: jnp.ndarray, offsets: jnp.ndarray
     Returns dict of int32 [N] columns for every fixed field."""
     idx = offsets[:, None] + jnp.arange(PREFIX, dtype=offsets.dtype)[None, :]
     tile = data[idx]  # [N, 36] uint8 gather
-    return _fields_from_tile(tile)
+    return unpack_fixed_fields_tile(tile)
 
 
 def unpack_fixed_fields_pallas(data: jnp.ndarray, offsets: jnp.ndarray,
@@ -90,7 +129,7 @@ def unpack_fixed_fields_pallas(data: jnp.ndarray, offsets: jnp.ndarray,
         idx = offs[:, None] + jax.lax.broadcasted_iota(
             jnp.int32, (block_n, PREFIX), 1)
         tile = data_ref[idx]
-        cols = _fields_from_tile(tile)
+        cols = unpack_fixed_fields_tile(tile)
         for ref, name in zip(out_refs, FIXED_FIELDS):
             ref[:] = cols[name]
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 import concurrent.futures as cf
 import dataclasses
 import functools
-import threading
+import os
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -32,7 +32,11 @@ from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.bam import SAMHeader
 from hadoop_bam_tpu.ops import inflate as inflate_ops
 from hadoop_bam_tpu.ops.flagstat import flagstat_from_columns
-from hadoop_bam_tpu.ops.unpack_bam import unpack_fixed_fields
+from hadoop_bam_tpu.ops.unpack_bam import (
+    ALL_FIELDS, FLAGSTAT_PROJECTION, PREFIX, projection_ranges,
+    projection_row_bytes, unpack_fixed_fields, unpack_fixed_fields_tile,
+    unpack_projected_tile,
+)
 from hadoop_bam_tpu.split.planners import plan_bam_spans
 from hadoop_bam_tpu.split.spans import FileVirtualSpan
 from hadoop_bam_tpu.utils.seekable import as_byte_source
@@ -41,8 +45,9 @@ from hadoop_bam_tpu.utils.seekable import as_byte_source
 @dataclasses.dataclass(frozen=True)
 class DecodeGeometry:
     """Static shapes of one device's slice of a span batch (jit contract)."""
-    bytes_cap: int = 1 << 24       # inflated bytes per device per step
+    bytes_cap: int = 1 << 24       # inflated bytes per device per step (span mode)
     records_cap: int = 1 << 18     # record offsets per device per step
+    tile_records: int = 1 << 18    # records per device per step (prefix-tile mode)
 
     def round_trip_bytes(self) -> int:
         return self.bytes_cap + 4 * self.records_cap
@@ -57,14 +62,18 @@ class HostSpanBatch:
     voffsets: List[np.ndarray]  # per-device per-record virtual offsets
 
 
-def decode_span_host(source, span: FileVirtualSpan, geometry: DecodeGeometry,
-                     check_crc: bool = False,
-                     inflate_backend: str = "auto",
-                     ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
+def _decode_span_core(source, span: FileVirtualSpan,
+                      check_crc: bool = False,
+                      inflate_backend: str = "auto",
+                      packed_walker=None,
+                      want_voffs: bool = True,
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 Optional[np.ndarray]]:
     """Fetch + inflate one span and walk its records (host stage).
 
-    Returns (data[bytes_cap], offsets[records_cap], n_records, voffsets[n]).
-    Only records *starting* inside the span are owned (reference reader
+    Returns (data, offsets, voffsets, rows) — unpadded; ``rows`` is the
+    packed row tile when ``packed_walker`` is given (else None).  Only
+    records *starting* inside the span are owned (reference reader
     contract); the final record may extend into the following blocks, which
     are fetched as needed.
     """
@@ -116,22 +125,41 @@ def decode_span_host(source, span: FileVirtualSpan, geometry: DecodeGeometry,
     #    buffer end — append following blocks and re-walk until it completes
     #    (reference reader contract: the last record may extend past the
     #    split's end voffset).
+    rows = None
     while True:
-        offs, tail = inflate_ops.walk_records(data, start=start_u)
+        if packed_walker is not None:
+            rows, offs, tail = packed_walker(data, start_u, end_inflated)
+        else:
+            offs, tail = inflate_ops.walk_records(data, start=start_u)
         if tail < end_inflated and next_c < src.size:
             next_c += append_block(next_c)
             continue
         break
-    offs = offs[offs < max(end_inflated, 1)]
+    keep = int(np.searchsorted(offs, max(end_inflated, 1)))  # offs ascend
+    offs = offs[:keep]
+    if rows is not None:
+        rows = rows[:keep]
 
     # 5. Map record offsets back to packed virtual offsets.
-    if offs.size:
+    if offs.size and want_voffs:
         blk = np.searchsorted(ubase, offs, side="right") - 1
         voffs = (abs_coffs[blk].astype(np.uint64) << np.uint64(16)) | \
             (offs - ubase[blk]).astype(np.uint64)
     else:
         voffs = np.empty(0, dtype=np.uint64)
+    return data, offs, voffs, rows
 
+
+def decode_span_host(source, span: FileVirtualSpan, geometry: DecodeGeometry,
+                     check_crc: bool = False,
+                     inflate_backend: str = "auto",
+                     ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
+    """Span mode: full inflated bytes + offsets padded to geometry caps.
+
+    Returns (data[bytes_cap], offsets[records_cap], n_records, voffsets[n]).
+    """
+    data, offs, voffs, _ = _decode_span_core(source, span, check_crc,
+                                             inflate_backend)
     n = int(offs.size)
     g = geometry
     if data.size > g.bytes_cap or n > g.records_cap:
@@ -143,6 +171,57 @@ def decode_span_host(source, span: FileVirtualSpan, geometry: DecodeGeometry,
     out_offs = np.zeros(g.records_cap, dtype=np.int32)
     out_offs[:n] = offs
     return out_data, out_offs, n, voffs
+
+
+def decode_span_prefix_host(source, span: FileVirtualSpan,
+                            check_crc: bool = False,
+                            inflate_backend: str = "auto",
+                            projection: Tuple[str, ...] = ALL_FIELDS,
+                            want_voffs: bool = True,
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """Prefix mode: pack each owned record's projected columns densely.
+
+    Returns (rows[n, row_bytes] uint8, voffsets[n]).  This is the columnar
+    transfer layout: for fixed-field consumers (flagstat, filters, sort
+    keys) only the projected bytes cross the host->device link — 36 B/record
+    for the full fixed prefix, 11 B for the flagstat projection — instead of
+    the whole inflated span (~250 B/record on 150 bp WGS data), and field
+    extraction on device needs no gather, the tile is already dense.  With
+    the native library, walk + pack is a single C++ pass over the inflated
+    bytes.
+    """
+    from hadoop_bam_tpu.utils import native
+
+    row_bytes = projection_row_bytes(projection)
+    ranges = projection_ranges(projection)
+    use_native = native.available()
+
+    def walker(data, start, end_limit):
+        if use_native:
+            stop = min(int(end_limit), data.size)
+            cap = max(16, (stop - start) // 36 + 1)
+            rows, offs, tail = native.walk_bam_packed(
+                np.ascontiguousarray(data), start, cap, ranges, row_bytes,
+                stop=stop)
+            return rows, offs, tail
+        offs, tail = inflate_ops.walk_records(data, start=start)
+        return None, offs, tail
+
+    data, offs, voffs, rows = _decode_span_core(
+        source, span, check_crc, inflate_backend, packed_walker=walker,
+        want_voffs=want_voffs)
+    if rows is None:
+        # NumPy fallback: gather the full prefix tile, then slice columns.
+        if offs.size == 0:
+            rows = np.empty((0, row_bytes), dtype=np.uint8)
+        else:
+            idx = offs[:, None] + np.arange(PREFIX, dtype=offs.dtype)[None, :]
+            tile = data[idx]
+            cols = []
+            for off, width in ranges:
+                cols.append(tile[:, off:off + width])
+            rows = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+    return rows, voffs
 
 
 def stack_span_group(source, spans: Sequence[FileVirtualSpan], n_dev: int,
@@ -175,7 +254,6 @@ def stack_span_group(source, spans: Sequence[FileVirtualSpan], n_dev: int,
 # ---------------------------------------------------------------------------
 
 _STEP_CACHE: Dict[Tuple, Callable] = {}
-_TRANSFER_LOCK = threading.Lock()
 
 
 def make_flagstat_step(mesh: Mesh, axis: str = "data") -> Callable:
@@ -204,6 +282,36 @@ def make_flagstat_step(mesh: Mesh, axis: str = "data") -> Callable:
 
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(P(axis), P(axis), P(axis)),
+                   out_specs=P())
+    step = jax.jit(fn)
+    _STEP_CACHE[key] = step
+    return step
+
+
+def make_flagstat_tile_step(mesh: Mesh, axis: str = "data",
+                            projection: Tuple[str, ...] = FLAGSTAT_PROJECTION
+                            ) -> Callable:
+    """Jitted sharded step over dense projected tiles: (tile [n, cap, row],
+    counts [n]) -> psum'd flagstat vector.  No gather on device — the host
+    packed the tile, so field extraction is strided slicing straight into
+    the reductions."""
+    key = ("flagstat_tile", tuple(mesh.devices.flat), mesh.axis_names, axis,
+           projection)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+
+    from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS
+
+    def per_device(tile, count):
+        tile, count = tile[0], count[0]
+        cols = unpack_projected_tile(tile, projection)
+        valid = jnp.arange(tile.shape[0], dtype=jnp.int32) < count
+        stats = flagstat_from_columns(cols, valid)
+        vec = jnp.stack([stats[k] for k in FLAGSTAT_FIELDS])
+        return jax.lax.psum(vec, axis)
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(P(axis), P(axis)),
                    out_specs=P())
     step = jax.jit(fn)
     _STEP_CACHE[key] = step
@@ -244,6 +352,71 @@ def iter_span_groups(spans: Sequence[FileVirtualSpan], n_dev: int
         yield spans[i:i + n_dev]
 
 
+_ADD = jax.jit(jnp.add)
+
+
+def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
+                   fn: Callable, window: int) -> Iterator:
+    """Submit ``fn(item)`` to the pool with bounded in-flight futures and
+    yield results in order.  Bounds host memory: at most ``window`` decoded
+    spans exist at once (a plain list of futures would retain every span's
+    rows for the whole run — concurrent.futures keeps results referenced)."""
+    from collections import deque
+
+    it = iter(items)
+    dq: "deque[cf.Future]" = deque()
+    for item in it:
+        dq.append(pool.submit(fn, item))
+        if len(dq) >= window:
+            break
+    while dq:
+        fut = dq.popleft()
+        for item in it:
+            dq.append(pool.submit(fn, item))
+            break
+        yield fut.result()
+
+
+def _iter_prefix_tiles(row_arrays, cap: int, row_bytes: int = PREFIX
+                       ) -> Iterator[Tuple[np.ndarray, int]]:
+    """Repack a stream of per-span row arrays into [cap, row_bytes] tiles.
+
+    Spans have data-dependent record counts; the jit contract wants static
+    shapes.  Rather than padding each span to the worst case (the old span
+    path's memset + transfer tax), concatenate across span boundaries and
+    emit full tiles — only the final tile carries padding."""
+    parts: List[np.ndarray] = []
+    have = 0
+
+    def emit(take: int) -> Tuple[np.ndarray, int]:
+        nonlocal have
+        # full tiles are fully overwritten — only the padded final tile
+        # needs zeroing
+        tile = (np.empty if take == cap else np.zeros)(
+            (cap, row_bytes), dtype=np.uint8)
+        filled = 0
+        while filled < take:
+            head = parts[0]
+            k = min(take - filled, head.shape[0])
+            tile[filled:filled + k] = head[:k]
+            if k == head.shape[0]:
+                parts.pop(0)
+            else:
+                parts[0] = head[k:]
+            filled += k
+        have -= take
+        return tile, take
+
+    for prefix in row_arrays:
+        if prefix.shape[0]:
+            parts.append(prefix)
+            have += prefix.shape[0]
+        while have >= cap:
+            yield emit(cap)
+    if have:
+        yield emit(have)
+
+
 def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                   config: HBamConfig = DEFAULT_CONFIG,
                   geometry: Optional[DecodeGeometry] = None,
@@ -251,68 +424,89 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                   spans: Optional[Sequence[FileVirtualSpan]] = None,
                   prefetch: int = 2) -> Dict[str, int]:
     """Distributed flagstat over a whole BAM — the minimum end-to-end slice
-    (SURVEY.md section 7): plan -> shard -> inflate -> unpack -> reduce."""
+    (SURVEY.md section 7): plan -> shard -> inflate -> pack prefixes ->
+    device reduce.
+
+    Uses the columnar projected-tile path: host threads inflate spans and
+    pack just the flagstat columns (11 B/record over the link instead of
+    whole spans); the device sees dense tiles and reduces them with one
+    psum'd step per tile group.  Transfers issue sequentially from one
+    thread (axon tunnel links collapse under concurrent device_put
+    streams); the host decode pool runs ``prefetch * n_workers`` spans
+    ahead of the transfer loop, which bounds peak host memory.
+    """
     from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS
 
     if mesh is None:
         mesh = make_mesh()
     n_dev = int(np.prod(mesh.devices.shape))
     if geometry is None:
         geometry = DecodeGeometry()
+    cap = geometry.tile_records
     if header is None:
         header, _ = read_bam_header(path)
 
     if spans is None:
-        # Plan spans sized to the geometry: compressed spans inflate <= ~4x.
-        span_bytes = max(geometry.bytes_cap // 4, 1 << 20)
+        # Span size trades host-decode parallelism (smaller = more threads
+        # busy) against per-span Python overhead; tiles repack across span
+        # boundaries, so this does NOT couple to the device geometry.
+        span_bytes = 8 << 20
         src = as_byte_source(path)
         n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
         src.close()
         spans = plan_bam_spans(path, num_spans=n_spans, config=config,
                                header=header)
 
-    step = make_flagstat_step(mesh)
+    projection = FLAGSTAT_PROJECTION
+    row_bytes = projection_row_bytes(projection)
+    step = make_flagstat_tile_step(mesh, projection=projection)
     sharding = NamedSharding(mesh, P("data"))
-    totals: Dict[str, int] = {}
-    # separate pools: outer drives group pipelining, inner parallelizes the
-    # per-span decode inside a group (sharing one pool could deadlock — outer
-    # workers block on inner futures).  H2D transfers are SERIALIZED under a
-    # lock and blocked on individually: concurrent async device_put streams
-    # collapse ~80x on tunneled TPU links (measured 19 MB/s vs 1.5 GB/s).
-    transfer_lock = _TRANSFER_LOCK
-    with cf.ThreadPoolExecutor(max_workers=max(prefetch, 1)) as ex, \
-            cf.ThreadPoolExecutor(max_workers=8) as inner:
-        groups = list(iter_span_groups(spans, n_dev))
-        pending = []
-        gi = 0
+    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
+    window = max(1, prefetch) * n_workers
+    totals_vec = None
+    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
+        check_crc = bool(getattr(config, "check_crc", False))
 
-        def submit(g):
-            def work():
-                batch = stack_span_group(path, g, n_dev, geometry,
-                                         executor=inner)
-                with transfer_lock:
-                    out = (jax.device_put(batch.data, sharding),
-                           jax.device_put(batch.offsets, sharding),
-                           jax.device_put(batch.n_records, sharding))
-                    for a in out:
-                        a.block_until_ready()
-                return out
-            return ex.submit(work)
+        def decode(span):
+            rows, _voffs = decode_span_prefix_host(
+                path, span, check_crc, "auto", projection, want_voffs=False)
+            return rows
 
-        add = jax.jit(jnp.add)
-        totals_vec = None
-        while gi < len(groups) and len(pending) < prefetch:
-            pending.append(submit(groups[gi])); gi += 1
-        while pending:
-            data, offsets, counts = pending.pop(0).result()
-            if gi < len(groups):
-                pending.append(submit(groups[gi])); gi += 1
-            vec = step(data, offsets, counts)
-            # accumulate on device; transfer to host exactly once at the end
-            totals_vec = vec if totals_vec is None else add(totals_vec, vec)
-    from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS
+        row_stream = _iter_windowed(pool, spans, decode, window)
+        # Fresh staging buffers per group + NO blocking between dispatches:
+        # device_put/step calls queue asynchronously from this one thread
+        # (sequential issue keeps the tunnel link from collapsing the way
+        # concurrent multi-thread puts do), and the single device_get at the
+        # end drains the whole queue.
+        group_tiles: List[np.ndarray] = []
+        group_counts: List[int] = []
+
+        def dispatch():
+            nonlocal totals_vec
+            tiles = np.stack(group_tiles) if len(group_tiles) > 1 \
+                else group_tiles[0][None]
+            counts = np.zeros((n_dev,), dtype=np.int32)
+            counts[:len(group_counts)] = group_counts
+            if tiles.shape[0] < n_dev:  # final partial group
+                pad = np.zeros((n_dev - tiles.shape[0], cap, row_bytes),
+                               dtype=np.uint8)
+                tiles = np.concatenate([tiles, pad])
+            t = jax.device_put(tiles, sharding)
+            c = jax.device_put(counts, sharding)
+            vec = step(t, c)
+            totals_vec = vec if totals_vec is None else _ADD(totals_vec, vec)
+            group_tiles.clear()
+            group_counts.clear()
+
+        for tile, count in _iter_prefix_tiles(row_stream, cap, row_bytes):
+            group_tiles.append(tile)
+            group_counts.append(count)
+            if len(group_tiles) == n_dev:
+                dispatch()
+        if group_tiles:
+            dispatch()
     host = np.zeros(len(FLAGSTAT_FIELDS), dtype=np.int64) if totals_vec is None \
         else np.asarray(jax.device_get(totals_vec), dtype=np.int64)
-    totals = {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}
-    return totals
+    return {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}

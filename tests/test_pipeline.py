@@ -75,6 +75,70 @@ def test_unpack_step_sharded(bam):
     assert valid[0, :n].all() and not valid[0, n:].any()
 
 
+def test_decode_span_prefix_host_matches_span_mode(bam):
+    """Prefix-tile rows must equal the 36-byte record prefixes from the
+    full-span decode, for both native and NumPy-fallback packers."""
+    path, header, records, voffs = bam
+    from hadoop_bam_tpu.parallel.pipeline import decode_span_prefix_host
+    spans = plan_bam_spans(path, num_spans=5, header=header)
+    got_voffs = []
+    for s in spans:
+        d, o, n, v = decode_span_host(path, s, GEOM)
+        rows, pv = decode_span_prefix_host(path, s)
+        assert rows.shape == (n, 36)
+        got_voffs.extend(int(x) for x in pv)
+        idx = o[:n].astype(np.int64)[:, None] + np.arange(36)[None, :]
+        np.testing.assert_array_equal(rows, d[idx])
+    assert got_voffs == voffs
+
+
+def test_projection_pack_and_unpack(bam):
+    """Projected rows decode to the same columns as the full-field path."""
+    path, header, records, voffs = bam
+    from hadoop_bam_tpu.ops.unpack_bam import (
+        FLAGSTAT_PROJECTION, projection_ranges, projection_row_bytes,
+        unpack_projected_tile,
+    )
+    from hadoop_bam_tpu.parallel.pipeline import decode_span_prefix_host
+    assert projection_ranges(tuple(
+        ["block_size", "refid", "pos", "l_read_name", "mapq", "bin",
+         "n_cigar", "flag", "l_seq", "mate_refid", "mate_pos", "tlen"])) \
+        == [(0, 36)]
+    spans = plan_bam_spans(path, num_spans=3, header=header)
+    rows, _ = decode_span_prefix_host(
+        path, spans[0], projection=FLAGSTAT_PROJECTION, want_voffs=False)
+    assert rows.shape[1] == projection_row_bytes(FLAGSTAT_PROJECTION) == 11
+    cols = unpack_projected_tile(rows, FLAGSTAT_PROJECTION)
+    full, _ = decode_span_prefix_host(path, spans[0])
+    from hadoop_bam_tpu.ops.unpack_bam import unpack_fixed_fields_tile
+    ref = unpack_fixed_fields_tile(full)
+    for name in FLAGSTAT_PROJECTION:
+        np.testing.assert_array_equal(np.asarray(cols[name]),
+                                      np.asarray(ref[name]))
+
+
+def test_native_walk_packed_matches_fallback(bam):
+    path, header, records, voffs = bam
+    from hadoop_bam_tpu.utils import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    from hadoop_bam_tpu.ops import inflate as inflate_ops
+    from hadoop_bam_tpu.formats.bam import SAMHeader
+    raw = open(path, "rb").read()
+    data, _ = inflate_ops.inflate_span(raw)
+    _, after = SAMHeader.from_bam_bytes(data.tobytes())
+    offs, tail = inflate_ops.walk_records(data, start=after)
+    rows, offs2, tail2 = native.walk_bam_packed(
+        data, after, offs.size + 16, [(18, 2), (4, 4)], 6)
+    np.testing.assert_array_equal(offs, offs2)
+    assert tail == tail2
+    # spot-check packing: bytes 18-19 (flag) then 4-7 (refid)
+    i = len(records) // 2
+    rec_off = int(offs[i])
+    np.testing.assert_array_equal(rows[i, :2], data[rec_off + 18:rec_off + 20])
+    np.testing.assert_array_equal(rows[i, 2:6], data[rec_off + 4:rec_off + 8])
+
+
 def test_broadcast_and_assign(bam):
     path, header, *_ = bam
     spans = plan_bam_spans(path, num_spans=6, header=header)
