@@ -105,6 +105,65 @@ class BamDataset:
             or None
         self._next_span = int(state["next_span"])
 
+    def tensor_batches(self, mesh=None, geometry=None,
+                       num_spans: Optional[int] = None) -> Iterator[Dict]:
+        """Yield device-resident tensor batches for mesh consumers — the
+        ML-feed surface this framework exists for.  Each batch is a dict of
+        arrays sharded over the mesh's data axis:
+
+        - ``seq_packed`` [n_dev, cap, seq_stride] uint8 — 4-bit bases,
+          2/byte, high nibble first [SPEC]; unpack on device with
+          ops.seq_pallas.unpack_bases (or feed packed straight into a
+          Pallas kernel)
+        - ``qual`` [n_dev, cap, qual_stride] uint8
+        - ``prefix`` [n_dev, cap, 36] uint8 — fixed columns; decode with
+          ops.unpack_bam.unpack_fixed_fields_tile
+        - ``n_records`` [n_dev] int32 — valid rows per shard
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from hadoop_bam_tpu.parallel.mesh import make_mesh
+        from hadoop_bam_tpu.parallel.pipeline import (
+            PayloadGeometry, iter_payload_tile_groups,
+        )
+
+        self._reject_intervals("tensor_batches")
+        if mesh is None:
+            mesh = make_mesh()
+        if geometry is None:
+            geometry = PayloadGeometry()
+        n_dev = int(np.prod(mesh.devices.shape))
+        sharding = NamedSharding(mesh, P("data"))
+        spans = self.spans(num_spans)
+        for stacked, cvec in iter_payload_tile_groups(
+                self.path, spans, geometry, n_dev,
+                bool(getattr(self.config, "check_crc", False))):
+            yield {
+                "prefix": jax.device_put(stacked[0], sharding),
+                "seq_packed": jax.device_put(stacked[1], sharding),
+                "qual": jax.device_put(stacked[2], sharding),
+                "n_records": jax.device_put(cvec, sharding),
+            }
+
+    def _reject_intervals(self, what: str) -> None:
+        """The payload mesh paths read spans directly and would silently
+        bypass the bam_intervals filter — fail loudly instead (interval
+        filtering needs CIGAR-aware overlap, host-batch path only)."""
+        if self.config.bam_intervals:
+            raise ValueError(
+                f"{what} does not support bam_intervals filtering; use "
+                "batches()/records() (host path) for interval-filtered "
+                "reads")
+
+    def seq_stats(self, mesh=None, geometry=None) -> Dict:
+        """Distributed GC / quality / base-composition stats via the fused
+        Pallas payload kernel (parallel/pipeline.seq_stats_file)."""
+        from hadoop_bam_tpu.parallel.pipeline import seq_stats_file
+        self._reject_intervals("seq_stats")
+        return seq_stats_file(self.path, mesh=mesh, config=self.config,
+                              geometry=geometry, header=self.header)
+
     def flagstat(self, mesh=None) -> Dict[str, int]:
         if self.config.bam_intervals:
             # the mesh path reads spans directly and would bypass the

@@ -53,6 +53,31 @@ class DecodeGeometry:
         return self.bytes_cap + 4 * self.records_cap
 
 
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@dataclasses.dataclass(frozen=True)
+class PayloadGeometry:
+    """Static shapes of the tensor-batch feed (seq/qual payload tiles).
+
+    Strides round up to 128-byte lanes (TPU tiling [pallas_guide]); reads
+    longer than max_len are truncated on pack (full l_seq stays available
+    in the prefix columns).
+    """
+    max_len: int = 160             # bases per read kept on device
+    tile_records: int = 1 << 15    # records per device per step
+    block_n: int = 256             # Pallas record-tile height
+
+    @property
+    def seq_stride(self) -> int:
+        return _round_up((self.max_len + 1) // 2, 128)
+
+    @property
+    def qual_stride(self) -> int:
+        return _round_up(self.max_len, 128)
+
+
 @dataclasses.dataclass
 class HostSpanBatch:
     """Host-side decoded span group, ready to stack for n devices."""
@@ -222,6 +247,71 @@ def decode_span_prefix_host(source, span: FileVirtualSpan,
                 cols.append(tile[:, off:off + width])
             rows = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
     return rows, voffs
+
+
+def decode_span_payload_host(source, span: FileVirtualSpan,
+                             geometry: PayloadGeometry,
+                             check_crc: bool = False,
+                             inflate_backend: str = "auto",
+                             want_voffs: bool = False,
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                        np.ndarray]:
+    """Payload mode: pack prefix + 4-bit seq + qual into dense row tiles.
+
+    Returns (prefix[n, 36], seq[n, seq_stride], qual[n, qual_stride],
+    voffsets[n]) — the host half of the tensor-batch feed.  Native path is
+    one C++ pass (hbam_walk_bam_payload); the fallback walks offsets and
+    packs per record in NumPy.
+    """
+    from hadoop_bam_tpu.utils import native
+
+    g = geometry
+    use_native = native.available()
+    out: Dict[str, np.ndarray] = {}
+
+    def walker(data, start, end_limit):
+        if not use_native:
+            offs, tail = inflate_ops.walk_records(data, start=start)
+            return None, offs, tail
+        stop = min(int(end_limit), data.size)
+        cap = max(16, (stop - start) // 36 + 1)
+        prefix, seq, qual, offs, tail = native.walk_bam_payload(
+            np.ascontiguousarray(data), start, cap, g.max_len,
+            g.seq_stride, g.qual_stride, stop=stop)
+        out["prefix"], out["seq"], out["qual"] = prefix, seq, qual
+        # rows (= prefix) flows through the core's keep-truncation; seq/qual
+        # are truncated identically below from the kept count
+        return prefix, offs, tail
+
+    data, offs, voffs, rows = _decode_span_core(
+        source, span, check_crc, inflate_backend, packed_walker=walker,
+        want_voffs=want_voffs)
+    n = int(offs.size)
+    if rows is not None:
+        return rows, out["seq"][:n], out["qual"][:n], voffs
+
+    # NumPy fallback: per-record pack from the inflated span.
+    prefix = np.zeros((n, PREFIX), dtype=np.uint8)
+    seq = np.zeros((n, g.seq_stride), dtype=np.uint8)
+    qual = np.zeros((n, g.qual_stride), dtype=np.uint8)
+    for i in range(n):
+        p = int(offs[i])
+        prefix[i] = data[p:p + PREFIX]
+        l_read_name = int(data[p + 12])
+        n_cigar = int(data[p + 16]) | (int(data[p + 17]) << 8)
+        l_seq = int.from_bytes(data[p + 20:p + 24].tobytes(), "little",
+                               signed=True)
+        bs = int.from_bytes(data[p:p + 4].tobytes(), "little", signed=True)
+        seq_off = p + PREFIX + l_read_name + 4 * n_cigar
+        nb = (l_seq + 1) // 2
+        # same payload-bounds validation as the native walker: a corrupt
+        # l_seq must fail loudly, not pack neighboring records' bytes
+        if l_seq < 0 or (seq_off - p) + nb + l_seq > 4 + bs:
+            raise ValueError("malformed BAM record chain")
+        use = min(l_seq, g.max_len)
+        seq[i, :(use + 1) // 2] = data[seq_off:seq_off + (use + 1) // 2]
+        qual[i, :use] = data[seq_off + nb:seq_off + nb + use]
+    return prefix, seq, qual, voffs
 
 
 def stack_span_group(source, spans: Sequence[FileVirtualSpan], n_dev: int,
@@ -415,6 +505,185 @@ def _iter_prefix_tiles(row_arrays, cap: int, row_bytes: int = PREFIX
             yield emit(cap)
     if have:
         yield emit(have)
+
+
+def _iter_tile_tuples(array_tuples, cap: int, widths: Sequence[int]
+                      ) -> Iterator[Tuple[Tuple[np.ndarray, ...], int]]:
+    """Like _iter_prefix_tiles but over tuples of row arrays kept in
+    lockstep (prefix/seq/qual share record order and counts)."""
+    k = len(widths)
+    parts: List[Tuple[np.ndarray, ...]] = []
+    have = 0
+
+    def emit(take: int) -> Tuple[Tuple[np.ndarray, ...], int]:
+        nonlocal have
+        alloc = np.empty if take == cap else np.zeros
+        tiles = tuple(alloc((cap, w), dtype=np.uint8) for w in widths)
+        filled = 0
+        while filled < take:
+            head = parts[0]
+            m = min(take - filled, head[0].shape[0])
+            for t, h in zip(tiles, head):
+                t[filled:filled + m] = h[:m]
+            if m == head[0].shape[0]:
+                parts.pop(0)
+            else:
+                parts[0] = tuple(h[m:] for h in head)
+            filled += m
+        have -= take
+        return tiles, take
+
+    for arrays in array_tuples:
+        assert len(arrays) == k
+        if arrays[0].shape[0]:
+            parts.append(tuple(arrays))
+            have += arrays[0].shape[0]
+        while have >= cap:
+            yield emit(cap)
+    if have:
+        yield emit(have)
+
+
+def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
+                             geometry: PayloadGeometry, n_dev: int,
+                             check_crc: bool = False, prefetch: int = 2
+                             ) -> Iterator[Tuple[List[np.ndarray],
+                                                 np.ndarray]]:
+    """Stream payload tile groups ready for a device mesh: yields
+    ([prefix, seq, qual] each [n_dev, cap, w] uint8, counts [n_dev] int32).
+    The shared batching core of seq_stats_file and
+    BamDataset.tensor_batches — host decode pool with a bounded window,
+    cross-span tile repacking, zero-padded final group."""
+    cap = geometry.tile_records
+    widths = (PREFIX, geometry.seq_stride, geometry.qual_stride)
+    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
+    window = max(1, prefetch) * n_workers
+    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
+        def decode(span):
+            prefix, seq, qual, _v = decode_span_payload_host(
+                path, span, geometry, check_crc)
+            return prefix, seq, qual
+
+        stream = _iter_windowed(pool, spans, decode, window)
+        group: List[Tuple[np.ndarray, ...]] = []
+        counts: List[int] = []
+
+        def emit() -> Tuple[List[np.ndarray], np.ndarray]:
+            stacked = [np.stack([g[j] for g in group])
+                       for j in range(len(widths))]
+            cvec = np.zeros((n_dev,), dtype=np.int32)
+            cvec[:len(counts)] = counts
+            if stacked[0].shape[0] < n_dev:
+                for j, w in enumerate(widths):
+                    pad = np.zeros((n_dev - stacked[j].shape[0], cap, w),
+                                   dtype=np.uint8)
+                    stacked[j] = np.concatenate([stacked[j], pad])
+            group.clear()
+            counts.clear()
+            return stacked, cvec
+
+        for tiles, count in _iter_tile_tuples(stream, cap, widths):
+            group.append(tiles)
+            counts.append(count)
+            if len(group) == n_dev:
+                yield emit()
+        if group:
+            yield emit()
+
+
+def make_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
+                        axis: str = "data") -> Callable:
+    """Jitted sharded step over payload tiles: (prefix [n, cap, 36],
+    seq [n, cap, SB], qual [n, cap, QB], counts [n]) -> psum'd
+    [3 + 16] vector: (sum_gc, sum_mean_qual, n_reads, base_hist).
+
+    Lengths come from the prefix tile's l_seq column on device, clipped to
+    max_len (the pack truncates there); padding rows get length 0 via the
+    count mask.  The per-tile compute is the Pallas fused kernel
+    (ops/seq_pallas.py) — bases never materialise in HBM.
+    """
+    key = ("seq_stats", tuple(mesh.devices.flat), mesh.axis_names, axis,
+           geometry)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+
+    from hadoop_bam_tpu.ops.seq_pallas import seq_qual_stats
+
+    def per_device(prefix, seq, qual, count):
+        prefix, seq, qual, count = prefix[0], seq[0], qual[0], count[0]
+        cols = unpack_projected_tile(prefix, ALL_FIELDS)
+        valid = jnp.arange(prefix.shape[0], dtype=jnp.int32) < count
+        lengths = jnp.where(valid,
+                            jnp.minimum(cols["l_seq"], geometry.max_len), 0)
+        stats = seq_qual_stats(seq, qual, lengths,
+                               block_n=geometry.block_n)
+        nonpad = valid.astype(jnp.float32)
+        vec = jnp.concatenate([
+            jnp.stack([(stats["gc"] * nonpad).sum(),
+                       (stats["mean_qual"] * nonpad).sum(),
+                       nonpad.sum()]),
+            stats["base_hist"],
+        ])
+        return jax.lax.psum(vec, axis)
+
+    # check_vma=False: pallas_call's out_shape has no varying-mesh-axes
+    # annotation, which the default shard_map VMA check rejects
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                   out_specs=P(), check_vma=False)
+    step = jax.jit(fn)
+    _STEP_CACHE[key] = step
+    return step
+
+
+def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
+                   config: HBamConfig = DEFAULT_CONFIG,
+                   geometry: Optional[PayloadGeometry] = None,
+                   header: Optional[SAMHeader] = None,
+                   spans: Optional[Sequence[FileVirtualSpan]] = None,
+                   prefetch: int = 2) -> Dict[str, object]:
+    """Distributed sequence/quality stats over a whole BAM: mean GC
+    fraction, mean per-read quality, and the 4-bit base-code histogram —
+    computed by the fused Pallas payload kernel on every device of the
+    mesh.  The payload analog of flagstat_file."""
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.ops.seq_pallas import N_CODES
+
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = int(np.prod(mesh.devices.shape))
+    if geometry is None:
+        geometry = PayloadGeometry()
+    cap = geometry.tile_records
+    assert cap % geometry.block_n == 0
+    if header is None:
+        header, _ = read_bam_header(path)
+    if spans is None:
+        span_bytes = 8 << 20
+        src = as_byte_source(path)
+        n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
+        src.close()
+        spans = plan_bam_spans(path, num_spans=n_spans, config=config,
+                               header=header)
+
+    step = make_seq_stats_step(mesh, geometry)
+    sharding = NamedSharding(mesh, P("data"))
+    check_crc = bool(getattr(config, "check_crc", False))
+    totals_vec = None
+    for stacked, cvec in iter_payload_tile_groups(
+            path, spans, geometry, n_dev, check_crc, prefetch):
+        args = [jax.device_put(a, sharding) for a in stacked]
+        c = jax.device_put(cvec, sharding)
+        vec = step(*args, c)
+        totals_vec = vec if totals_vec is None else _ADD(totals_vec, vec)
+    if totals_vec is None:
+        return {"n_reads": 0, "mean_gc": 0.0, "mean_qual": 0.0,
+                "base_hist": np.zeros(N_CODES)}
+    host = np.asarray(jax.device_get(totals_vec), dtype=np.float64)
+    n = max(host[2], 1.0)
+    return {"n_reads": int(host[2]), "mean_gc": float(host[0] / n),
+            "mean_qual": float(host[1] / n), "base_hist": host[3:]}
 
 
 def flagstat_file(path: str, mesh: Optional[Mesh] = None,

@@ -72,6 +72,11 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hbam_walk_bam_packed.argtypes = [
             i8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i32p, i32p,
             ctypes.c_int32, ctypes.c_int32, i8p, i64p, ctypes.c_int64, i64p]
+        lib.hbam_walk_bam_payload.restype = ctypes.c_int64
+        lib.hbam_walk_bam_payload.argtypes = [
+            i8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            i8p, i8p, i8p, i64p, ctypes.c_int64, i64p]
         lib.hbam_crc32_batch.restype = ctypes.c_int
         lib.hbam_crc32_batch.argtypes = [
             i8p, i64p, i32p, ctypes.c_int32, u32p, ctypes.c_int32]
@@ -154,6 +159,39 @@ def walk_bam_packed(buf: np.ndarray, start: int, cap: int,
     if n > cap:
         raise ValueError(f"record count {n} exceeds capacity {cap}")
     return rows[:n], offs[:n], int(tail[0])
+
+
+def walk_bam_payload(buf: np.ndarray, start: int, cap: int, max_len: int,
+                     seq_stride: int, qual_stride: int,
+                     stop: Optional[int] = None,
+                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                np.ndarray, int]:
+    """Native single-pass walk + prefix/seq/qual tile pack.
+
+    Returns (prefix[n, 36], seq[n, seq_stride] 4-bit packed,
+    qual[n, qual_stride], offsets[n], tail_offset).  Rows are zero-padded
+    (buffers are allocated zeroed here; the C side only writes payload).
+    """
+    lib = load()
+    assert lib is not None
+    if stop is None:
+        stop = buf.size
+    prefix = np.zeros((cap, 36), dtype=np.uint8)
+    seq = np.zeros((cap, seq_stride), dtype=np.uint8)
+    qual = np.zeros((cap, qual_stride), dtype=np.uint8)
+    offs = np.empty(cap, dtype=np.int64)
+    tail = np.zeros(1, dtype=np.int64)
+    n = lib.hbam_walk_bam_payload(
+        _ptr(buf, ctypes.c_uint8), buf.size, start, stop,
+        max_len, seq_stride, qual_stride,
+        _ptr(prefix, ctypes.c_uint8), _ptr(seq, ctypes.c_uint8),
+        _ptr(qual, ctypes.c_uint8), _ptr(offs, ctypes.c_int64), cap,
+        _ptr(tail, ctypes.c_int64))
+    if n < 0:
+        raise ValueError("malformed BAM record chain")
+    if n > cap:
+        raise ValueError(f"record count {n} exceeds capacity {cap}")
+    return prefix[:n], seq[:n], qual[:n], offs[:n], int(tail[0])
 
 
 def available() -> bool:

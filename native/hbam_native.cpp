@@ -158,6 +158,51 @@ int64_t hbam_walk_bam_packed(const uint8_t* buf, int64_t n, int64_t start,
   return count;
 }
 
+// Walk BAM records and pack fixed prefix + sequence + quality payloads into
+// dense tiles in one pass — the host side of the tensor-batch feed (bases
+// and quals as fixed-stride device tiles).  Sequence bytes stay 4-bit
+// packed (2 bases/byte [SPEC]); reads longer than max_len are truncated
+// (full l_seq remains available in the prefix).  Output rows beyond the
+// copied payload are NOT cleared — callers pass zeroed buffers.  Walk stops
+// at ``stop`` as in hbam_walk_bam_packed.  Returns record count, or -1 on a
+// malformed record.
+int64_t hbam_walk_bam_payload(const uint8_t* buf, int64_t n, int64_t start,
+                              int64_t stop, int32_t max_len,
+                              int32_t seq_stride, int32_t qual_stride,
+                              uint8_t* out_prefix, uint8_t* out_seq,
+                              uint8_t* out_qual, int64_t* out_off,
+                              int64_t cap, int64_t* tail_off) {
+  int64_t p = start, count = 0;
+  while (p + 4 <= n && p < stop) {
+    int32_t bs;
+    std::memcpy(&bs, buf + p, 4);
+    if (bs < 32) return -1;
+    if (p + 4 + bs > n) break;
+    if (count < cap) {
+      const uint8_t* rec = buf + p;
+      std::memcpy(out_prefix + count * 36, rec, 36);
+      uint8_t l_read_name = rec[12];
+      uint16_t n_cigar;
+      std::memcpy(&n_cigar, rec + 16, 2);
+      int32_t l_seq;
+      std::memcpy(&l_seq, rec + 20, 4);
+      int64_t seq_off = 36 + static_cast<int64_t>(l_read_name) +
+                        4 * static_cast<int64_t>(n_cigar);
+      int64_t nb = (static_cast<int64_t>(l_seq) + 1) / 2;
+      if (l_seq < 0 || seq_off + nb + l_seq > 4 + static_cast<int64_t>(bs))
+        return -1;
+      int32_t use = l_seq < max_len ? l_seq : max_len;
+      std::memcpy(out_seq + count * seq_stride, rec + seq_off, (use + 1) / 2);
+      std::memcpy(out_qual + count * qual_stride, rec + seq_off + nb, use);
+      out_off[count] = p;
+    }
+    ++count;
+    p += 4 + static_cast<int64_t>(bs);
+  }
+  if (tail_off) *tail_off = p;
+  return count;
+}
+
 // CRC32 of a batch of byte ranges (BGZF block payload validation), threaded.
 // Returns 0; crcs[i] receives the zlib CRC32 of data[off[i] .. off[i]+len[i]).
 int hbam_crc32_batch(const uint8_t* data, const int64_t* off,
