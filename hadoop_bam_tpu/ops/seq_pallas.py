@@ -82,9 +82,10 @@ def _seq_stats_kernel(seq_ref, qual_ref, len_ref,
     hist_ref[:] += hist
 
 
-@functools.partial(jax.jit, static_argnames=("block_n",))
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
 def seq_qual_stats(seq_tile: jnp.ndarray, qual_tile: jnp.ndarray,
-                   lengths: jnp.ndarray, block_n: int = 256
+                   lengths: jnp.ndarray, block_n: int = 256,
+                   interpret: bool | None = None
                    ) -> Dict[str, jnp.ndarray]:
     """Fused per-read stats over packed payload tiles.
 
@@ -92,10 +93,17 @@ def seq_qual_stats(seq_tile: jnp.ndarray, qual_tile: jnp.ndarray,
     lengths: [N] int32 (0 for padding rows — they contribute nothing).
     N must be a multiple of block_n.  Returns {"gc": [N] f32,
     "mean_qual": [N] f32, "base_hist": [16] f32}.
+
+    ``interpret``: run the kernel in interpreter mode (required on CPU
+    devices).  None = infer from the default backend — pass it explicitly
+    when placing the computation on devices that are not the default
+    backend (e.g. a virtual CPU mesh under a TPU-default process).
     """
     n = seq_tile.shape[0]
     assert n % block_n == 0, (n, block_n)
     grid = n // block_n
+    if interpret is None:
+        interpret = _interpret()
     gc, mq, hist = pl.pallas_call(
         _seq_stats_kernel,
         grid=(grid,),
@@ -114,7 +122,7 @@ def seq_qual_stats(seq_tile: jnp.ndarray, qual_tile: jnp.ndarray,
             jax.ShapeDtypeStruct((n, 1), jnp.float32),
             jax.ShapeDtypeStruct((1, N_CODES), jnp.float32),
         ),
-        interpret=_interpret(),
+        interpret=interpret,
     )(seq_tile, qual_tile, lengths[:, None])
     return {"gc": gc[:, 0], "mean_qual": mq[:, 0], "base_hist": hist[0]}
 
