@@ -104,16 +104,22 @@ def _decode_span_core(source, span: FileVirtualSpan,
     """
     from hadoop_bam_tpu.formats import bgzf
 
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
     src = as_byte_source(source)
     start_c, start_u = span.start
     end_c, end_u = span.end
+    METRICS.count("pipeline.spans")
 
     # 1. Batched inflate of the whole blocks in [start_c, end_c).
     raw = src.pread(start_c, max(end_c - start_c, 0))
     if raw:
         table = inflate_ops.block_table(raw)
-        data, ubase = inflate_ops.inflate_span(raw, table,
-                                               backend=inflate_backend)
+        with METRICS.timer("pipeline.inflate"):
+            data, ubase = inflate_ops.inflate_span(raw, table,
+                                                   backend=inflate_backend)
+        METRICS.count("pipeline.blocks", int(table["isize"].size))
+        METRICS.count("pipeline.inflated_bytes", int(data.size))
         if check_crc:
             inflate_ops.verify_crcs(raw, table, data, ubase)
         abs_coffs = table["coffset"] + start_c
@@ -164,6 +170,7 @@ def _decode_span_core(source, span: FileVirtualSpan,
     offs = offs[:keep]
     if rows is not None:
         rows = rows[:keep]
+    METRICS.count("pipeline.records", int(offs.size))
 
     # 5. Map record offsets back to packed virtual offsets.
     if offs.size and want_voffs:

@@ -1,0 +1,67 @@
+"""Record serialization for exchange between pipeline stages and hosts.
+
+The rebuild of the reference's Writables layer (hb/SAMRecordWritable.java,
+hb/VariantContextWritable.java + hb/util/VariantContextCodec.java,
+SURVEY.md section 2.5): where Hadoop needed ``write()``/``readFields()`` so
+records could cross the shuffle, a mesh framework needs records to cross
+host boundaries (plan broadcast, resort exchanges, checkpoint sidecars).
+The wire formats ARE the specs' own binary layouts — BAM record bytes
+[SPEC section 4.2] and BCF2 record bytes [SPEC BCFv2] — so any spec-
+compliant reader interoperates; like the reference's lazy ``readFields``,
+decode defers to the columnar BamBatch machinery rather than eagerly
+materializing objects.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from hadoop_bam_tpu.formats.bam import BamBatch, SAMHeader
+from hadoop_bam_tpu.formats.sam import SamRecord
+from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+
+
+def encode_sam_records(records: Sequence[SamRecord], header: SAMHeader
+                       ) -> bytes:
+    """SamRecords -> concatenated BAM record bytes (block_size-prefixed,
+    uncompressed — the SAMRecordWritable wire form)."""
+    return b"".join(rec.to_bam_bytes(header) for rec in records)
+
+
+def decode_sam_records(buf: bytes, header: SAMHeader) -> List[SamRecord]:
+    """Concatenated BAM record bytes -> SamRecords (via the lazy columnar
+    batch, the LazyBAMRecordFactory analog: fields parse on access)."""
+    data = np.frombuffer(buf, dtype=np.uint8)
+    offs: List[int] = []
+    p = 0
+    while p + 4 <= data.size:
+        bs = int.from_bytes(buf[p:p + 4], "little", signed=True)
+        if bs < 32 or p + 4 + bs > data.size:
+            raise ValueError(f"malformed serialized BAM record at {p}")
+        offs.append(p)
+        p += 4 + bs
+    if p != data.size:
+        raise ValueError("trailing bytes after final serialized record")
+    batch = BamBatch(data, np.asarray(offs, dtype=np.int64), header=header)
+    return [SamRecord.from_line(batch.to_sam_line(i))
+            for i in range(len(offs))]
+
+
+def encode_variants(records: Sequence[VcfRecord], header: VCFHeader) -> bytes:
+    """VcfRecords -> concatenated BCF2 record bytes (the
+    VariantContextWritable wire form)."""
+    from hadoop_bam_tpu.formats.bcf import BCFRecordCodec
+    codec = BCFRecordCodec(header)
+    return b"".join(codec.encode(rec) for rec in records)
+
+
+def decode_variants(buf: bytes, header: VCFHeader) -> List[VcfRecord]:
+    from hadoop_bam_tpu.formats.bcf import BCFRecordCodec
+    codec = BCFRecordCodec(header)
+    out: List[VcfRecord] = []
+    off = 0
+    while off < len(buf):
+        rec, off = codec.decode(buf, off)
+        out.append(rec)
+    return out
