@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Dict, Iterator, List, Optional, Union
 
+import numpy as np
+
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig, ValidationStringency
 from hadoop_bam_tpu.api.dispatch import VCFContainer, sniff_vcf_container
 from hadoop_bam_tpu.formats import bgzf
@@ -121,6 +123,75 @@ class VcfDataset:
             recs = self.read_span(span)
             self._next_span += 1
             yield VariantBatch(recs, self.header)
+
+    def tensor_batches(self, mesh=None, geometry=None,
+                       num_spans: Optional[int] = None) -> Iterator[Dict]:
+        """Yield device-resident variant tensor batches sharded over the
+        mesh's data axis: ``chrom``/``pos`` int32 [n_dev, cap], ``flags``
+        uint8 (bit0 PASS, bit1 SNP), ``dosage`` int8 [n_dev, cap, S_pad]
+        (ALT-allele dosage, -1 missing), ``n_records`` int32 [n_dev]."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from hadoop_bam_tpu.parallel.mesh import make_mesh
+        from hadoop_bam_tpu.parallel.pipeline import _iter_windowed
+        from hadoop_bam_tpu.parallel.variant_pipeline import (
+            VariantGeometry, _iter_variant_tiles, pack_variant_tiles,
+        )
+        import concurrent.futures as cf
+        import os as _os
+
+        if mesh is None:
+            mesh = make_mesh()
+        if geometry is None:
+            geometry = VariantGeometry(n_samples=self.header.n_samples)
+        n_dev = int(np.prod(mesh.devices.shape))
+        cap = geometry.tile_records
+        sharding = NamedSharding(mesh, P("data"))
+        spans = self.spans(num_spans)
+        n_workers = min(32, max(4, (_os.cpu_count() or 4) * 4))
+        with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
+            def decode(span):
+                return pack_variant_tiles(
+                    VariantBatch(self.read_span(span), self.header),
+                    geometry)
+
+            stream = _iter_windowed(pool, spans, decode, 2 * n_workers)
+            group, counts = [], []
+            for tile, count in _iter_variant_tiles(stream, cap, geometry):
+                group.append(tile)
+                counts.append(count)
+                if len(group) == n_dev:
+                    yield self._emit_tensor_batch(group, counts, n_dev,
+                                                  sharding)
+            if group:
+                yield self._emit_tensor_batch(group, counts, n_dev, sharding)
+
+    @staticmethod
+    def _emit_tensor_batch(group, counts, n_dev, sharding) -> Dict:
+        import jax
+
+        cvec = np.zeros((n_dev,), dtype=np.int32)
+        cvec[:len(counts)] = counts
+        out = {}
+        for k in group[0]:
+            arrs = [g[k] for g in group]
+            while len(arrs) < n_dev:
+                arrs.append(np.zeros_like(arrs[0]))
+            out[k] = jax.device_put(np.stack(arrs), sharding)
+        out["n_records"] = jax.device_put(cvec, sharding)
+        group.clear()
+        counts.clear()
+        return out
+
+    def variant_stats(self, mesh=None, geometry=None) -> Dict:
+        """Distributed variant/SNP/PASS counts, mean ALT allele frequency,
+        and per-sample call rates (parallel/variant_pipeline.py)."""
+        from hadoop_bam_tpu.parallel.variant_pipeline import (
+            variant_stats_file,
+        )
+        return variant_stats_file(self.path, mesh=mesh, config=self.config,
+                                  header=self.header)
 
     # -- checkpoint / resume (SURVEY.md section 5) ---------------------------
     def state_dict(self) -> Dict:

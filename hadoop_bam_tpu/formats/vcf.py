@@ -369,3 +369,27 @@ class VariantBatch:
 
     def __len__(self) -> int:
         return len(self.records)
+
+    def dosage_matrix(self) -> np.ndarray:
+        """ALT-allele dosage per (variant, sample): 0/1/2 for diploid GTs,
+        summed alt count for polyploid, -1 for missing ('./.' or no GT
+        field) — the genotype tensor of the variant device feed."""
+        S = self.header.n_samples
+        out = np.full((len(self), S), -1, dtype=np.int8)
+        for i, r in enumerate(self.records):
+            if not r.fmt or r.fmt[0] != "GT":
+                continue
+            for s, g in enumerate(r.genotypes[:S]):
+                gt = g.split(":", 1)[0]
+                if not gt or gt.startswith("."):
+                    continue
+                dose = 0
+                ok = True
+                for a in gt.replace("|", "/").split("/"):
+                    if not a.isdigit():
+                        ok = False
+                        break
+                    dose += 1 if int(a) > 0 else 0
+                if ok:
+                    out[i, s] = min(dose, 127)
+        return out
