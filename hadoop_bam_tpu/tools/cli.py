@@ -176,6 +176,42 @@ def cmd_summarize(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# seq-stats / vcf-stats (device payload paths; no reference-CLI analog —
+# the closest is `summarize`, which these extend to payload columns)
+# ---------------------------------------------------------------------------
+
+def cmd_seq_stats(args) -> int:
+    from hadoop_bam_tpu.parallel.pipeline import (
+        PayloadGeometry, seq_stats_file,
+    )
+    geometry = PayloadGeometry(max_len=args.max_len)
+    stats = seq_stats_file(args.path, geometry=geometry)
+    print(f"reads\t{stats['n_reads']}")
+    print(f"mean_gc\t{stats['mean_gc']:.6f}")
+    print(f"mean_qual\t{stats['mean_qual']:.3f}")
+    names = ["=", "A", "C", "M", "G", "R", "S", "V",
+             "T", "W", "Y", "H", "K", "D", "B", "N"]
+    hist = stats["base_hist"]
+    total = max(float(hist.sum()), 1.0)
+    for code, name in enumerate(names):
+        if hist[code]:
+            print(f"base_{name}\t{int(hist[code])}\t{hist[code]/total:.4f}")
+    return 0
+
+
+def cmd_vcf_stats(args) -> int:
+    from hadoop_bam_tpu.parallel.variant_pipeline import variant_stats_file
+    stats = variant_stats_file(args.path)
+    print(f"variants\t{stats['n_variants']}")
+    print(f"snps\t{stats['n_snp']}")
+    print(f"pass\t{stats['n_pass']}")
+    print(f"mean_af\t{stats['mean_af']:.6f}")
+    for i, cr in enumerate(stats["sample_callrate"]):
+        print(f"callrate_{i}\t{cr:.4f}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # sort
 # ---------------------------------------------------------------------------
 
@@ -327,6 +363,19 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("summarize", help="distributed flagstat")
     s.add_argument("path")
     s.set_defaults(fn=cmd_summarize)
+
+    sq = sub.add_parser("seq-stats",
+                        help="GC/quality/base stats via the Pallas "
+                             "payload kernel")
+    sq.add_argument("path")
+    sq.add_argument("--max-len", type=int, default=160)
+    sq.set_defaults(fn=cmd_seq_stats)
+
+    vst = sub.add_parser("vcf-stats",
+                         help="variant counts, allele freq, call rates "
+                              "on the mesh")
+    vst.add_argument("path")
+    vst.set_defaults(fn=cmd_vcf_stats)
 
     so = sub.add_parser("sort", help="sort a BAM")
     so.add_argument("input")
