@@ -137,8 +137,7 @@ class BamDataset:
         sharding = NamedSharding(mesh, P("data"))
         spans = self.spans(num_spans)
         for stacked, cvec in iter_payload_tile_groups(
-                self.path, spans, geometry, n_dev,
-                bool(getattr(self.config, "check_crc", False))):
+                self.path, spans, geometry, n_dev, self.config):
             yield {
                 "prefix": jax.device_put(stacked[0], sharding),
                 "seq_packed": jax.device_put(stacked[1], sharding),

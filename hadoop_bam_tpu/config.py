@@ -102,6 +102,13 @@ class HBamConfig:
     # queryname-grouped (hb/BAMInputFormat.java upstream 7.9+):
     keep_paired_reads_together: bool = False
 
+    # --- failure policy (SURVEY.md section 5: spans are idempotent retry
+    # units, the MapReduce task-retry analog) ---
+    span_retries: int = 2            # re-decode attempts per failing span
+    skip_bad_spans: bool = False     # after retries: True = warn + skip
+    #                                  (ticks pipeline.bad_spans), False = raise
+    check_crc: bool = False          # verify BGZF CRC32 footers on inflate
+
     # --- split planning ---
     split_size: int = 128 * 1024 * 1024   # analog of HDFS block size splits
     splitting_index_granularity: int = 4096  # records per splitting-bai sample

@@ -452,6 +452,33 @@ def iter_span_groups(spans: Sequence[FileVirtualSpan], n_dev: int
 _ADD = jax.jit(jnp.add)
 
 
+def decode_with_retry(fn: Callable, span: FileVirtualSpan,
+                      config: HBamConfig):
+    """Span-level failure policy (SURVEY.md section 5): a span is a
+    self-describing, idempotent unit of work — the retry mechanism is
+    simply re-decoding it, exactly as MapReduce re-runs a map task.  After
+    ``config.span_retries`` re-attempts, ``skip_bad_spans`` decides between
+    raising and warn+skip (returns None; ticks pipeline.bad_spans)."""
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
+    retries = max(0, int(getattr(config, "span_retries", 0)))
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            return fn(span)
+        except Exception as e:  # noqa: BLE001 — policy boundary
+            last = e
+            if attempt < retries:
+                METRICS.count("pipeline.span_retries")
+    METRICS.count("pipeline.bad_spans")
+    if getattr(config, "skip_bad_spans", False):
+        import sys
+        print(f"hadoop-bam-tpu: skipping bad span {span}: {last}",
+              file=sys.stderr)
+        return None
+    raise last
+
+
 def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
                    fn: Callable, window: int) -> Iterator:
     """Submit ``fn(item)`` to the pool with bounded in-flight futures and
@@ -553,23 +580,32 @@ def _iter_tile_tuples(array_tuples, cap: int, widths: Sequence[int]
 
 def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                              geometry: PayloadGeometry, n_dev: int,
-                             check_crc: bool = False, prefetch: int = 2
+                             config: HBamConfig = DEFAULT_CONFIG,
+                             prefetch: int = 2
                              ) -> Iterator[Tuple[List[np.ndarray],
                                                  np.ndarray]]:
     """Stream payload tile groups ready for a device mesh: yields
     ([prefix, seq, qual] each [n_dev, cap, w] uint8, counts [n_dev] int32).
     The shared batching core of seq_stats_file and
     BamDataset.tensor_batches — host decode pool with a bounded window,
-    cross-span tile repacking, zero-padded final group."""
+    cross-span tile repacking, zero-padded final group, span retry/skip
+    per the config's failure policy."""
     cap = geometry.tile_records
     widths = (PREFIX, geometry.seq_stride, geometry.qual_stride)
+    check_crc = bool(getattr(config, "check_crc", False))
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     window = max(1, prefetch) * n_workers
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         def decode(span):
-            prefix, seq, qual, _v = decode_span_payload_host(
-                path, span, geometry, check_crc)
-            return prefix, seq, qual
+            def inner(s):
+                prefix, seq, qual, _v = decode_span_payload_host(
+                    path, s, geometry, check_crc)
+                return prefix, seq, qual
+            out = decode_with_retry(inner, span, config)
+            return out if out is not None else (
+                np.empty((0, PREFIX), np.uint8),
+                np.empty((0, geometry.seq_stride), np.uint8),
+                np.empty((0, geometry.qual_stride), np.uint8))
 
         stream = _iter_windowed(pool, spans, decode, window)
         group: List[Tuple[np.ndarray, ...]] = []
@@ -682,10 +718,9 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
 
     step = make_seq_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
-    check_crc = bool(getattr(config, "check_crc", False))
     totals_vec = None
     for stacked, cvec in iter_payload_tile_groups(
-            path, spans, geometry, n_dev, check_crc, prefetch):
+            path, spans, geometry, n_dev, config, prefetch):
         args = [jax.device_put(a, sharding) for a in stacked]
         c = jax.device_put(cvec, sharding)
         vec = step(*args, c)
@@ -753,9 +788,14 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
         check_crc = bool(getattr(config, "check_crc", False))
 
         def decode(span):
-            rows, _voffs = decode_span_prefix_host(
-                path, span, check_crc, "auto", projection, want_voffs=False)
-            return rows
+            def inner(s):
+                rows, _voffs = decode_span_prefix_host(
+                    path, s, check_crc, "auto", projection,
+                    want_voffs=False)
+                return rows
+            out = decode_with_retry(inner, span, config)
+            return out if out is not None \
+                else np.empty((0, row_bytes), dtype=np.uint8)
 
         row_stream = _iter_windowed(pool, spans, decode, window)
         # Fresh staging buffers per group + NO blocking between dispatches:

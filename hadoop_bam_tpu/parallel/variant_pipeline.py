@@ -186,9 +186,17 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
     window = max(1, prefetch) * n_workers
     totals = None
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
+        from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
+
         def decode(span):
-            recs = ds.read_span(span)
-            return pack_variant_tiles(VariantBatch(recs, header), geometry)
+            def inner(s):
+                recs = ds.read_span(s)
+                return pack_variant_tiles(VariantBatch(recs, header),
+                                          geometry)
+            out = decode_with_retry(inner, span, config)
+            if out is not None:
+                return out
+            return pack_variant_tiles(VariantBatch([], header), geometry)
 
         stream = _iter_windowed(pool, spans, decode, window)
         group: List[Dict[str, np.ndarray]] = []

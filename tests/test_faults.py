@@ -114,6 +114,42 @@ def test_bad_block_size_chain_raises(bam, tmp_path):
         decode_span_payload_host(out, whole, PayloadGeometry())
 
 
+def test_skip_bad_spans_policy(bam, tmp_path):
+    """With skip_bad_spans=True, a corrupt span is retried, warned about,
+    and excluded — the rest of the file still counts (the MapReduce
+    task-retry analog)."""
+    import dataclasses
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
+    path, header, records = bam
+    raw = open(path, "rb").read()
+    blocks = list(bgzf.scan_blocks(raw))
+    victim = blocks[len(blocks) // 2]
+
+    def mutate(data):
+        start = victim.cdata_offset
+        for i in range(start + 10, start + 40):
+            data[i] ^= 0xFF
+
+    bad = _corrupt_copy(path, tmp_path, mutate)
+    spans = _spans(path, header, n=4)  # plan on the intact twin
+
+    # default policy: raise
+    with pytest.raises(Exception):
+        flagstat_file(bad, header=header, spans=spans)
+
+    cfg = dataclasses.replace(DEFAULT_CONFIG, skip_bad_spans=True,
+                              span_retries=1)
+    METRICS.reset()
+    stats = flagstat_file(bad, header=header, spans=spans, config=cfg)
+    assert 0 < stats["total"] < len(records)
+    assert METRICS.counters["pipeline.bad_spans"] >= 1
+    assert METRICS.counters["pipeline.span_retries"] >= 1
+
+
 def test_serde_sam_round_trip(bam):
     path, header, records = bam
     from hadoop_bam_tpu.utils.serde import (
