@@ -109,6 +109,77 @@ class FastqDataset(_SpannedDataset):
                 ) -> Iterator[SequencedFragment]:
         return self._iter_spans(num_spans)
 
+    def tensor_batches(self, mesh=None, geometry=None,
+                       num_spans: Optional[int] = None) -> Iterator[Dict]:
+        """Device-resident read batches sharded over the mesh's data axis:
+        ``seq_packed`` uint8 [n_dev, cap, seq_stride] (BAM 4-bit nibble
+        codes, same alphabet as BamDataset.tensor_batches), ``qual`` uint8,
+        ``lengths`` int32 [n_dev, cap], ``n_records`` int32 [n_dev]."""
+        import concurrent.futures as cf
+        import os as _os
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from hadoop_bam_tpu.parallel.mesh import make_mesh
+        from hadoop_bam_tpu.parallel.pipeline import (
+            PayloadGeometry, _iter_tile_tuples, _iter_windowed,
+            decode_with_retry,
+        )
+
+        if mesh is None:
+            mesh = make_mesh()
+        if geometry is None:
+            geometry = PayloadGeometry()
+        n_dev = int(np.prod(mesh.devices.shape))
+        cap = geometry.tile_records
+        sharding = NamedSharding(mesh, P("data"))
+        spans = self.spans(num_spans)
+        n_workers = min(32, max(4, (_os.cpu_count() or 4) * 4))
+        specs = (geometry.seq_stride, geometry.qual_stride,
+                 (None, np.int32))
+        with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
+            def decode(span):
+                def inner(s):
+                    return fragments_to_payload_tiles(
+                        self.read_span(s), geometry.seq_stride,
+                        geometry.qual_stride, geometry.max_len)
+                out = decode_with_retry(inner, span, self.config)
+                return out if out is not None else (
+                    np.empty((0, geometry.seq_stride), np.uint8),
+                    np.empty((0, geometry.qual_stride), np.uint8),
+                    np.empty((0,), np.int32))
+
+            stream = _iter_windowed(pool, spans, decode, 2 * n_workers)
+            group, counts = [], []
+
+            def emit():
+                cvec = np.zeros((n_dev,), dtype=np.int32)
+                cvec[:len(counts)] = counts
+                stacked = []
+                for j in range(3):
+                    arrs = [g[j] for g in group]
+                    while len(arrs) < n_dev:
+                        arrs.append(np.zeros_like(arrs[0]))
+                    stacked.append(np.stack(arrs))
+                out = {
+                    "seq_packed": jax.device_put(stacked[0], sharding),
+                    "qual": jax.device_put(stacked[1], sharding),
+                    "lengths": jax.device_put(stacked[2], sharding),
+                    "n_records": jax.device_put(cvec, sharding),
+                }
+                group.clear()
+                counts.clear()
+                return out
+
+            for tile, count in _iter_tile_tuples(stream, cap, specs):
+                group.append(tile)
+                counts.append(count)
+                if len(group) == n_dev:
+                    yield emit()
+            if group:
+                yield emit()
+
 
 class QseqDataset(_SpannedDataset):
     """Illumina qseq: one record per line."""
@@ -161,6 +232,45 @@ _BASE_CODE = np.full(256, 4, dtype=np.uint8)
 for i, c in enumerate("ACGT"):
     _BASE_CODE[ord(c)] = i
     _BASE_CODE[ord(c.lower())] = i
+
+
+# ASCII -> BAM 4-bit base codes [SPEC]: the same nibble alphabet the BAM
+# payload tiles use, so one Pallas kernel (ops/seq_pallas.py) serves every
+# read format.  Unknown characters map to N (15).
+_NIBBLE_CODE = np.full(256, 15, dtype=np.uint8)
+for _c, _code in (("=", 0), ("A", 1), ("C", 2), ("M", 3), ("G", 4),
+                  ("R", 5), ("S", 6), ("V", 7), ("T", 8), ("W", 9),
+                  ("Y", 10), ("H", 11), ("K", 12), ("D", 13), ("B", 14),
+                  ("N", 15)):
+    _NIBBLE_CODE[ord(_c)] = _code
+    _NIBBLE_CODE[ord(_c.lower())] = _code
+
+
+def fragments_to_payload_tiles(frags: List[SequencedFragment],
+                               seq_stride: int, qual_stride: int,
+                               max_len: int
+                               ) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]:
+    """Pack reads into the BAM-payload tile layout (4-bit bases, 2/byte,
+    high nibble first; Phred quality bytes) — the FASTQ/QSEQ entry into
+    the device payload path.  Returns (seq [n, seq_stride] uint8,
+    qual [n, qual_stride] uint8, lengths [n] int32)."""
+    n = len(frags)
+    seq = np.zeros((n, seq_stride), dtype=np.uint8)
+    qual = np.zeros((n, qual_stride), dtype=np.uint8)
+    lengths = np.zeros(n, dtype=np.int32)
+    for i, f in enumerate(frags):
+        l = min(len(f.sequence), max_len)
+        lengths[i] = l
+        raw = np.frombuffer(f.sequence[:l].encode("latin-1"), np.uint8)
+        codes = _NIBBLE_CODE[raw]
+        if l % 2:
+            codes = np.concatenate([codes, np.zeros(1, np.uint8)])
+        packed = (codes[0::2] << 4) | codes[1::2]
+        seq[i, :packed.size] = packed
+        q = np.frombuffer(f.quality[:l].encode("latin-1"), np.uint8)
+        qual[i, :l] = q - 33
+    return seq, qual, lengths
 
 
 def fragments_to_arrays(frags: List[SequencedFragment], max_len: int

@@ -541,18 +541,24 @@ def _iter_prefix_tiles(row_arrays, cap: int, row_bytes: int = PREFIX
         yield emit(have)
 
 
-def _iter_tile_tuples(array_tuples, cap: int, widths: Sequence[int]
+def _iter_tile_tuples(array_tuples, cap: int, specs: Sequence
                       ) -> Iterator[Tuple[Tuple[np.ndarray, ...], int]]:
     """Like _iter_prefix_tiles but over tuples of row arrays kept in
-    lockstep (prefix/seq/qual share record order and counts)."""
-    k = len(widths)
+    lockstep (prefix/seq/qual/lengths share record order and counts).
+
+    ``specs``: per-array spec — an int width (uint8 [cap, w] tile) or a
+    (width_or_None, dtype) pair; width None means a 1-D [cap] tile."""
+    norm = [(s, np.uint8) if isinstance(s, int) else tuple(s)
+            for s in specs]
     parts: List[Tuple[np.ndarray, ...]] = []
     have = 0
 
     def emit(take: int) -> Tuple[Tuple[np.ndarray, ...], int]:
         nonlocal have
         alloc = np.empty if take == cap else np.zeros
-        tiles = tuple(alloc((cap, w), dtype=np.uint8) for w in widths)
+        tiles = tuple(
+            alloc((cap,) if w is None else (cap, w), dtype=dt)
+            for w, dt in norm)
         filled = 0
         while filled < take:
             head = parts[0]
@@ -568,7 +574,7 @@ def _iter_tile_tuples(array_tuples, cap: int, widths: Sequence[int]
         return tiles, take
 
     for arrays in array_tuples:
-        assert len(arrays) == k
+        assert len(arrays) == len(norm)
         if arrays[0].shape[0]:
             parts.append(tuple(arrays))
             have += arrays[0].shape[0]
@@ -683,6 +689,129 @@ def make_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
     step = jax.jit(fn)
     _STEP_CACHE[key] = step
     return step
+
+
+def make_read_stats_step(mesh: Mesh, geometry: PayloadGeometry,
+                         axis: str = "data") -> Callable:
+    """Like make_seq_stats_step but with explicit per-read lengths instead
+    of a BAM prefix tile — the step for text read formats (FASTQ/QSEQ)
+    whose payload tiles come from fragments_to_payload_tiles."""
+    key = ("read_stats", tuple(mesh.devices.flat), mesh.axis_names, axis,
+           geometry)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+
+    from hadoop_bam_tpu.ops.seq_pallas import seq_qual_stats
+
+    interpret = mesh.devices.flat[0].platform != "tpu"
+
+    def per_device(seq, qual, lengths, count):
+        seq, qual, lengths, count = seq[0], qual[0], lengths[0], count[0]
+        valid = jnp.arange(seq.shape[0], dtype=jnp.int32) < count
+        lengths = jnp.where(valid, lengths, 0)
+        stats = seq_qual_stats(seq, qual, lengths,
+                               block_n=geometry.block_n,
+                               interpret=interpret)
+        nonpad = valid.astype(jnp.float32)
+        vec = jnp.concatenate([
+            jnp.stack([(stats["gc"] * nonpad).sum(),
+                       (stats["mean_qual"] * nonpad).sum(),
+                       nonpad.sum()]),
+            stats["base_hist"],
+        ])
+        return jax.lax.psum(vec, axis)
+
+    fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis),) * 4,
+                   out_specs=P(), check_vma=False)
+    step = jax.jit(fn)
+    _STEP_CACHE[key] = step
+    return step
+
+
+def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
+                         config: HBamConfig = DEFAULT_CONFIG,
+                         geometry: Optional[PayloadGeometry] = None,
+                         prefetch: int = 2) -> Dict[str, object]:
+    """Distributed GC / quality / base stats over a FASTQ (or QSEQ) file —
+    the text-format twin of seq_stats_file, through the same fused Pallas
+    payload kernel."""
+    from hadoop_bam_tpu.api.read_datasets import (
+        fragments_to_payload_tiles, open_fastq, open_qseq,
+    )
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.ops.seq_pallas import N_CODES
+
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = int(np.prod(mesh.devices.shape))
+    if geometry is None:
+        geometry = PayloadGeometry()
+    cap = geometry.tile_records
+    lower = path.lower()
+    ds = open_qseq(path, config) if lower.endswith((".qseq", ".qseq.gz",
+                                                    ".txt")) \
+        else open_fastq(path, config)
+    spans = ds.spans()
+    step = make_read_stats_step(mesh, geometry)
+    sharding = NamedSharding(mesh, P("data"))
+    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
+    window = max(1, prefetch) * n_workers
+    totals_vec = None
+    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
+        def decode(span):
+            def inner(s):
+                frags = ds.read_span(s)
+                return fragments_to_payload_tiles(
+                    frags, geometry.seq_stride, geometry.qual_stride,
+                    geometry.max_len)
+            out = decode_with_retry(inner, span, config)
+            return out if out is not None else (
+                np.empty((0, geometry.seq_stride), np.uint8),
+                np.empty((0, geometry.qual_stride), np.uint8),
+                np.empty((0,), np.int32))
+
+        stream = _iter_windowed(pool, spans, decode, window)
+        group: List[Tuple[np.ndarray, ...]] = []
+        counts: List[int] = []
+
+        def dispatch():
+            nonlocal totals_vec
+            seqs = np.stack([g[0] for g in group] + [
+                np.zeros((cap, geometry.seq_stride), np.uint8)
+                for _ in range(n_dev - len(group))])
+            quals = np.stack([g[1] for g in group] + [
+                np.zeros((cap, geometry.qual_stride), np.uint8)
+                for _ in range(n_dev - len(group))])
+            lens = np.stack([g[2] for g in group] + [
+                np.zeros((cap,), np.int32)
+                for _ in range(n_dev - len(group))])
+            cvec = np.zeros((n_dev,), dtype=np.int32)
+            cvec[:len(counts)] = counts
+            args = [jax.device_put(a, sharding)
+                    for a in (seqs, quals, lens)]
+            c = jax.device_put(cvec, sharding)
+            vec = step(*args, c)
+            totals_vec = vec if totals_vec is None else _ADD(totals_vec,
+                                                             vec)
+            group.clear()
+            counts.clear()
+
+        specs = (geometry.seq_stride, geometry.qual_stride,
+                 (None, np.int32))
+        for tile, count in _iter_tile_tuples(stream, cap, specs):
+            group.append(tile)
+            counts.append(count)
+            if len(group) == n_dev:
+                dispatch()
+        if group:
+            dispatch()
+    if totals_vec is None:
+        return {"n_reads": 0, "mean_gc": 0.0, "mean_qual": 0.0,
+                "base_hist": np.zeros(N_CODES)}
+    host = np.asarray(jax.device_get(totals_vec), dtype=np.float64)
+    n = max(host[2], 1.0)
+    return {"n_reads": int(host[2]), "mean_gc": float(host[0] / n),
+            "mean_qual": float(host[1] / n), "base_hist": host[3:]}
 
 
 def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
