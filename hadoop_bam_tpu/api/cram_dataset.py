@@ -56,6 +56,24 @@ class CramDataset:
             self._next_span += 1
             yield from recs
 
+    def tensor_batches(self, mesh=None, geometry=None,
+                       num_spans: Optional[int] = None) -> Iterator[Dict]:
+        """Device-resident read batches (same layout as
+        FastqDataset.tensor_batches) decoded from CRAM containers."""
+        from hadoop_bam_tpu.formats.fastq import SequencedFragment
+        from hadoop_bam_tpu.parallel.pipeline import (
+            stream_read_tensor_batches,
+        )
+
+        def read_frags(span):
+            return [SequencedFragment(
+                sequence="" if r.seq == "*" else r.seq,
+                quality="" if r.qual == "*" else r.qual)
+                for r in self.read_span(span)]
+
+        yield from stream_read_tensor_batches(
+            self.spans(num_spans), read_frags, self.config, mesh, geometry)
+
     # -- checkpoint / resume (same contract as BamDataset) --
     def state_dict(self) -> Dict:
         return {"path": self.path,

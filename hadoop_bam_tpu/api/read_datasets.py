@@ -115,70 +115,12 @@ class FastqDataset(_SpannedDataset):
         ``seq_packed`` uint8 [n_dev, cap, seq_stride] (BAM 4-bit nibble
         codes, same alphabet as BamDataset.tensor_batches), ``qual`` uint8,
         ``lengths`` int32 [n_dev, cap], ``n_records`` int32 [n_dev]."""
-        import concurrent.futures as cf
-        import os as _os
-
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from hadoop_bam_tpu.parallel.mesh import make_mesh
         from hadoop_bam_tpu.parallel.pipeline import (
-            PayloadGeometry, _iter_tile_tuples, _iter_windowed,
-            decode_with_retry,
+            stream_read_tensor_batches,
         )
-
-        if mesh is None:
-            mesh = make_mesh()
-        if geometry is None:
-            geometry = PayloadGeometry()
-        n_dev = int(np.prod(mesh.devices.shape))
-        cap = geometry.tile_records
-        sharding = NamedSharding(mesh, P("data"))
-        spans = self.spans(num_spans)
-        n_workers = min(32, max(4, (_os.cpu_count() or 4) * 4))
-        specs = (geometry.seq_stride, geometry.qual_stride,
-                 (None, np.int32))
-        with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
-            def decode(span):
-                def inner(s):
-                    return fragments_to_payload_tiles(
-                        self.read_span(s), geometry.seq_stride,
-                        geometry.qual_stride, geometry.max_len)
-                out = decode_with_retry(inner, span, self.config)
-                return out if out is not None else (
-                    np.empty((0, geometry.seq_stride), np.uint8),
-                    np.empty((0, geometry.qual_stride), np.uint8),
-                    np.empty((0,), np.int32))
-
-            stream = _iter_windowed(pool, spans, decode, 2 * n_workers)
-            group, counts = [], []
-
-            def emit():
-                cvec = np.zeros((n_dev,), dtype=np.int32)
-                cvec[:len(counts)] = counts
-                stacked = []
-                for j in range(3):
-                    arrs = [g[j] for g in group]
-                    while len(arrs) < n_dev:
-                        arrs.append(np.zeros_like(arrs[0]))
-                    stacked.append(np.stack(arrs))
-                out = {
-                    "seq_packed": jax.device_put(stacked[0], sharding),
-                    "qual": jax.device_put(stacked[1], sharding),
-                    "lengths": jax.device_put(stacked[2], sharding),
-                    "n_records": jax.device_put(cvec, sharding),
-                }
-                group.clear()
-                counts.clear()
-                return out
-
-            for tile, count in _iter_tile_tuples(stream, cap, specs):
-                group.append(tile)
-                counts.append(count)
-                if len(group) == n_dev:
-                    yield emit()
-            if group:
-                yield emit()
+        yield from stream_read_tensor_batches(
+            self.spans(num_spans), self.read_span, self.config, mesh,
+            geometry)
 
 
 class QseqDataset(_SpannedDataset):

@@ -32,8 +32,11 @@ class BamWriter:
 
     def __init__(self, sink, header: SAMHeader, *, write_header: bool = True,
                  write_eof: bool = True, level: int = 6,
-                 track_voffsets: bool = False):
+                 track_voffsets: bool = False,
+                 index_granularity: int = 0,
+                 index_flavor: str = "splitting-bai"):
         self._own = False
+        self._path = sink if isinstance(sink, (str, bytes)) else None
         if isinstance(sink, (str, bytes)):
             sink = open(sink, "wb")
             self._own = True
@@ -41,7 +44,15 @@ class BamWriter:
         self.header = header
         self._w = bgzf.BGZFWriter(sink, level=level, write_eof=write_eof)
         self._voffsets: List[int] = []
-        self._track = track_voffsets
+        # index-on-write (hb/SplittingBAMIndexer.java's MR-integrated mode):
+        # sample every Nth record's voffset during output and emit the
+        # sidecar on close — no second pass over the file
+        self._index_granularity = int(index_granularity)
+        self._index_flavor = index_flavor
+        if self._index_granularity and self._path is None:
+            raise ValueError("index_granularity needs a path sink (the "
+                             "sidecar is written next to the BAM)")
+        self._track = track_voffsets or bool(self._index_granularity)
         self.records_written = 0
         if write_header:
             self._w.write(header.to_bam_bytes())
@@ -64,6 +75,29 @@ class BamWriter:
         self._w.close()
         if self._own:
             self._sink.close()
+        if self._index_granularity and self.records_written:
+            self._write_sidecar()
+
+    def _write_sidecar(self) -> None:
+        import os
+
+        from hadoop_bam_tpu.split.splitting_index import (
+            SBI_SUFFIX, SPLITTING_BAI_SUFFIX, SplittingIndex,
+        )
+        g = self._index_granularity
+        path = self._path if isinstance(self._path, str) \
+            else self._path.decode()
+        size = os.path.getsize(path)
+        idx = SplittingIndex(
+            voffsets=self._voffsets[::g] + [size << 16],
+            granularity=g, total_records=self.records_written)
+        if self._index_flavor == "sbi":
+            out, data = path + SBI_SUFFIX, idx.to_sbi_bytes(size)
+        else:
+            out, data = (path + SPLITTING_BAI_SUFFIX,
+                         idx.to_splitting_bai_bytes())
+        with open(out, "wb") as f:
+            f.write(data)
 
     def __enter__(self):
         return self

@@ -691,6 +691,70 @@ def make_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
     return step
 
 
+def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
+                               mesh: Optional[Mesh],
+                               geometry: "Optional[PayloadGeometry]"
+                               ) -> Iterator[Dict]:
+    """Shared tensor-batch generator for text/record read formats
+    (FASTQ/QSEQ/CRAM): ``read_span_fn(span)`` returns a list of objects
+    with ``.sequence``/``.quality`` attributes; yields sharded device
+    batches {seq_packed, qual, lengths, n_records}."""
+    from hadoop_bam_tpu.api.read_datasets import fragments_to_payload_tiles
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    if geometry is None:
+        geometry = PayloadGeometry()
+    n_dev = int(np.prod(mesh.devices.shape))
+    cap = geometry.tile_records
+    sharding = NamedSharding(mesh, P("data"))
+    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
+    specs = (geometry.seq_stride, geometry.qual_stride, (None, np.int32))
+    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
+        def decode(span):
+            def inner(s):
+                return fragments_to_payload_tiles(
+                    read_span_fn(s), geometry.seq_stride,
+                    geometry.qual_stride, geometry.max_len)
+            out = decode_with_retry(inner, span, config)
+            return out if out is not None else (
+                np.empty((0, geometry.seq_stride), np.uint8),
+                np.empty((0, geometry.qual_stride), np.uint8),
+                np.empty((0,), np.int32))
+
+        stream = _iter_windowed(pool, spans, decode, 2 * n_workers)
+        group: List[Tuple[np.ndarray, ...]] = []
+        counts: List[int] = []
+
+        def emit() -> Dict:
+            cvec = np.zeros((n_dev,), dtype=np.int32)
+            cvec[:len(counts)] = counts
+            stacked = []
+            for j in range(3):
+                arrs = [g[j] for g in group]
+                while len(arrs) < n_dev:
+                    arrs.append(np.zeros_like(arrs[0]))
+                stacked.append(np.stack(arrs))
+            out = {
+                "seq_packed": jax.device_put(stacked[0], sharding),
+                "qual": jax.device_put(stacked[1], sharding),
+                "lengths": jax.device_put(stacked[2], sharding),
+                "n_records": jax.device_put(cvec, sharding),
+            }
+            group.clear()
+            counts.clear()
+            return out
+
+        for tile, count in _iter_tile_tuples(stream, cap, specs):
+            group.append(tile)
+            counts.append(count)
+            if len(group) == n_dev:
+                yield emit()
+        if group:
+            yield emit()
+
+
 def make_read_stats_step(mesh: Mesh, geometry: PayloadGeometry,
                          axis: str = "data") -> Callable:
     """Like make_seq_stats_step but with explicit per-read lengths instead

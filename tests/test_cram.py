@@ -277,3 +277,32 @@ def test_cram_header_roundtrip(tmp_path):
     data = open(path, "rb").read()
     assert data[:4] == b"CRAM"
     assert data.endswith(CANONICAL_EOF)
+
+
+def test_cram_tensor_batches(tmp_path):
+    """CRAM reads flow through the shared payload tensor feed."""
+    import numpy as np
+
+    from hadoop_bam_tpu.api.cram_dataset import open_cram
+    from hadoop_bam_tpu.parallel.pipeline import PayloadGeometry
+
+    header = make_header()
+    recs = make_records(header, 600, seed=9)
+    path = str(tmp_path / "t.cram")
+    write_cram(path, header, recs)
+    ds = open_cram(path)
+    g = PayloadGeometry(max_len=160, tile_records=256, block_n=256)
+    total = 0
+    first_seq = None
+    for batch in ds.tensor_batches(geometry=g, num_spans=2):
+        counts = np.asarray(batch["n_records"])
+        if first_seq is None and counts[0]:
+            from hadoop_bam_tpu.ops.seq_pallas import unpack_bases
+            codes = np.asarray(unpack_bases(
+                np.asarray(batch["seq_packed"])[0][:1]))
+            ln = int(np.asarray(batch["lengths"])[0, 0])
+            code_to_base = {0: "=", 1: "A", 2: "C", 4: "G", 8: "T", 15: "N"}
+            first_seq = "".join(code_to_base[int(c)] for c in codes[0, :ln])
+        total += int(counts.sum())
+    assert total == len(recs)
+    assert first_seq == recs[0].seq[:160]

@@ -138,3 +138,35 @@ def test_text_span_every_offset(tmp_path):
         parts = [read_text_span(data, FileByteSpan("t", bounds[i], bounds[i + 1]))
                  for i in range(5)]
         assert b"".join(parts) == data
+
+
+def test_index_on_write_matches_posthoc(tmp_path):
+    """BamWriter(index_granularity=N) emits the same sidecar the standalone
+    indexer builds after the fact (hb/SplittingBAMIndexer MR-integrated
+    mode vs main())."""
+    from fixtures import make_header, make_records
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.split.splitting_index import (
+        SplittingIndex, build_splitting_index,
+    )
+
+    header = make_header()
+    records = make_records(header, 1000, seed=3)
+    path = str(tmp_path / "iw.bam")
+    with BamWriter(path, header, index_granularity=64) as w:
+        for r in records:
+            w.write_sam_record(r)
+    sidecar = path + ".splitting-bai"
+    import os
+    assert os.path.exists(sidecar)
+    got = SplittingIndex.from_bytes(open(sidecar, "rb").read())
+    ref = build_splitting_index(path, granularity=64)
+    assert list(got.voffsets) == list(ref.voffsets)
+
+    # sbi flavor round-trips too
+    path2 = str(tmp_path / "iw2.bam")
+    with BamWriter(path2, header, index_granularity=64,
+                   index_flavor="sbi") as w:
+        for r in records:
+            w.write_sam_record(r)
+    assert os.path.exists(path2 + ".sbi")
