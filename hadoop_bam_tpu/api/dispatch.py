@@ -28,6 +28,10 @@ class SAMContainer(enum.Enum):
 class VCFContainer(enum.Enum):
     VCF = "vcf"       # plain text
     VCF_BGZF = "vcf.gz"
+    # plain-gzip (non-BGZF) .vcf.gz: readable but NOT splittable — decoded
+    # as one whole-file span, the hb/util/BGZFEnhancedGzipCodec.java
+    # fallback behavior
+    VCF_GZIP = "vcf.gz(plain)"
     BCF = "bcf"
 
 
@@ -77,7 +81,12 @@ def sniff_vcf_container(path: str, config: HBamConfig = DEFAULT_CONFIG,
     lower = path.lower()
     if config.vcf_trust_exts:
         if lower.endswith((".vcf.gz", ".vcf.bgz", ".vcf.bgzf")):
-            fmt = VCFContainer.VCF_BGZF
+            # the extension promises VCF-in-gzip; BGZF vs plain gzip decides
+            # splittability and must be checked against the bytes
+            # (hb/util/BGZFEnhancedGzipCodec.java)
+            head = data if data is not None else _read_head(path)
+            fmt = VCFContainer.VCF_BGZF if bgzf.is_bgzf(head) \
+                else VCFContainer.VCF_GZIP
         elif lower.endswith(".bcf"):
             fmt = VCFContainer.BCF
         elif lower.endswith(".vcf"):
@@ -102,6 +111,8 @@ def _sniff_vcf_data(path: str, data: Optional[bytes]) -> VCFContainer:
         if payload[:3] == b"BCF":
             return VCFContainer.BCF
         return VCFContainer.VCF_BGZF
+    if head[:2] == b"\x1f\x8b":
+        return VCFContainer.VCF_GZIP
     if head[:13] == b"##fileformat=":
         return VCFContainer.VCF
     raise ValueError(f"cannot determine VCF container of {path!r}")

@@ -58,6 +58,14 @@ class VcfDataset:
                     return r.read(size)
                 header, _ = read_vcf_header_text(read_chunk)
                 return header
+            if self.container is VCFContainer.VCF_GZIP:
+                import gzip
+                text = gzip.decompress(src.pread(0, src.size))
+
+                def read_chunk(off: int, size: int) -> bytes:
+                    return text[off:off + size]
+                header, _ = read_vcf_header_text(read_chunk)
+                return header
             header, _, self._is_bgzf_bcf = read_bcf_header(src)
             return header
         finally:
@@ -76,6 +84,14 @@ class VcfDataset:
             elif self.container is VCFContainer.VCF_BGZF:
                 self._plan = plan_bgzf_text_spans(
                     self.path, num_spans=num_spans, config=self.config)
+            elif self.container is VCFContainer.VCF_GZIP:
+                # plain gzip is not splittable: one whole-file span
+                # (hb/util/BGZFEnhancedGzipCodec fallback)
+                src = as_byte_source(self.path)
+                try:
+                    self._plan = [FileByteSpan(self.path, 0, src.size)]
+                finally:
+                    src.close()
             else:
                 self._plan = plan_bcf_spans(
                     self.path, num_spans=num_spans, config=self.config,
@@ -89,6 +105,10 @@ class VcfDataset:
                                  is_bgzf=self._is_bgzf_bcf)
         if self.container is VCFContainer.VCF_BGZF:
             text = read_bgzf_text_span(self.path, span)
+        elif self.container is VCFContainer.VCF_GZIP:
+            import gzip
+            with open(self.path, "rb") as f:
+                text = gzip.decompress(f.read())
         else:
             text = read_text_span(self.path, span)
         out: List[VcfRecord] = []

@@ -338,3 +338,29 @@ def test_variant_batch_columns(vcf_files):
         assert b.pos[i] == r.pos
         assert b.chrom[i] == header.contig_index(r.chrom)
         assert b.n_allele[i] == r.n_allele
+
+
+def test_plain_gzip_vcf_fallback(tmp_path):
+    """A .vcf.gz that is plain gzip (NOT BGZF) reads as one whole-file
+    span — the BGZFEnhancedGzipCodec fallback behavior — and stats work."""
+    import gzip
+
+    from hadoop_bam_tpu.api.dispatch import (
+        VCFContainer, sniff_vcf_container, _vcf_cache,
+    )
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+
+    header_text = ("##fileformat=VCFv4.2\n"
+                   "##contig=<ID=c1,length=1000>\n"
+                   "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+    body = "".join(f"c1\t{10+i}\t.\tA\tG\t40\tPASS\t.\n" for i in range(300))
+    path = str(tmp_path / "p.vcf.gz")
+    with open(path, "wb") as f:
+        f.write(gzip.compress((header_text + body).encode()))
+    _vcf_cache.clear()
+    assert sniff_vcf_container(path) is VCFContainer.VCF_GZIP
+    ds = open_vcf(path)
+    recs = list(ds.records())
+    assert len(recs) == 300 and recs[0].pos == 10 and recs[-1].pos == 309
+    stats = ds.variant_stats()
+    assert stats["n_variants"] == 300 and stats["n_snp"] == 300
