@@ -136,6 +136,16 @@ class QseqDataset(_SpannedDataset):
                 ) -> Iterator[SequencedFragment]:
         return self._iter_spans(num_spans)
 
+    def tensor_batches(self, mesh=None, geometry=None,
+                       num_spans: Optional[int] = None) -> Iterator[Dict]:
+        """Same device batch layout as FastqDataset.tensor_batches."""
+        from hadoop_bam_tpu.parallel.pipeline import (
+            stream_read_tensor_batches,
+        )
+        yield from stream_read_tensor_batches(
+            self.spans(num_spans), self.read_span, self.config, mesh,
+            geometry)
+
 
 class FastaDataset(_SpannedDataset):
     """Reference FASTA: spans hold whole contigs (snapped to '>')."""
@@ -150,6 +160,52 @@ class FastaDataset(_SpannedDataset):
     def fragments(self, num_spans: Optional[int] = None
                   ) -> Iterator[ReferenceFragment]:
         return self._iter_spans(num_spans)
+
+    def window_tensor_batches(self, window: int = 1024, stride: int = 0,
+                              mesh=None, geometry=None,
+                              num_spans: Optional[int] = None
+                              ) -> Iterator[Dict]:
+        """Reference windows as device tensors: each contig is cut into
+        ``window``-base pieces every ``stride`` bases (default stride =
+        window, i.e. non-overlapping) and packed into the same 4-bit
+        nibble tiles as the read feeds — the reference-context input for
+        models that consume (read, reference) pairs.  Yields the
+        FastqDataset.tensor_batches layout."""
+        from hadoop_bam_tpu.parallel.pipeline import (
+            PayloadGeometry, stream_read_tensor_batches,
+        )
+
+        stride = stride or window
+        if geometry is None:
+            geometry = PayloadGeometry(max_len=window)
+
+        def read_windows(span) -> List[SequencedFragment]:
+            out: List[SequencedFragment] = []
+            # contig-order reassembly: fragments of one contig arrive in
+            # position order within a span (spans snap to '>')
+            per_contig: Dict[str, List[ReferenceFragment]] = {}
+            for frag in self.read_span(span):
+                per_contig.setdefault(frag.contig, []).append(frag)
+            for contig, frags in per_contig.items():
+                seq = "".join(f.sequence for f in frags)
+                n = len(seq)
+                if not n:
+                    continue
+                if n <= window:
+                    out.append(SequencedFragment(sequence=seq, quality=""))
+                    continue
+                last = n - window
+                starts = list(range(0, last + 1, stride))
+                if starts[-1] != last:
+                    starts.append(last)  # flush a final full window
+                for off in starts:
+                    out.append(SequencedFragment(
+                        sequence=seq[off:off + window], quality=""))
+            return out
+
+        yield from stream_read_tensor_batches(
+            self.spans(num_spans), read_windows, self.config, mesh,
+            geometry)
 
 
 def open_fastq(path: str, config: HBamConfig = DEFAULT_CONFIG) -> FastqDataset:
@@ -211,7 +267,7 @@ def fragments_to_payload_tiles(frags: List[SequencedFragment],
         packed = (codes[0::2] << 4) | codes[1::2]
         seq[i, :packed.size] = packed
         q = np.frombuffer(f.quality[:l].encode("latin-1"), np.uint8)
-        qual[i, :l] = q - 33
+        qual[i, :q.size] = q - 33  # quality may be absent (FASTA windows)
     return seq, qual, lengths
 
 

@@ -168,3 +168,29 @@ def test_tensor_batches_api(bam):
         if counts[0]:
             assert int(np.asarray(cols["flag"])[0]) == 99
     assert total == len(recs)
+
+
+def test_fasta_window_tensor_batches(tmp_path):
+    """Reference windows pack into nibble tiles covering every base."""
+    rng = random.Random(3)
+    path = str(tmp_path / "ref.fa")
+    sizes = {"ctg0": 700, "ctg1": 1500, "ctg2": 2300}
+    contigs = {name: "".join(rng.choice("ACGT") for _ in range(n))
+               for name, n in sizes.items()}
+    with open(path, "w") as f:
+        for name, seq in contigs.items():
+            f.write(f">{name}\n")
+            for i in range(0, len(seq), 70):
+                f.write(seq[i:i + 70] + "\n")
+    from hadoop_bam_tpu.api.read_datasets import open_fasta
+    from hadoop_bam_tpu.parallel.pipeline import PayloadGeometry
+    ds = open_fasta(path)
+    g = PayloadGeometry(max_len=1024, tile_records=256, block_n=256)
+    windows = 0
+    for batch in ds.window_tensor_batches(window=1024, geometry=g,
+                                          num_spans=2):
+        windows += int(np.asarray(batch["n_records"]).sum())
+        lens = np.asarray(batch["lengths"])
+    # 700 -> 1 short window; 1500 -> ceil((1500-1024)/1024)+... starts
+    # {0, 476}; 2300 -> starts {0, 1024, 1276}
+    assert windows == 1 + 2 + 3
