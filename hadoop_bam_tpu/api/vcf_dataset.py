@@ -98,6 +98,20 @@ class VcfDataset:
                     header=self.header)
         return self._plan
 
+    def read_span_text(self, span: Span) -> Optional[bytes]:
+        """Raw text bytes of a span (None for the binary BCF container) —
+        the input of the fast column tokenizer
+        (parallel/variant_pipeline.pack_variant_tiles_from_text)."""
+        if self.container is VCFContainer.BCF:
+            return None
+        if self.container is VCFContainer.VCF_BGZF:
+            return read_bgzf_text_span(self.path, span)
+        if self.container is VCFContainer.VCF_GZIP:
+            import gzip
+            with open(self.path, "rb") as f:
+                return gzip.decompress(f.read())
+        return read_text_span(self.path, span)
+
     # -- span read (hb/VCFRecordReader / hb/BCFRecordReader) -----------------
     def read_span(self, span: Span) -> List[VcfRecord]:
         if self.container is VCFContainer.BCF:

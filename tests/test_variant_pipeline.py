@@ -113,3 +113,23 @@ def test_variant_stats_on_bcf(vcf, tmp_path):
     stats = variant_stats_file(out)
     assert stats["n_variants"] == len(recs)
     assert stats["n_snp"] == len(recs)
+
+
+def test_fast_tokenizer_matches_generic(vcf):
+    """pack_variant_tiles_from_text == VariantBatch-based packing."""
+    path, header, recs = vcf
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.parallel.variant_pipeline import (
+        pack_variant_tiles, pack_variant_tiles_from_text,
+    )
+    g = VariantGeometry(n_samples=header.n_samples)
+    ds = open_vcf(path)
+    for span in ds.spans(3):
+        text = ds.read_span_text(span)
+        fast = pack_variant_tiles_from_text(text, header, g)
+        slow = pack_variant_tiles(
+            __import__("hadoop_bam_tpu.formats.vcf",
+                       fromlist=["VariantBatch"]).VariantBatch(
+                ds.read_span(span), header), g)
+        for k in ("chrom", "pos", "flags", "dosage"):
+            np.testing.assert_array_equal(fast[k], slow[k], err_msg=k)
