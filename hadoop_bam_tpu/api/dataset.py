@@ -128,7 +128,6 @@ class BamDataset:
             PayloadGeometry, iter_payload_tile_groups,
         )
 
-        self._reject_intervals("tensor_batches")
         if mesh is None:
             mesh = make_mesh()
         if geometry is None:
@@ -137,7 +136,8 @@ class BamDataset:
         sharding = NamedSharding(mesh, P("data"))
         spans = self.spans(num_spans)
         for stacked, cvec in iter_payload_tile_groups(
-                self.path, spans, geometry, n_dev, self.config):
+                self.path, spans, geometry, n_dev, self.config,
+                header=self.header):
             yield {
                 "prefix": jax.device_put(stacked[0], sharding),
                 "seq_packed": jax.device_put(stacked[1], sharding),
@@ -145,33 +145,17 @@ class BamDataset:
                 "n_records": jax.device_put(cvec, sharding),
             }
 
-    def _reject_intervals(self, what: str) -> None:
-        """The payload mesh paths read spans directly and would silently
-        bypass the bam_intervals filter — fail loudly instead (interval
-        filtering needs CIGAR-aware overlap, host-batch path only)."""
-        if self.config.bam_intervals:
-            raise ValueError(
-                f"{what} does not support bam_intervals filtering; use "
-                "batches()/records() (host path) for interval-filtered "
-                "reads")
-
     def seq_stats(self, mesh=None, geometry=None) -> Dict:
         """Distributed GC / quality / base-composition stats via the fused
-        Pallas payload kernel (parallel/pipeline.seq_stats_file)."""
+        Pallas payload kernel (parallel/pipeline.seq_stats_file).  Honors
+        bam_intervals (rows filter host-side before tiling)."""
         from hadoop_bam_tpu.parallel.pipeline import seq_stats_file
-        self._reject_intervals("seq_stats")
         return seq_stats_file(self.path, mesh=mesh, config=self.config,
                               geometry=geometry, header=self.header)
 
     def flagstat(self, mesh=None) -> Dict[str, int]:
-        if self.config.bam_intervals:
-            # the mesh path reads spans directly and would bypass the
-            # interval filter; count over filtered batches instead
-            from hadoop_bam_tpu.ops.flagstat import flagstat_from_batch
-            stats: Dict[str, int] = {}
-            for span in self.spans():
-                flagstat_from_batch(self.read_span(span), stats)
-            return stats
+        """Distributed flagstat; honors bam_intervals via the mesh path's
+        host-side row filter."""
         from hadoop_bam_tpu.parallel.pipeline import flagstat_file
         return flagstat_file(self.path, mesh=mesh, config=self.config,
                              header=self.header)

@@ -205,11 +205,24 @@ def decode_span_host(source, span: FileVirtualSpan, geometry: DecodeGeometry,
     return out_data, out_offs, n, voffs
 
 
+def _interval_mask(data: np.ndarray, offs: np.ndarray, header, intervals
+                   ) -> np.ndarray:
+    """Row keep-mask for interval filtering on the mesh decode paths
+    (hb/BAMInputFormat's hadoopbam.bam.intervals record filter): overlap
+    test on pos + CIGAR reference span via the columnar batch."""
+    from hadoop_bam_tpu.formats.bam import BamBatch
+    from hadoop_bam_tpu.split.intervals import batch_overlap_mask
+
+    batch = BamBatch(data, offs.astype(np.int64), header=header)
+    return batch_overlap_mask(batch, intervals, header)
+
+
 def decode_span_prefix_host(source, span: FileVirtualSpan,
                             check_crc: bool = False,
                             inflate_backend: str = "auto",
                             projection: Tuple[str, ...] = ALL_FIELDS,
                             want_voffs: bool = True,
+                            intervals=None, header=None,
                             ) -> Tuple[np.ndarray, np.ndarray]:
     """Prefix mode: pack each owned record's projected columns densely.
 
@@ -253,6 +266,11 @@ def decode_span_prefix_host(source, span: FileVirtualSpan,
             for off, width in ranges:
                 cols.append(tile[:, off:off + width])
             rows = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+    if intervals and offs.size:
+        keep = _interval_mask(data, offs, header, intervals)
+        rows = rows[keep]
+        if voffs.size:
+            voffs = voffs[keep]
     return rows, voffs
 
 
@@ -261,6 +279,7 @@ def decode_span_payload_host(source, span: FileVirtualSpan,
                              check_crc: bool = False,
                              inflate_backend: str = "auto",
                              want_voffs: bool = False,
+                             intervals=None, header=None,
                              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
                                         np.ndarray]:
     """Payload mode: pack prefix + 4-bit seq + qual into dense row tiles.
@@ -294,8 +313,18 @@ def decode_span_payload_host(source, span: FileVirtualSpan,
         source, span, check_crc, inflate_backend, packed_walker=walker,
         want_voffs=want_voffs)
     n = int(offs.size)
+
+    def apply_intervals(prefix, seq, qual, voffs):
+        if intervals and offs.size:
+            keep = _interval_mask(data, offs, header, intervals)
+            prefix, seq, qual = prefix[keep], seq[keep], qual[keep]
+            if voffs.size:
+                voffs = voffs[keep]
+        return prefix, seq, qual, voffs
+
     if rows is not None:
-        return rows, out["seq"][:n], out["qual"][:n], voffs
+        return apply_intervals(rows, out["seq"][:n], out["qual"][:n],
+                               voffs)
 
     # NumPy fallback: per-record pack from the inflated span.
     prefix = np.zeros((n, PREFIX), dtype=np.uint8)
@@ -318,7 +347,7 @@ def decode_span_payload_host(source, span: FileVirtualSpan,
         use = min(l_seq, g.max_len)
         seq[i, :(use + 1) // 2] = data[seq_off:seq_off + (use + 1) // 2]
         qual[i, :use] = data[seq_off + nb:seq_off + nb + use]
-    return prefix, seq, qual, voffs
+    return apply_intervals(prefix, seq, qual, voffs)
 
 
 def stack_span_group(source, spans: Sequence[FileVirtualSpan], n_dev: int,
@@ -450,6 +479,15 @@ def iter_span_groups(spans: Sequence[FileVirtualSpan], n_dev: int
 
 
 _ADD = jax.jit(jnp.add)
+
+
+def parse_config_intervals(config: HBamConfig, header):
+    """config.bam_intervals -> parsed Interval list (None when unset)."""
+    if not getattr(config, "bam_intervals", None):
+        return None
+    from hadoop_bam_tpu.split.intervals import parse_intervals
+    return parse_intervals(config.bam_intervals,
+                           header.ref_names if header else None)
 
 
 def decode_with_retry(fn: Callable, span: FileVirtualSpan,
@@ -587,7 +625,8 @@ def _iter_tile_tuples(array_tuples, cap: int, specs: Sequence
 def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                              geometry: PayloadGeometry, n_dev: int,
                              config: HBamConfig = DEFAULT_CONFIG,
-                             prefetch: int = 2
+                             prefetch: int = 2,
+                             header=None,
                              ) -> Iterator[Tuple[List[np.ndarray],
                                                  np.ndarray]]:
     """Stream payload tile groups ready for a device mesh: yields
@@ -599,13 +638,15 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     cap = geometry.tile_records
     widths = (PREFIX, geometry.seq_stride, geometry.qual_stride)
     check_crc = bool(getattr(config, "check_crc", False))
+    intervals = parse_config_intervals(config, header)
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     window = max(1, prefetch) * n_workers
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         def decode(span):
             def inner(s):
                 prefix, seq, qual, _v = decode_span_payload_host(
-                    path, s, geometry, check_crc)
+                    path, s, geometry, check_crc,
+                    intervals=intervals, header=header)
                 return prefix, seq, qual
             out = decode_with_retry(inner, span, config)
             return out if out is not None else (
@@ -913,7 +954,7 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     sharding = NamedSharding(mesh, P("data"))
     totals_vec = None
     for stacked, cvec in iter_payload_tile_groups(
-            path, spans, geometry, n_dev, config, prefetch):
+            path, spans, geometry, n_dev, config, prefetch, header=header):
         args = [jax.device_put(a, sharding) for a in stacked]
         c = jax.device_put(cvec, sharding)
         vec = step(*args, c)
@@ -979,12 +1020,13 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     totals_vec = None
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         check_crc = bool(getattr(config, "check_crc", False))
+        intervals = parse_config_intervals(config, header)
 
         def decode(span):
             def inner(s):
                 rows, _voffs = decode_span_prefix_host(
                     path, s, check_crc, "auto", projection,
-                    want_voffs=False)
+                    want_voffs=False, intervals=intervals, header=header)
                 return rows
             out = decode_with_retry(inner, span, config)
             return out if out is not None \

@@ -163,3 +163,39 @@ def test_reference_span_column(tmp_path):
     assert list(b.reference_span()) == [20, 120, 30]
     sub = b.select(np.array([2, 0]))
     assert [sub.read_name(i) for i in range(len(sub))] == ["c", "a"]
+
+
+def test_mesh_flagstat_honors_intervals(tmp_path):
+    """flagstat/seq_stats through the mesh path count only interval-
+    overlapping records, matching the host-filtered oracle."""
+    import dataclasses
+
+    from fixtures import make_header, make_records
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.ops.flagstat import flagstat_from_batch
+
+    header = make_header()
+    records = make_records(header, 3000, seed=31)
+    path = str(tmp_path / "iv.bam")
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_sam_record(r)
+    iv = f"{header.ref_names[0]}:1000-40000"
+    cfg = dataclasses.replace(DEFAULT_CONFIG, bam_intervals=iv)
+    ds = open_bam(path, cfg)
+    stats = ds.flagstat()
+
+    # oracle: host batch filter over the same spans
+    plain = open_bam(path)
+    expect = {}
+    for span in plain.spans():
+        batch = ds.read_span(span)  # read_span applies the interval filter
+        flagstat_from_batch(batch, expect)
+    assert 0 < stats["total"] < len(records)
+    assert stats["total"] == expect["total"]
+    assert stats["mapped"] == expect["mapped"]
+
+    sstats = ds.seq_stats()
+    assert sstats["n_reads"] == stats["total"]
