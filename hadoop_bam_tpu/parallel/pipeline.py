@@ -111,8 +111,17 @@ def _decode_span_core(source, span: FileVirtualSpan,
     end_c, end_u = span.end
     METRICS.count("pipeline.spans")
 
-    # 1. Batched inflate of the whole blocks in [start_c, end_c).
+    # 1. Batched inflate of the whole blocks in [start_c, end_c) — plus the
+    #    block AT end_c when the span ends inside it (end_u > 0): reading it
+    #    up front folds it into the one native batched-inflate call instead
+    #    of a per-block Python zlib + whole-buffer concatenate afterwards.
     raw = src.pread(start_c, max(end_c - start_c, 0))
+    end_block_size = 0
+    if end_u > 0 and end_c < src.size:
+        head = src.pread(end_c, bgzf.MAX_BLOCK_SIZE)
+        info = bgzf.parse_block_header(head, 0)
+        end_block_size = info.block_size
+        raw = raw + head[:end_block_size]
     if raw:
         table = inflate_ops.block_table(raw)
         with METRICS.timer("pipeline.inflate"):
@@ -142,11 +151,12 @@ def _decode_span_core(source, span: FileVirtualSpan,
         data = np.concatenate([data, np.frombuffer(extra, np.uint8)])
         return info.block_size
 
-    # 2. The span may end inside the block at end_c: its first end_u inflated
-    #    bytes still hold records owned by this span.
-    if end_u > 0 and end_c < src.size:
-        end_inflated = data.size + end_u
-        next_c = end_c + append_block(end_c)
+    # 2. The span may end inside the block at end_c (already inflated as the
+    #    final table entry): its first end_u inflated bytes still hold
+    #    records owned by this span.
+    if end_block_size:
+        end_inflated = int(ubase[-1]) + end_u
+        next_c = end_c + end_block_size
     else:
         end_inflated = data.size
 
