@@ -216,44 +216,12 @@ def cmd_vcf_stats(args) -> int:
 # ---------------------------------------------------------------------------
 
 def cmd_sort(args) -> int:
-    import numpy as np
-    from hadoop_bam_tpu.api.dataset import open_bam
-    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.utils.sort import sort_bam
 
-    ds = open_bam(args.input)
-    header = ds.header
-    batches = list(ds.batches())
-    recs: List[bytes] = []
-    keys = []
-    for b in batches:
-        if args.by_name:
-            for i in range(len(b)):
-                keys.append(b.read_name(i))
-                recs.append(b.record_bytes(i))
-        else:
-            refid = b.refid.astype(np.int64)
-            # unmapped (-1) sorts last, as in coordinate order [SPEC]
-            refkey = np.where(refid < 0, np.int64(1 << 40), refid)
-            pos = b.pos.astype(np.int64)
-            for i in range(len(b)):
-                keys.append((int(refkey[i]), int(pos[i])))
-                recs.append(b.record_bytes(i))
-    order = sorted(range(len(recs)), key=lambda i: keys[i])
-    text = header.text
+    n = sort_bam(args.input, args.output, by_name=args.by_name,
+                 run_records=args.run_records)
     so = "queryname" if args.by_name else "coordinate"
-    if "@HD" in text:
-        import re
-        # drop any existing SO tag, then append the new one to the @HD line
-        text = re.sub(r"(@HD[^\n]*?)\tSO:\S*", r"\1", text, count=1)
-        text = re.sub(r"(@HD[^\n]*)", rf"\1\tSO:{so}", text, count=1)
-    else:
-        text = f"@HD\tVN:1.6\tSO:{so}\n" + text
-    header2 = type(header)(text=text, ref_names=header.ref_names,
-                           ref_lengths=header.ref_lengths)
-    with BamWriter(args.output, header2) as w:
-        for i in order:
-            w.write_record_bytes(recs[i])
-    print(f"wrote {args.output} ({len(recs)} records, {so})")
+    print(f"wrote {args.output} ({n} records, {so})")
     return 0
 
 
@@ -377,10 +345,12 @@ def build_parser() -> argparse.ArgumentParser:
     vst.add_argument("path")
     vst.set_defaults(fn=cmd_vcf_stats)
 
-    so = sub.add_parser("sort", help="sort a BAM")
+    so = sub.add_parser("sort", help="sort a BAM (external spill-merge)")
     so.add_argument("input")
     so.add_argument("output")
     so.add_argument("-n", "--by-name", action="store_true")
+    so.add_argument("--run-records", type=int, default=1_000_000,
+                    help="records per in-memory sort run (memory bound)")
     so.set_defaults(fn=cmd_sort)
 
     f = sub.add_parser("fixmate", help="fill mate fields on name-grouped BAM")

@@ -1,0 +1,146 @@
+"""External (spill-merge) BAM sort — the MR-shuffle analog at any scale.
+
+The reference never sorted in-library: its CLI `sort` plugin keyed records
+into the MapReduce shuffle and let Hadoop's external merge sort do the work.
+This module is that machinery in-process: decode spans, accumulate bounded
+runs, sort each run, spill as headerless BGZF shards, then k-way merge by
+key into the final file (header written once, BGZF EOF terminator last —
+the same shard-concatenation contract as utils/mergers.py).
+
+Keys follow the SAM spec orderings: coordinate = (refid with unmapped
+last, pos); queryname = read-name bytes.
+"""
+from __future__ import annotations
+
+import heapq
+import os
+import re
+import tempfile
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bam import SAMHeader
+
+_UNMAPPED = 1 << 40
+
+
+def coordinate_key(rec: bytes) -> Tuple[int, int]:
+    """(refid, pos) from raw record bytes; unmapped (refid -1) sorts last
+    [SPEC coordinate order]."""
+    refid = int.from_bytes(rec[4:8], "little", signed=True)
+    pos = int.from_bytes(rec[8:12], "little", signed=True)
+    return (_UNMAPPED if refid < 0 else refid, pos)
+
+
+def name_key(rec: bytes) -> bytes:
+    """Read name bytes (NUL excluded) from raw record bytes."""
+    l_read_name = rec[16]
+    return rec[36:36 + l_read_name - 1]
+
+
+def _iter_run(path: str) -> Iterator[bytes]:
+    """Stream raw record bytes from a spilled run file."""
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.utils.seekable import as_byte_source
+
+    src = as_byte_source(path)
+    try:
+        _, first = read_bam_header(src)
+        r = bgzf.BGZFReader(src)
+        r.seek_voffset(first)
+        while True:
+            head = r.read(4)
+            if len(head) < 4:
+                return
+            bs = int.from_bytes(head, "little", signed=True)
+            body = r.read(bs)
+            if len(body) < bs:
+                raise ValueError(f"truncated run file {path}")
+            yield head + body
+    finally:
+        src.close()
+
+
+def _sorted_header(header: SAMHeader, by_name: bool) -> SAMHeader:
+    so = "queryname" if by_name else "coordinate"
+    text = header.text
+    if "@HD" in text:
+        text = re.sub(r"(@HD[^\n]*?)\tSO:\S*", r"\1", text, count=1)
+        text = re.sub(r"(@HD[^\n]*)", rf"\1\tSO:{so}", text, count=1)
+    else:
+        text = f"@HD\tVN:1.6\tSO:{so}\n" + text
+    return type(header)(text=text, ref_names=header.ref_names,
+                        ref_lengths=header.ref_lengths)
+
+
+def sort_bam(input_path: str, output_path: str, *, by_name: bool = False,
+             config: HBamConfig = DEFAULT_CONFIG,
+             run_records: int = 1_000_000,
+             tmp_dir: Optional[str] = None) -> int:
+    """Sort a BAM of any size with bounded memory; returns record count.
+
+    Memory bound ≈ run_records × record size; spills go to ``tmp_dir``
+    (a fresh temporary directory by default, removed afterwards).
+    """
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+
+    key: Callable = name_key if by_name else coordinate_key
+    ds = open_bam(input_path, config)
+    header = _sorted_header(ds.header, by_name)
+
+    own_tmp = tmp_dir is None
+    tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="hbam_sort_")
+    runs: List[str] = []
+    pending: List[Tuple] = []
+    total = 0
+
+    def spill() -> None:
+        if not pending:
+            return
+        pending.sort(key=lambda kv: kv[0])
+        run_path = os.path.join(tmp_dir, f"run-{len(runs):05d}.bam")
+        # level 1: runs are transient, trade ratio for speed
+        with BamWriter(run_path, ds.header, level=1) as w:
+            for _k, rec in pending:
+                w.write_record_bytes(rec)
+        runs.append(run_path)
+        pending.clear()
+
+    try:
+        for batch in ds.batches():
+            for i in range(len(batch)):
+                rec = batch.record_bytes(i)
+                pending.append((key(rec), rec))
+                total += 1
+            if len(pending) >= run_records:
+                spill()
+
+        with BamWriter(output_path, header) as w:
+            if not runs:  # everything fit in one run: sort + write directly
+                pending.sort(key=lambda kv: kv[0])
+                for _k, rec in pending:
+                    w.write_record_bytes(rec)
+            else:
+                spill()
+                merged = heapq.merge(
+                    *(((key(rec), rec) for rec in _iter_run(p))
+                      for p in runs),
+                    key=lambda kv: kv[0])
+                for _k, rec in merged:
+                    w.write_record_bytes(rec)
+    finally:
+        if own_tmp:
+            for p in runs:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            try:
+                os.rmdir(tmp_dir)
+            except OSError:
+                pass
+    return total

@@ -159,3 +159,49 @@ def test_summarize(bam_file, capsys):
 def test_error_path(capsys):
     assert main(["view", "/does/not/exist.bam"]) == 1
     assert "error:" in capsys.readouterr().err
+
+
+def test_external_sort_multiple_runs(tmp_path):
+    """Spill-merge sort with tiny runs produces globally sorted output
+    with every record preserved, identical to a single in-memory sort."""
+    import random
+
+    from fixtures import make_header, make_records
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.utils.sort import coordinate_key, name_key, sort_bam
+
+    header = make_header()
+    records = make_records(header, 2000, seed=41)
+    random.Random(5).shuffle(records)
+    path = str(tmp_path / "unsorted.bam")
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_sam_record(r)
+
+    out_ext = str(tmp_path / "sorted_ext.bam")
+    n = sort_bam(path, out_ext, run_records=300)  # forces ~7 runs
+    assert n == len(records)
+    out_mem = str(tmp_path / "sorted_mem.bam")
+    assert sort_bam(path, out_mem, run_records=10_000_000) == len(records)
+    assert open(out_ext, "rb").read() != b""
+
+    def record_bytes(p):
+        ds = open_bam(p)
+        return [b.record_bytes(i) for bt in ds.batches()
+                for b, i in ((bt, j) for j in range(len(bt)))]
+
+    ext = record_bytes(out_ext)
+    mem = record_bytes(out_mem)
+    keys = [coordinate_key(r) for r in ext]
+    assert keys == sorted(keys)
+    assert sorted(ext) == sorted(mem)         # same multiset
+    assert [coordinate_key(r) for r in mem] == keys  # same global order
+    hdr = open_bam(out_ext).header
+    assert "SO:coordinate" in hdr.text
+
+    # queryname mode
+    out_qn = str(tmp_path / "sorted_qn.bam")
+    sort_bam(path, out_qn, by_name=True, run_records=256)
+    qn = [name_key(r) for r in record_bytes(out_qn)]
+    assert qn == sorted(qn)
