@@ -182,10 +182,15 @@ def cmd_summarize(args) -> int:
 
 def cmd_seq_stats(args) -> int:
     from hadoop_bam_tpu.parallel.pipeline import (
-        PayloadGeometry, seq_stats_file,
+        PayloadGeometry, fastq_seq_stats_file, seq_stats_file,
     )
     geometry = PayloadGeometry(max_len=args.max_len)
-    stats = seq_stats_file(args.path, geometry=geometry)
+    lower = args.path.lower()
+    if lower.endswith((".fastq", ".fq", ".fastq.gz", ".fq.gz", ".qseq",
+                       ".qseq.gz", ".txt")):
+        stats = fastq_seq_stats_file(args.path, geometry=geometry)
+    else:
+        stats = seq_stats_file(args.path, geometry=geometry)
     print(f"reads\t{stats['n_reads']}")
     print(f"mean_gc\t{stats['mean_gc']:.6f}")
     print(f"mean_qual\t{stats['mean_qual']:.3f}")
@@ -279,19 +284,11 @@ def _alen(r) -> int:
 # ---------------------------------------------------------------------------
 
 def cmd_vcf_sort(args) -> int:
-    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
-    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.utils.sort import sort_vcf
 
-    ds = open_vcf(args.input)
-    header = ds.header
-    recs = list(ds.records())
-    contig_order = {c: i for i, c in enumerate(header.contigs)}
-    recs.sort(key=lambda r: (contig_order.get(r.chrom, 1 << 30), r.pos))
-    w = open_vcf_writer(args.output, header)
-    for r in recs:
-        w.write_record(r)
-    w.close()
-    print(f"wrote {args.output} ({len(recs)} records)")
+    n = sort_vcf(args.input, args.output,
+                 run_records=args.run_records)
+    print(f"wrote {args.output} ({n} records)")
     return 0
 
 
@@ -358,9 +355,11 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("output")
     f.set_defaults(fn=cmd_fixmate)
 
-    vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos)")
+    vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos) "
+                                         "(external spill-merge)")
     vs.add_argument("input")
     vs.add_argument("output")
+    vs.add_argument("--run-records", type=int, default=1_000_000)
     vs.set_defaults(fn=cmd_vcf_sort)
     return p
 

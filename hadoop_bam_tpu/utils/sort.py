@@ -76,6 +76,74 @@ def _sorted_header(header: SAMHeader, by_name: bool) -> SAMHeader:
                         ref_lengths=header.ref_lengths)
 
 
+def sort_vcf(input_path: str, output_path: str, *,
+             config: HBamConfig = DEFAULT_CONFIG,
+             run_records: int = 1_000_000,
+             tmp_dir: Optional[str] = None) -> int:
+    """External (contig, pos) sort for VCF/BCF — runs spill as BCF shards
+    (compact binary), k-way merged into the output container chosen by the
+    output extension.  Returns record count."""
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+
+    ds = open_vcf(input_path, config)
+    header = ds.header
+    contig_order = {c: i for i, c in enumerate(header.contigs)}
+
+    def key(rec) -> Tuple[int, int]:
+        return (contig_order.get(rec.chrom, 1 << 30), rec.pos)
+
+    own_tmp = tmp_dir is None
+    tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="hbam_vcfsort_")
+    runs: List[str] = []
+    pending: List = []
+    total = 0
+
+    def spill() -> None:
+        if not pending:
+            return
+        pending.sort(key=lambda kv: kv[0])
+        run_path = os.path.join(tmp_dir, f"run-{len(runs):05d}.bcf")
+        with open_vcf_writer(run_path, header) as w:
+            for _k, rec in pending:
+                w.write_record(rec)
+        runs.append(run_path)
+        pending.clear()
+
+    try:
+        for rec in ds.records():
+            pending.append((key(rec), rec))
+            total += 1
+            if len(pending) >= run_records:
+                spill()
+        with open_vcf_writer(output_path, header) as w:
+            if not runs:
+                pending.sort(key=lambda kv: kv[0])
+                for _k, rec in pending:
+                    w.write_record(rec)
+            else:
+                spill()
+                merged = heapq.merge(
+                    *(((key(rec), rec)
+                       for rec in open_vcf(p, config).records())
+                      for p in runs),
+                    key=lambda kv: kv[0])
+                for _k, rec in merged:
+                    w.write_record(rec)
+    finally:
+        if own_tmp:
+            for p in runs:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            try:
+                os.rmdir(tmp_dir)
+            except OSError:
+                pass
+    return total
+
+
 def sort_bam(input_path: str, output_path: str, *, by_name: bool = False,
              config: HBamConfig = DEFAULT_CONFIG,
              run_records: int = 1_000_000,

@@ -205,3 +205,30 @@ def test_external_sort_multiple_runs(tmp_path):
     sort_bam(path, out_qn, by_name=True, run_records=256)
     qn = [name_key(r) for r in record_bytes(out_qn)]
     assert qn == sorted(qn)
+
+
+def test_external_vcf_sort_multiple_runs(tmp_path):
+    import random
+
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+    from hadoop_bam_tpu.utils.sort import sort_vcf
+
+    header_text = ("##fileformat=VCFv4.2\n"
+                   "##contig=<ID=c1,length=100000>\n"
+                   "##contig=<ID=c2,length=100000>\n"
+                   "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+    rng = random.Random(13)
+    recs = [f"c{rng.choice([1, 2])}\t{rng.randint(1, 99999)}\t.\tA\tG\t"
+            f"30\tPASS\t." for _ in range(1500)]
+    path = str(tmp_path / "u.vcf")
+    with open(path, "w") as f:
+        f.write(header_text)
+        f.write("\n".join(recs) + "\n")
+    out = str(tmp_path / "s.vcf")
+    n = sort_vcf(path, out, run_records=200)  # forces ~8 BCF runs
+    assert n == 1500
+    ds = open_vcf(out)
+    got = [(r.chrom, r.pos) for r in ds.records()]
+    assert got == sorted(got)
+    assert len(got) == 1500
