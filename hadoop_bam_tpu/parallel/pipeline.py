@@ -843,6 +843,13 @@ def make_read_stats_step(mesh: Mesh, geometry: PayloadGeometry,
     return step
 
 
+# text read-format extensions recognized by the payload stats dispatch
+# (single source of truth — the CLI imports these)
+FASTQ_EXTS = (".fastq", ".fq", ".fastq.gz", ".fq.gz")
+QSEQ_EXTS = (".qseq", ".qseq.gz")
+TEXT_READ_EXTS = FASTQ_EXTS + QSEQ_EXTS
+
+
 def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                          config: HBamConfig = DEFAULT_CONFIG,
                          geometry: Optional[PayloadGeometry] = None,
@@ -863,8 +870,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         geometry = PayloadGeometry()
     cap = geometry.tile_records
     lower = path.lower()
-    ds = open_qseq(path, config) if lower.endswith((".qseq", ".qseq.gz",
-                                                    ".txt")) \
+    ds = open_qseq(path, config) if lower.endswith(QSEQ_EXTS) \
         else open_fastq(path, config)
     spans = ds.spans()
     step = make_read_stats_step(mesh, geometry)

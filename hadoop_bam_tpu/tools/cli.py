@@ -182,12 +182,11 @@ def cmd_summarize(args) -> int:
 
 def cmd_seq_stats(args) -> int:
     from hadoop_bam_tpu.parallel.pipeline import (
-        PayloadGeometry, fastq_seq_stats_file, seq_stats_file,
+        TEXT_READ_EXTS, PayloadGeometry, fastq_seq_stats_file,
+        seq_stats_file,
     )
     geometry = PayloadGeometry(max_len=args.max_len)
-    lower = args.path.lower()
-    if lower.endswith((".fastq", ".fq", ".fastq.gz", ".fq.gz", ".qseq",
-                       ".qseq.gz", ".txt")):
+    if args.path.lower().endswith(TEXT_READ_EXTS):
         stats = fastq_seq_stats_file(args.path, geometry=geometry)
     else:
         stats = seq_stats_file(args.path, geometry=geometry)

@@ -1,14 +1,15 @@
-"""External (spill-merge) BAM sort — the MR-shuffle analog at any scale.
+"""External (spill-merge) sorts — the MR-shuffle analog at any scale.
 
 The reference never sorted in-library: its CLI `sort` plugin keyed records
 into the MapReduce shuffle and let Hadoop's external merge sort do the work.
-This module is that machinery in-process: decode spans, accumulate bounded
-runs, sort each run, spill as headerless BGZF shards, then k-way merge by
-key into the final file (header written once, BGZF EOF terminator last —
-the same shard-concatenation contract as utils/mergers.py).
+This module is that machinery in-process: decode, accumulate bounded runs,
+sort each run, spill, then k-way merge by key into the final file.  One
+shared scaffold (`_external_sort`) parameterized by (record stream, run
+writer, run reader, output writer, key); BAM and VCF instantiate it.
 
-Keys follow the SAM spec orderings: coordinate = (refid with unmapped
-last, pos); queryname = read-name bytes.
+Keys follow the SAM/VCF spec orderings: BAM coordinate = (refid with
+unmapped last, pos); queryname = read-name bytes; VCF = (contig order with
+undeclared contigs last, POS).
 """
 from __future__ import annotations
 
@@ -16,9 +17,7 @@ import heapq
 import os
 import re
 import tempfile
-from typing import Callable, Iterator, List, Optional, Tuple
-
-import numpy as np
+from typing import Callable, Iterable, Iterator, List, Optional, Tuple
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats import bgzf
@@ -41,8 +40,68 @@ def name_key(rec: bytes) -> bytes:
     return rec[36:36 + l_read_name - 1]
 
 
-def _iter_run(path: str) -> Iterator[bytes]:
-    """Stream raw record bytes from a spilled run file."""
+def _external_sort(records: Iterable, key: Callable,
+                   write_run: Callable, iter_run: Callable,
+                   write_output: Callable, run_records: int,
+                   tmp_dir: Optional[str], run_suffix: str) -> int:
+    """Shared spill-merge scaffold.
+
+    - ``write_run(path, sorted_records)`` spills one run;
+    - ``iter_run(path)`` STREAMS a run back (bounded memory — the whole
+      point; never materialize a run);
+    - ``write_output(record_iter)`` writes the final sorted stream.
+    Returns the record count.
+    """
+    own_tmp = tmp_dir is None
+    tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="hbam_sort_")
+    runs: List[str] = []
+    pending: List[Tuple] = []
+    total = 0
+
+    def spill() -> None:
+        if not pending:
+            return
+        pending.sort(key=lambda kv: kv[0])
+        run_path = os.path.join(tmp_dir, f"run-{len(runs):05d}{run_suffix}")
+        write_run(run_path, (rec for _k, rec in pending))
+        runs.append(run_path)
+        pending.clear()
+
+    try:
+        for rec in records:
+            pending.append((key(rec), rec))
+            total += 1
+            if len(pending) >= run_records:
+                spill()
+        if not runs:  # everything fit in one run: sort + write directly
+            pending.sort(key=lambda kv: kv[0])
+            write_output(rec for _k, rec in pending)
+        else:
+            spill()
+            merged = heapq.merge(
+                *(((key(rec), rec) for rec in iter_run(p)) for p in runs),
+                key=lambda kv: kv[0])
+            write_output(rec for _k, rec in merged)
+    finally:
+        if own_tmp:
+            for p in runs:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            try:
+                os.rmdir(tmp_dir)
+            except OSError:
+                pass
+    return total
+
+
+# ---------------------------------------------------------------------------
+# BAM
+# ---------------------------------------------------------------------------
+
+def _iter_bam_run(path: str) -> Iterator[bytes]:
+    """Stream raw record bytes from a spilled BAM run file."""
     from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.utils.seekable import as_byte_source
 
@@ -76,74 +135,6 @@ def _sorted_header(header: SAMHeader, by_name: bool) -> SAMHeader:
                         ref_lengths=header.ref_lengths)
 
 
-def sort_vcf(input_path: str, output_path: str, *,
-             config: HBamConfig = DEFAULT_CONFIG,
-             run_records: int = 1_000_000,
-             tmp_dir: Optional[str] = None) -> int:
-    """External (contig, pos) sort for VCF/BCF — runs spill as BCF shards
-    (compact binary), k-way merged into the output container chosen by the
-    output extension.  Returns record count."""
-    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
-    from hadoop_bam_tpu.api.writers import open_vcf_writer
-
-    ds = open_vcf(input_path, config)
-    header = ds.header
-    contig_order = {c: i for i, c in enumerate(header.contigs)}
-
-    def key(rec) -> Tuple[int, int]:
-        return (contig_order.get(rec.chrom, 1 << 30), rec.pos)
-
-    own_tmp = tmp_dir is None
-    tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="hbam_vcfsort_")
-    runs: List[str] = []
-    pending: List = []
-    total = 0
-
-    def spill() -> None:
-        if not pending:
-            return
-        pending.sort(key=lambda kv: kv[0])
-        run_path = os.path.join(tmp_dir, f"run-{len(runs):05d}.bcf")
-        with open_vcf_writer(run_path, header) as w:
-            for _k, rec in pending:
-                w.write_record(rec)
-        runs.append(run_path)
-        pending.clear()
-
-    try:
-        for rec in ds.records():
-            pending.append((key(rec), rec))
-            total += 1
-            if len(pending) >= run_records:
-                spill()
-        with open_vcf_writer(output_path, header) as w:
-            if not runs:
-                pending.sort(key=lambda kv: kv[0])
-                for _k, rec in pending:
-                    w.write_record(rec)
-            else:
-                spill()
-                merged = heapq.merge(
-                    *(((key(rec), rec)
-                       for rec in open_vcf(p, config).records())
-                      for p in runs),
-                    key=lambda kv: kv[0])
-                for _k, rec in merged:
-                    w.write_record(rec)
-    finally:
-        if own_tmp:
-            for p in runs:
-                try:
-                    os.unlink(p)
-                except OSError:
-                    pass
-            try:
-                os.rmdir(tmp_dir)
-            except OSError:
-                pass
-    return total
-
-
 def sort_bam(input_path: str, output_path: str, *, by_name: bool = False,
              config: HBamConfig = DEFAULT_CONFIG,
              run_records: int = 1_000_000,
@@ -156,59 +147,76 @@ def sort_bam(input_path: str, output_path: str, *, by_name: bool = False,
     from hadoop_bam_tpu.api.dataset import open_bam
     from hadoop_bam_tpu.formats.bamio import BamWriter
 
-    key: Callable = name_key if by_name else coordinate_key
     ds = open_bam(input_path, config)
-    header = _sorted_header(ds.header, by_name)
+    out_header = _sorted_header(ds.header, by_name)
 
-    own_tmp = tmp_dir is None
-    tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="hbam_sort_")
-    runs: List[str] = []
-    pending: List[Tuple] = []
-    total = 0
-
-    def spill() -> None:
-        if not pending:
-            return
-        pending.sort(key=lambda kv: kv[0])
-        run_path = os.path.join(tmp_dir, f"run-{len(runs):05d}.bam")
-        # level 1: runs are transient, trade ratio for speed
-        with BamWriter(run_path, ds.header, level=1) as w:
-            for _k, rec in pending:
-                w.write_record_bytes(rec)
-        runs.append(run_path)
-        pending.clear()
-
-    try:
+    def records() -> Iterator[bytes]:
         for batch in ds.batches():
             for i in range(len(batch)):
-                rec = batch.record_bytes(i)
-                pending.append((key(rec), rec))
-                total += 1
-            if len(pending) >= run_records:
-                spill()
+                yield batch.record_bytes(i)
 
-        with BamWriter(output_path, header) as w:
-            if not runs:  # everything fit in one run: sort + write directly
-                pending.sort(key=lambda kv: kv[0])
-                for _k, rec in pending:
-                    w.write_record_bytes(rec)
-            else:
-                spill()
-                merged = heapq.merge(
-                    *(((key(rec), rec) for rec in _iter_run(p))
-                      for p in runs),
-                    key=lambda kv: kv[0])
-                for _k, rec in merged:
-                    w.write_record_bytes(rec)
-    finally:
-        if own_tmp:
-            for p in runs:
-                try:
-                    os.unlink(p)
-                except OSError:
-                    pass
-            try:
-                os.rmdir(tmp_dir)
-            except OSError:
-                pass
-    return total
+    def write_run(path, recs) -> None:
+        # level 1: runs are transient, trade ratio for speed
+        with BamWriter(path, ds.header, level=1) as w:
+            for rec in recs:
+                w.write_record_bytes(rec)
+
+    def write_output(recs) -> None:
+        with BamWriter(output_path, out_header) as w:
+            for rec in recs:
+                w.write_record_bytes(rec)
+
+    return _external_sort(records(), name_key if by_name else coordinate_key,
+                          write_run, _iter_bam_run, write_output,
+                          run_records, tmp_dir, ".bam")
+
+
+# ---------------------------------------------------------------------------
+# VCF / BCF
+# ---------------------------------------------------------------------------
+
+def _iter_vcf_run(path: str) -> Iterator:
+    """Stream VcfRecords from a spilled text run, one line at a time."""
+    from hadoop_bam_tpu.formats.vcf import VcfRecord
+
+    with open(path, "r") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line and not line.startswith("#"):
+                yield VcfRecord.from_line(line)
+
+
+def sort_vcf(input_path: str, output_path: str, *,
+             config: HBamConfig = DEFAULT_CONFIG,
+             run_records: int = 1_000_000,
+             tmp_dir: Optional[str] = None) -> int:
+    """External (contig, pos) sort for VCF/BCF; returns record count.
+
+    Runs spill as headerless TEXT VCF: no contig dictionary needed (a text
+    VCF may legally use contigs with no ##contig line, which BCF runs
+    would reject), and text streams back line-by-line, keeping the merge's
+    memory bound at one record per run.  The output container follows the
+    output extension and ``config`` (open_vcf_writer).
+    """
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+
+    ds = open_vcf(input_path, config)
+    header = ds.header
+    contig_order = {c: i for i, c in enumerate(header.contigs)}
+
+    def key(rec) -> Tuple[int, int]:
+        return (contig_order.get(rec.chrom, 1 << 30), rec.pos)
+
+    def write_run(path, recs) -> None:
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(rec.to_line() + "\n")
+
+    def write_output(recs) -> None:
+        with open_vcf_writer(output_path, header, config=config) as w:
+            for rec in recs:
+                w.write_record(rec)
+
+    return _external_sort(ds.records(), key, write_run, _iter_vcf_run,
+                          write_output, run_records, tmp_dir, ".vcf")

@@ -232,3 +232,25 @@ def test_external_vcf_sort_multiple_runs(tmp_path):
     got = [(r.chrom, r.pos) for r in ds.records()]
     assert got == sorted(got)
     assert len(got) == 1500
+
+
+def test_vcf_sort_undeclared_contigs(tmp_path):
+    """Text VCF with no ##contig lines (legal) must still external-sort —
+    runs spill as text, so no BCF contig dictionary is required."""
+    import random
+
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.utils.sort import sort_vcf
+
+    header_text = ("##fileformat=VCFv4.2\n"
+                   "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+    rng = random.Random(2)
+    path = str(tmp_path / "nc.vcf")
+    with open(path, "w") as f:
+        f.write(header_text)
+        for _ in range(700):
+            f.write(f"chrX\t{rng.randint(1, 9999)}\t.\tA\tT\t9\tPASS\t.\n")
+    out = str(tmp_path / "nc_sorted.vcf")
+    assert sort_vcf(path, out, run_records=100) == 700  # forces 7 runs
+    got = [r.pos for r in open_vcf(out).records()]
+    assert got == sorted(got) and len(got) == 700
