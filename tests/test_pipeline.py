@@ -148,3 +148,24 @@ def test_broadcast_and_assign(bam):
     flat = [s for p in parts for s in p]
     assert sorted(flat, key=lambda s: s.start_voffset) == spans
     assert all(len(p) >= 1 for p in parts)
+
+
+def test_two_host_simulation(bam):
+    """Simulate the multi-host protocol single-process: host 0 plans,
+    every 'host' decodes only its assigned spans, and the per-host stats
+    sum to the whole-file answer (psum-over-DCN equivalence)."""
+    path, header, records, voffs = bam
+    from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+
+    spans = plan_bam_spans(path, num_spans=6, header=header)
+    whole = flagstat_file(path, header=header, spans=spans)
+    merged = {k: 0 for k in FLAGSTAT_FIELDS}
+    for host in range(2):
+        part = assign_spans(spans, index=host, count=2)
+        assert part, "each host must get work"
+        stats = flagstat_file(path, header=header, spans=part)
+        for k in FLAGSTAT_FIELDS:
+            merged[k] += stats[k]
+    assert merged == whole
+    assert whole["total"] == len(records)
