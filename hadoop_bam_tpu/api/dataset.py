@@ -56,8 +56,11 @@ class BamDataset:
     def spans(self, num_spans: Optional[int] = None) -> List[FileVirtualSpan]:
         _check_replan(self, num_spans)
         if self._plan is None:
-            self._plan = plan_bam_spans(self.path, num_spans=num_spans,
-                                        config=self.config, header=self.header)
+            from hadoop_bam_tpu.split.planners import (
+                plan_spans_maybe_intervals,
+            )
+            self._plan = plan_spans_maybe_intervals(
+                self.path, self.header, self.config, num_spans=num_spans)
             self._plan_num_spans = num_spans
         return self._plan
 

@@ -963,8 +963,9 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         src = as_byte_source(path)
         n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
         src.close()
-        spans = plan_bam_spans(path, num_spans=n_spans, config=config,
-                               header=header)
+        from hadoop_bam_tpu.split.planners import plan_spans_maybe_intervals
+        spans = plan_spans_maybe_intervals(path, header, config,
+                                           num_spans=n_spans)
 
     step = make_seq_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
@@ -1024,8 +1025,9 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
         src = as_byte_source(path)
         n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
         src.close()
-        spans = plan_bam_spans(path, num_spans=n_spans, config=config,
-                               header=header)
+        from hadoop_bam_tpu.split.planners import plan_spans_maybe_intervals
+        spans = plan_spans_maybe_intervals(path, header, config,
+                                           num_spans=n_spans)
 
     projection = FLAGSTAT_PROJECTION
     row_bytes = projection_row_bytes(projection)

@@ -142,6 +142,24 @@ def _next_name_group_start(path: str, boundary: int, header: SAMHeader,
     return boundary   # name group exceeds the window: leave the boundary
 
 
+def plan_spans_maybe_intervals(path: str, header, config,
+                               num_spans: Optional[int] = None):
+    """plan_bam_spans, but when ``config.bam_intervals`` is set and a
+    ``.bai`` sidecar exists, trim the plan to the index's chunk ranges —
+    the reference's BAI split trimming (hb/BAMInputFormat.java 7.7+): only
+    file regions that can contain overlapping records are read at all;
+    exact row filtering still happens in the decoders."""
+    if getattr(config, "bam_intervals", None):
+        from hadoop_bam_tpu.split.bai import plan_interval_spans
+        from hadoop_bam_tpu.split.intervals import parse_intervals
+        ivs = parse_intervals(config.bam_intervals, header.ref_names)
+        spans = plan_interval_spans(path, ivs, header)
+        if spans is not None:
+            return spans
+    return plan_bam_spans(path, num_spans=num_spans, config=config,
+                          header=header)
+
+
 def read_bam_span(source, span: FileVirtualSpan,
                   header: Optional[SAMHeader] = None,
                   check_crc: bool = False) -> BamBatch:

@@ -129,8 +129,12 @@ def _view_vcf(args) -> int:
 def cmd_index(args) -> int:
     from hadoop_bam_tpu.split.splitting_index import write_splitting_index
     for path in args.paths:
-        out = write_splitting_index(path, granularity=args.granularity,
-                                    flavor=args.flavor)
+        if args.flavor == "bai":
+            from hadoop_bam_tpu.split.bai import write_bai
+            out = write_bai(path)
+        else:
+            out = write_splitting_index(path, granularity=args.granularity,
+                                        flavor=args.flavor)
         print(f"wrote {out}")
     return 0
 
@@ -315,8 +319,10 @@ def build_parser() -> argparse.ArgumentParser:
     i = sub.add_parser("index", help="build splitting index sidecar(s)")
     i.add_argument("paths", nargs="+")
     i.add_argument("-g", "--granularity", type=int, default=4096)
-    i.add_argument("--flavor", choices=["splitting-bai", "sbi"],
-                   default="splitting-bai")
+    i.add_argument("--flavor", choices=["splitting-bai", "sbi", "bai"],
+                   default="splitting-bai",
+                   help="bai = genomic BAI (needs coordinate-sorted input; "
+                        "enables interval split trimming)")
     i.set_defaults(fn=cmd_index)
 
     c = sub.add_parser("cat", help="concatenate same-header BAMs")

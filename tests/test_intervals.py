@@ -199,3 +199,67 @@ def test_mesh_flagstat_honors_intervals(tmp_path):
 
     sstats = ds.seq_stats()
     assert sstats["n_reads"] == stats["total"]
+
+
+def _sorted_bam(tmp_path, n=4000, seed=17):
+    from fixtures import make_header, make_records
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+
+    header = make_header()
+    records = make_records(header, n, seed=seed)
+    rid = {name: i for i, name in enumerate(header.ref_names)}
+    records.sort(key=lambda r: (rid.get(r.rname, 1 << 30), r.pos))
+    path = str(tmp_path / "sorted.bam")
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_sam_record(r)
+    return path, header, records
+
+
+def test_bai_round_trip_and_query(tmp_path):
+    from hadoop_bam_tpu.split.bai import (
+        BaiIndex, build_bai, reg2bin, reg2bins,
+    )
+
+    # spec arithmetic sanity
+    assert reg2bin(0, 1) == 4681
+    assert reg2bin(0, 1 << 29) == 0
+    assert 4681 in reg2bins(0, 100)
+    assert 0 in reg2bins(0, 100)
+
+    path, header, records = _sorted_bam(tmp_path)
+    idx = build_bai(path)
+    back = BaiIndex.from_bytes(idx.to_bytes())
+    assert len(back.refs) == len(header.ref_names)
+    ranges = back.query(0, 0, 1 << 29)
+    assert ranges and ranges[0][0] < ranges[-1][1]
+    # a region beyond all data yields nothing
+    assert back.query(0, (1 << 28), (1 << 28) + 100) == []
+
+
+def test_bai_split_trimming_matches_full_scan(tmp_path):
+    import dataclasses
+
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.split.bai import write_bai
+
+    path, header, records = _sorted_bam(tmp_path)
+    iv = f"{header.ref_names[0]}:5000-20000"
+    cfg = dataclasses.replace(DEFAULT_CONFIG, bam_intervals=iv)
+
+    # full-scan (no .bai yet): plans over the whole file + row filter
+    full = open_bam(path, cfg).flagstat()
+
+    write_bai(path)
+    ds = open_bam(path, cfg)
+    spans = ds.spans()
+    import os
+    assert sum(s.compressed_size for s in spans) < os.path.getsize(path), \
+        "BAI trimming should read less than the whole file"
+    trimmed = ds.flagstat()
+    assert trimmed == full
+    assert 0 < trimmed["total"] < len(records)
+
+    # seq stats agree too
+    assert ds.seq_stats()["n_reads"] == trimmed["total"]
