@@ -176,6 +176,10 @@ def cmd_summarize(args) -> int:
     from hadoop_bam_tpu.parallel.pipeline import flagstat_file
     stats = flagstat_file(args.path)
     sys.stdout.write(format_flagstat(stats))
+    if args.metrics:
+        from hadoop_bam_tpu.utils.metrics import METRICS
+        print("\n-- pipeline metrics --", file=sys.stderr)
+        print(METRICS.render(), file=sys.stderr)
     return 0
 
 
@@ -332,6 +336,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("summarize", help="distributed flagstat")
     s.add_argument("path")
+    s.add_argument("--metrics", action="store_true",
+                   help="dump pipeline stage counters/timers to stderr")
     s.set_defaults(fn=cmd_summarize)
 
     sq = sub.add_parser("seq-stats",
