@@ -188,6 +188,13 @@ def _decode_order0(buf: bytes, pos: int, out_size: int) -> bytes:
         if freqs[s]:
             slot2sym[cum[s]:cum[s + 1]] = s
 
+    from hadoop_bam_tpu.utils import native
+    if native.available():
+        return native.rans_decode(
+            0, np.frombuffer(buf, dtype=np.uint8), pos,
+            freqs.astype(np.uint32), cum[:256].astype(np.uint32),
+            slot2sym, out_size).tobytes()
+
     data = np.frombuffer(buf, dtype=np.uint8)
     states = np.frombuffer(buf[pos:pos + 16], dtype="<u4").astype(np.int64)
     ptr = pos + 16
@@ -324,6 +331,14 @@ def _decode_order1(buf: bytes, pos: int, out_size: int) -> bytes:
                 break
             else:
                 c = nxt
+    from hadoop_bam_tpu.utils import native
+    if native.available():
+        return native.rans_decode(
+            1, np.frombuffer(buf, dtype=np.uint8), pos,
+            np.ascontiguousarray(freqs.astype(np.uint32)),
+            np.ascontiguousarray(cums[:, :256].astype(np.uint32)),
+            np.ascontiguousarray(slot2sym), out_size).tobytes()
+
     data = np.frombuffer(buf, dtype=np.uint8)
     states = np.frombuffer(buf[pos:pos + 16], dtype="<u4").astype(np.int64)
     ptr = pos + 16

@@ -77,6 +77,11 @@ def load() -> Optional[ctypes.CDLL]:
             i8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             i8p, i8p, i8p, i64p, ctypes.c_int64, i64p]
+        for name in ("hbam_rans0_decode", "hbam_rans1_decode"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [i8p, ctypes.c_int64, ctypes.c_int64,
+                           u32p, u32p, i8p, i8p, ctypes.c_int64]
         lib.hbam_crc32_batch.restype = ctypes.c_int
         lib.hbam_crc32_batch.argtypes = [
             i8p, i64p, i32p, ctypes.c_int32, u32p, ctypes.c_int32]
@@ -192,6 +197,24 @@ def walk_bam_payload(buf: np.ndarray, start: int, cap: int, max_len: int,
     if n > cap:
         raise ValueError(f"record count {n} exceeds capacity {cap}")
     return prefix[:n], seq[:n], qual[:n], offs[:n], int(tail[0])
+
+
+def rans_decode(order: int, buf: np.ndarray, ptr: int, freqs: np.ndarray,
+                cum: np.ndarray, slot2sym: np.ndarray, out_size: int
+                ) -> np.ndarray:
+    """Native rANS 4x8 decode loop (tables parsed by the caller).
+    Raises on corrupt/truncated streams."""
+    lib = load()
+    assert lib is not None
+    out = np.empty(out_size, dtype=np.uint8)
+    fn = lib.hbam_rans1_decode if order else lib.hbam_rans0_decode
+    rc = fn(_ptr(buf, ctypes.c_uint8), buf.size, ptr,
+            _ptr(freqs, ctypes.c_uint32), _ptr(cum, ctypes.c_uint32),
+            _ptr(slot2sym, ctypes.c_uint8), _ptr(out, ctypes.c_uint8),
+            out_size)
+    if rc != 0:
+        raise ValueError("corrupt rANS stream (ran out of bytes)")
+    return out
 
 
 def available() -> bool:

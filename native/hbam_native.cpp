@@ -273,4 +273,103 @@ int hbam_deflate_batch(const uint8_t* src, const int64_t* src_off,
   return f >= 0 ? 1000 + f : 0;
 }
 
+// ---------------------------------------------------------------------------
+// rANS 4x8 decode (CRAM 3.0 entropy codec [SPEC CRAMv3 section 13]).
+// Frequency tables are parsed Python-side (once per stream); these run the
+// per-symbol loops, which dominate CRAM decode time in pure Python.
+// Semantics mirror formats/cram_codecs.py exactly, including byte-
+// consumption order during renormalization.
+// ---------------------------------------------------------------------------
+
+static const uint32_t kRansLow = 1u << 23;
+static const int kTfShift = 12;
+static const uint32_t kTotMask = (1u << kTfShift) - 1;
+
+// Order-0: 4 interleaved states over the whole output.
+// buf[ptr..ptr+16) holds the 4 little-endian initial states.
+int hbam_rans0_decode(const uint8_t* buf, int64_t buf_len, int64_t ptr,
+                      const uint32_t* freqs, const uint32_t* cum,
+                      const uint8_t* slot2sym,
+                      uint8_t* out, int64_t out_size) {
+  if (ptr + 16 > buf_len) return -1;
+  uint64_t states[4];
+  for (int j = 0; j < 4; ++j) {
+    uint32_t s;
+    std::memcpy(&s, buf + ptr + 4 * j, 4);
+    states[j] = s;
+  }
+  ptr += 16;
+  int64_t i = 0;
+  for (; i + 4 <= out_size; i += 4) {
+    for (int j = 0; j < 4; ++j) {
+      uint64_t x = states[j];
+      uint32_t m = static_cast<uint32_t>(x) & kTotMask;
+      uint8_t s = slot2sym[m];
+      out[i + j] = s;
+      x = static_cast<uint64_t>(freqs[s]) * (x >> kTfShift) + m - cum[s];
+      while (x < kRansLow) {
+        if (ptr >= buf_len) return -1;
+        x = (x << 8) | buf[ptr++];
+      }
+      states[j] = x;
+    }
+  }
+  for (int j = 0; i + j < out_size; ++j) {
+    uint64_t x = states[j];
+    uint32_t m = static_cast<uint32_t>(x) & kTotMask;
+    uint8_t s = slot2sym[m];
+    out[i + j] = s;
+    x = static_cast<uint64_t>(freqs[s]) * (x >> kTfShift) + m - cum[s];
+    while (x < kRansLow) {
+      if (ptr >= buf_len) return -1;
+      x = (x << 8) | buf[ptr++];
+    }
+    states[j] = x;
+  }
+  return 0;
+}
+
+// Order-1: per-context tables (freqs/cum [256*256], slot2sym [256*4096]);
+// 4 states own the output quarters, stepped together in j order (the byte
+// consumption order of the Python reference loop).
+int hbam_rans1_decode(const uint8_t* buf, int64_t buf_len, int64_t ptr,
+                      const uint32_t* freqs, const uint32_t* cum,
+                      const uint8_t* slot2sym,
+                      uint8_t* out, int64_t out_size) {
+  if (ptr + 16 > buf_len) return -1;
+  uint64_t states[4];
+  for (int j = 0; j < 4; ++j) {
+    uint32_t s;
+    std::memcpy(&s, buf + ptr + 4 * j, 4);
+    states[j] = s;
+  }
+  ptr += 16;
+  const int64_t q = out_size >> 2;
+  int64_t idx[4] = {0, q, 2 * q, 3 * q};
+  const int64_t ends[4] = {q, 2 * q, 3 * q, out_size};
+  int ctxs[4] = {0, 0, 0, 0};
+  bool done_all = false;
+  while (!done_all) {
+    done_all = true;
+    for (int j = 0; j < 4; ++j) {
+      if (idx[j] >= ends[j]) continue;
+      uint64_t x = states[j];
+      uint32_t m = static_cast<uint32_t>(x) & kTotMask;
+      int ctx = ctxs[j];
+      uint8_t s = slot2sym[static_cast<int64_t>(ctx) * 4096 + m];
+      out[idx[j]] = s;
+      const int64_t t = static_cast<int64_t>(ctx) * 256 + s;
+      x = static_cast<uint64_t>(freqs[t]) * (x >> kTfShift) + m - cum[t];
+      while (x < kRansLow) {
+        if (ptr >= buf_len) return -1;
+        x = (x << 8) | buf[ptr++];
+      }
+      states[j] = x;
+      ctxs[j] = s;
+      if (++idx[j] < ends[j]) done_all = false;
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"
