@@ -133,6 +133,11 @@ class Encoding:
     def decode_array(self, st: DecodeState) -> bytes:
         raise CRAMError(f"{type(self).__name__} cannot decode byte arrays")
 
+    def decode_bytes(self, st: DecodeState, n: int) -> bytes:
+        """n bytes of this series — the bulk fast path (EXTERNAL series
+        read a slice in one call; others fall back to per-byte decode)."""
+        return bytes(self.decode_byte(st) for _ in range(n))
+
     def params(self) -> bytes:
         raise NotImplementedError
 
@@ -160,6 +165,9 @@ class ExternalEncoding(Encoding):
 
     def decode_byte(self, st: DecodeState) -> int:
         return st.cursor(self.content_id).read_byte()
+
+    def decode_bytes(self, st: DecodeState, n: int) -> bytes:
+        return st.cursor(self.content_id).read_bytes(n)
 
     def params(self) -> bytes:
         return write_itf8(self.content_id)
@@ -263,9 +271,7 @@ class ByteArrayLenEncoding(Encoding):
 
     def decode_array(self, st: DecodeState) -> bytes:
         n = self.len_encoding.decode_int(st)
-        if isinstance(self.val_encoding, ExternalEncoding):
-            return st.cursor(self.val_encoding.content_id).read_bytes(n)
-        return bytes(self.val_encoding.decode_byte(st) for _ in range(n))
+        return self.val_encoding.decode_bytes(st, n)
 
     def params(self) -> bytes:
         return self.len_encoding.serialize() + self.val_encoding.serialize()
@@ -623,13 +629,11 @@ def decode_slice_records(comp: CompressionHeader, slice_hdr: SliceHeader,
             _decode_mapped(comp, st, r, ref_names, ref_source)
         else:
             ba = comp.series("BA")
-            r.seq = "".join(chr(ba.decode_byte(st))
-                            for _ in range(r.read_length))
+            r.seq = ba.decode_bytes(st, r.read_length).decode("latin-1")
             r.cigar = "*"
             if r.cf & CF_QUAL_STORED:
                 qs = comp.series("QS")
-                r.qual = bytes(qs.decode_byte(st)
-                               for _ in range(r.read_length))
+                r.qual = qs.decode_bytes(st, r.read_length)
         records.append(r)
     return records
 
@@ -676,8 +680,8 @@ def _decode_mapped(comp: CompressionHeader, st: DecodeState, r: CramRecord,
     r.mapq = comp.series("MQ").decode_int(st)
     quals = bytearray(b"\xff" * r.read_length)
     if r.cf & CF_QUAL_STORED:
-        qs = comp.series("QS")
-        quals = bytearray(qs.decode_byte(st) for _ in range(r.read_length))
+        quals = bytearray(
+            comp.series("QS").decode_bytes(st, r.read_length))
 
     # reconstruct seq + cigar from the feature list
     ref_base_at = _make_ref_lookup(r, ref_names, ref_source)

@@ -28,6 +28,9 @@ from hadoop_bam_tpu.formats.cram_decode import (
 from hadoop_bam_tpu.formats.cram_encode import encode_container
 from hadoop_bam_tpu.formats.sam import SamRecord
 
+# Phred -> ASCII(+33) translation table (bulk qual rendering)
+_Q33 = bytes(min(q + 33, 255) for q in range(256))
+
 DEFAULT_RECORDS_PER_CONTAINER = 10_000
 
 
@@ -255,7 +258,7 @@ def _to_sam(r: CramRecord, header: SAMHeader, counter: int) -> SamRecord:
     else:
         rnext = names[r.mate_ref_id] if r.mate_ref_id < len(names) else "*"
     if r.cf & CF_QUAL_STORED and r.qual:
-        qual = "".join(chr(q + 33) for q in r.qual)
+        qual = bytes(r.qual).translate(_Q33).decode("latin-1")
     else:
         qual = "*"
     tags = list(r.tags)
