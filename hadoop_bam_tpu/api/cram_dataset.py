@@ -74,6 +74,12 @@ class CramDataset:
         yield from stream_read_tensor_batches(
             self.spans(num_spans), read_frags, self.config, mesh, geometry)
 
+    def flagstat(self, mesh=None) -> Dict[str, int]:
+        """Host-side flagstat over decoded CRAM records (same counters as
+        the BAM mesh path)."""
+        from hadoop_bam_tpu.api.dataset import _flagstat_records
+        return _flagstat_records(self.records())
+
     # -- checkpoint / resume (same contract as BamDataset) --
     def state_dict(self) -> Dict:
         return {"path": self.path,

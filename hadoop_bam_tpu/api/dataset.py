@@ -223,6 +223,45 @@ class SamDataset:
         for span in self.spans(num_spans):
             yield from self.read_span(span)
 
+    def flagstat(self, mesh=None) -> Dict[str, int]:
+        """Host-side flagstat (text SAM has no columnar device path);
+        same counter definitions as the BAM mesh path."""
+        return _flagstat_records(self.records())
+
+
+def _flagstat_records(records) -> Dict[str, int]:
+    """samtools-flagstat counters over an iterator of SamRecords — the
+    uniform fallback for datasets without a device decode path."""
+    import numpy as np
+
+    from hadoop_bam_tpu.formats.bam import BamBatch
+    from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS, flagstat_from_batch
+
+    stats = {k: 0 for k in FLAGSTAT_FIELDS}
+
+    class _Cols:
+        pass
+
+    flags, refids, mrefids, mapqs = [], [], [], []
+    names: Dict[str, int] = {}
+    for r in records:
+        flags.append(r.flag)
+        refids.append(-1 if r.rname == "*"
+                      else names.setdefault(r.rname, len(names)))
+        if r.rnext == "*":
+            mrefids.append(-1)
+        elif r.rnext == "=":
+            mrefids.append(refids[-1])
+        else:
+            mrefids.append(names.setdefault(r.rnext, len(names)))
+        mapqs.append(r.mapq)
+    batch = _Cols()
+    batch.flag = np.asarray(flags, dtype=np.int64)
+    batch.refid = np.asarray(refids, dtype=np.int64)
+    batch.mate_refid = np.asarray(mrefids, dtype=np.int64)
+    batch.mapq = np.asarray(mapqs, dtype=np.int64)
+    return flagstat_from_batch(batch, stats)
+
 
 def open_bam(path: str, config: HBamConfig = DEFAULT_CONFIG) -> BamDataset:
     return BamDataset(path, config)

@@ -143,3 +143,32 @@ def test_shard_merge_sam(files, tmp_path):
     hdr, got = read_sam_text(open(out).read())
     assert got == records
     assert hdr.ref_names == header.ref_names
+
+
+def test_flagstat_uniform_across_containers(tmp_path):
+    """open_any_sam(...).flagstat() works for BAM, SAM, and CRAM and
+    agrees across containers for the same records."""
+    import sys
+    sys.path.insert(0, "tests")
+    from fixtures import make_header, make_records
+    from hadoop_bam_tpu.api import open_any_sam
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.formats.cramio import write_cram
+
+    header = make_header()
+    recs = make_records(header, 800, seed=77)
+    bam = str(tmp_path / "u.bam")
+    with BamWriter(bam, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    sam = str(tmp_path / "u.sam")
+    with open(sam, "w") as f:
+        f.write(header.text)
+        for r in recs:
+            f.write(r.to_line() + "\n")
+    cram = str(tmp_path / "u.cram")
+    write_cram(cram, header, recs)
+
+    stats = {p: open_any_sam(p).flagstat() for p in (bam, sam, cram)}
+    assert stats[bam]["total"] == len(recs)
+    assert stats[bam] == stats[sam] == stats[cram]
