@@ -131,8 +131,13 @@ def deflate_block(payload: bytes, level: int = 6) -> bytes:
     """Build one complete BGZF block around ``payload`` (≤ WRITE_PAYLOAD_SIZE)."""
     if len(payload) > MAX_UNCOMPRESSED:
         raise BGZFError("payload exceeds 64 KiB BGZF limit")
-    co = zlib.compressobj(level, zlib.DEFLATED, -15)
-    cdata = co.compress(payload) + co.flush()
+    cdata = None
+    from hadoop_bam_tpu.utils import native
+    if native.available():
+        cdata = native.deflate_raw(payload, level)  # ~3x zlib (libdeflate)
+    if cdata is None:
+        co = zlib.compressobj(level, zlib.DEFLATED, -15)
+        cdata = co.compress(payload) + co.flush()
     if HEADER_SIZE + len(cdata) + FOOTER_SIZE > MAX_BLOCK_SIZE:
         # Incompressible data at high payload sizes: store uncompressed.
         co = zlib.compressobj(0, zlib.DEFLATED, -15)

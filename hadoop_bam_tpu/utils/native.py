@@ -199,6 +199,29 @@ def walk_bam_payload(buf: np.ndarray, start: int, cap: int, max_len: int,
     return prefix[:n], seq[:n], qual[:n], offs[:n], int(tail[0])
 
 
+def deflate_raw(payload: bytes, level: int = 6) -> Optional[bytes]:
+    """Compress one raw-DEFLATE stream natively (libdeflate when built in).
+    Returns None when the result would not beat the stored-block limit —
+    callers fall back to an uncompressed block."""
+    lib = load()
+    assert lib is not None
+    src = np.frombuffer(payload, dtype=np.uint8)
+    cap = max(len(payload) + 64, 256)
+    dst = np.empty(cap, dtype=np.uint8)
+    out_len = np.zeros(1, dtype=np.int32)
+    rc = lib.hbam_deflate_batch(
+        _ptr(src, ctypes.c_uint8),
+        _ptr(np.zeros(1, np.int64), ctypes.c_int64),
+        _ptr(np.asarray([len(payload)], np.int32), ctypes.c_int32), 1,
+        _ptr(dst, ctypes.c_uint8),
+        _ptr(np.zeros(1, np.int64), ctypes.c_int64),
+        _ptr(np.asarray([cap], np.int32), ctypes.c_int32),
+        _ptr(out_len, ctypes.c_int32), level, 1)
+    if rc or out_len[0] <= 0:
+        return None
+    return dst[:int(out_len[0])].tobytes()
+
+
 def rans_decode(order: int, buf: np.ndarray, ptr: int, freqs: np.ndarray,
                 cum: np.ndarray, slot2sym: np.ndarray, out_size: int
                 ) -> np.ndarray:

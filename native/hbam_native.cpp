@@ -241,6 +241,25 @@ int hbam_deflate_batch(const uint8_t* src, const int64_t* src_off,
   if (n_threads < 1) n_threads = 1;
   std::atomic<int32_t> next(0);
   std::atomic<int32_t> fail(-1);
+#if defined(HBAM_USE_LIBDEFLATE)
+  // libdeflate compresses ~3x faster than zlib at comparable ratios.
+  // out_len[i] = 0 signals "did not fit in dst_cap" (incompressible) —
+  // callers fall back to a stored block, matching the zlib-path contract
+  // where oversized output is also a caller-handled condition.
+  auto worker = [&]() {
+    libdeflate_compressor* c = libdeflate_alloc_compressor(level);
+    if (!c) { fail.store(0); return; }
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n_blocks || fail.load(std::memory_order_relaxed) >= 0) break;
+      size_t n = libdeflate_deflate_compress(
+          c, src + src_off[i], static_cast<size_t>(src_len[i]),
+          dst + dst_off[i], static_cast<size_t>(dst_cap[i]));
+      out_len[i] = static_cast<int32_t>(n);
+    }
+    libdeflate_free_compressor(c);
+  };
+#else
   auto worker = [&]() {
     for (;;) {
       int32_t i = next.fetch_add(1);
@@ -266,6 +285,7 @@ int hbam_deflate_batch(const uint8_t* src, const int64_t* src_off,
       deflateEnd(&zs);
     }
   };
+#endif
   std::vector<std::thread> pool;
   for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
   for (auto& th : pool) th.join();
