@@ -119,6 +119,21 @@ def encode_typed_int_scalar(v: int) -> bytes:
     return encode_typed_ints([v])
 
 
+def skip_typed(buf: bytes, off: int) -> int:
+    """Advance past one typed value without decoding it (fast-scan path)."""
+    desc = buf[off]
+    off += 1
+    count, typ = desc >> 4, desc & 0x0F
+    if count == 15:
+        _, cv, off = read_typed(buf, off)
+        count = int(cv[0])
+    if typ == T_MISSING:
+        return off
+    size = 1 if typ == T_CHAR else (4 if typ == T_FLOAT
+                                    else _INT_SIZE.get(typ, 4))
+    return off + size * count
+
+
 def read_typed(buf: bytes, off: int) -> Tuple[int, List, int]:
     """Read one typed value: returns (type, values list, new offset).
     Chars come back as one Python str; sentinels as None (missing) with
@@ -528,3 +543,105 @@ def plausible_record_start(buf: bytes, off: int, n_contigs: int,
     if n_allele > 1024:
         return False
     return True
+
+
+# ---------------------------------------------------------------------------
+# Fast column scan (the binary twin of the text tokenizer in
+# parallel/variant_pipeline.py): chrom/pos/flags + GT dosage straight from
+# record bytes, skipping ID/INFO entirely and non-GT FORMAT fields by size
+# arithmetic — no VcfRecord objects.  Semantics match BCFRecordCodec
+# (asserted by tests).
+# ---------------------------------------------------------------------------
+
+_SNP_BASES = frozenset(b"ACGTN")
+
+
+def scan_variant_columns(buf: bytes, header: VCFHeader, samples_pad: int
+                         ) -> Dict[str, "np.ndarray"]:
+    """All records in ``buf`` (concatenated BCF record bytes) -> typed
+    columns {chrom i32, pos i32 (1-based), flags u8, dosage i8
+    [n, samples_pad]}.  FLAG bits follow the variant pipeline: 1 = PASS,
+    2 = SNP."""
+
+    strings = header.string_dictionary()
+    try:
+        gt_key = strings.index("GT")
+    except ValueError:
+        gt_key = -1
+    n_samples = header.n_samples
+
+    chroms: List[int] = []
+    poss: List[int] = []
+    flags: List[int] = []
+    dosages: List[np.ndarray] = []
+    p = 0
+    n_buf = len(buf)
+    while p + 8 <= n_buf:
+        l_shared, l_indiv = struct.unpack_from("<II", buf, p)
+        base = p + 8
+        end_shared = base + l_shared
+        end = end_shared + l_indiv
+        if end > n_buf:
+            raise BCFError("truncated BCF record in scan")
+        chrom_idx, pos0 = struct.unpack_from("<ii", buf, base)
+        n_info, n_allele = struct.unpack_from("<HH", buf, base + 16)
+        ns_nf = struct.unpack_from("<I", buf, base + 20)[0]
+        n_sample, n_fmt = ns_nf & 0xFFFFFF, ns_nf >> 24
+        q = skip_typed(buf, base + 24)          # ID
+        # alleles: need lengths/content for the SNP flag
+        snp = n_allele >= 2
+        for k in range(n_allele):
+            desc = buf[q]
+            q += 1
+            count, typ = desc >> 4, desc & 0x0F
+            if count == 15:
+                _, cv, q = read_typed(buf, q)
+                count = int(cv[0])
+            if typ != T_CHAR:
+                raise BCFError("allele is not a char vector")
+            # REF (k == 0) only needs length 1; ALTs must also be bases
+            # (matches VariantBatch.is_snp)
+            if count != 1 or (k > 0 and buf[q] not in _SNP_BASES):
+                snp = False
+            q += count
+        # FILTER: typed int vector; PASS == exactly [0]
+        f_typ, f_vals, q = read_typed(buf, q)
+        is_pass = (len(f_vals) == 1 and int(f_vals[0]) == 0)
+        # INFO is skipped wholesale: jump to the indiv block
+        q = end_shared
+        dose = np.full(samples_pad, -1, dtype=np.int8)
+        seen_fmt = 0
+        while q < end and seen_fmt < n_fmt:
+            k_typ, k_vals, q = read_typed(buf, q)
+            key = int(k_vals[0])
+            desc = buf[q]
+            q += 1
+            count, typ = desc >> 4, desc & 0x0F
+            if count == 15:
+                _, cv, q = read_typed(buf, q)
+                count = int(cv[0])
+            size = 1 if typ == T_CHAR else (4 if typ == T_FLOAT
+                                            else _INT_SIZE.get(typ, 4))
+            data_len = size * count * n_sample
+            if key == gt_key and typ == T_INT8 and n_sample:
+                g = np.frombuffer(buf, np.int8, count * n_sample, q
+                                  ).reshape(n_sample, count)
+                valid = (g != INT8_EOV) & (g != 0)     # 0 = missing allele
+                alt = ((g.astype(np.int16) >> 1) - 1) > 0
+                d = np.where(valid.any(axis=1),
+                             (alt & valid).sum(axis=1), -1)
+                dose[:n_sample] = np.minimum(d, 127).astype(np.int8)
+            q += data_len
+            seen_fmt += 1
+        chroms.append(chrom_idx)
+        poss.append(pos0 + 1)
+        flags.append((1 if is_pass else 0) | (2 if snp else 0))
+        dosages.append(dose)
+        p = end
+    return {
+        "chrom": np.asarray(chroms, dtype=np.int32),
+        "pos": np.asarray(poss, dtype=np.int32),
+        "flags": np.asarray(flags, dtype=np.uint8),
+        "dosage": (np.stack(dosages) if dosages
+                   else np.empty((0, samples_pad), np.int8)),
+    }

@@ -256,9 +256,15 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
                 if text is not None:  # fast tokenizer, no record objects
                     return pack_variant_tiles_from_text(text, header,
                                                         geometry)
-                recs = ds.read_span(s)
-                return pack_variant_tiles(VariantBatch(recs, header),
-                                          geometry)
+                # BCF: binary fast scan — skips ID/INFO and non-GT FORMAT
+                # fields entirely
+                from hadoop_bam_tpu.formats.bcf import scan_variant_columns
+                from hadoop_bam_tpu.split.vcf_planners import (
+                    read_bcf_span_bytes,
+                )
+                raw = read_bcf_span_bytes(ds.path, s, ds._is_bgzf_bcf)
+                return scan_variant_columns(raw, header,
+                                            geometry.samples_pad)
             out = decode_with_retry(inner, span, config)
             if out is not None:
                 return out

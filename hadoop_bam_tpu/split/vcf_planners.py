@@ -213,3 +213,36 @@ def read_bcf_span(source, span: FileVirtualSpan,
             out.append(rec)
             pos += 8 + l_shared + l_indiv
     return out
+
+
+def read_bcf_span_bytes(source, span: FileVirtualSpan,
+                        is_bgzf: Optional[bool] = None) -> bytes:
+    """Raw concatenated record bytes of a BCF span (no decode) — the input
+    of the fast column scanner (formats/bcf.py scan_variant_columns)."""
+    src = as_byte_source(source)
+    if is_bgzf is None:
+        _, _, is_bgzf = read_bcf_header(src)
+    chunks: List[bytes] = []
+    if is_bgzf:
+        r = bgzf.BGZFReader(src)
+        r.seek_voffset(span.start_voffset)
+        while True:
+            v = r.voffset()
+            if v >= span.end_voffset:
+                break
+            head = r.read(8)
+            if len(head) < 8:
+                break
+            l_shared, l_indiv = struct.unpack("<II", head)
+            chunks.append(head + r.read(l_shared + l_indiv))
+    else:
+        pos = span.start[0]
+        end_byte = span.end[0]
+        while pos < min(end_byte, src.size):
+            head = src.pread(pos, 8)
+            if len(head) < 8:
+                break
+            l_shared, l_indiv = struct.unpack("<II", head)
+            chunks.append(head + src.pread(pos + 8, l_shared + l_indiv))
+            pos += 8 + l_shared + l_indiv
+    return b"".join(chunks)

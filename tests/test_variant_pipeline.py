@@ -133,3 +133,30 @@ def test_fast_tokenizer_matches_generic(vcf):
                 ds.read_span(span), header), g)
         for k in ("chrom", "pos", "flags", "dosage"):
             np.testing.assert_array_equal(fast[k], slow[k], err_msg=k)
+
+
+def test_bcf_fast_scan_matches_generic(vcf, tmp_path):
+    """scan_variant_columns == VariantBatch packing for BCF spans."""
+    path, header, recs = vcf
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.formats.bcf import scan_variant_columns
+    from hadoop_bam_tpu.parallel.variant_pipeline import pack_variant_tiles
+    from hadoop_bam_tpu.split.vcf_planners import read_bcf_span_bytes
+
+    out = str(tmp_path / "scan.bcf")
+    with open_vcf_writer(out, header) as w:
+        for r in recs:
+            w.write_record(r)
+    ds = open_vcf(out)
+    g = VariantGeometry(n_samples=header.n_samples)
+    total = 0
+    for span in ds.spans(3):
+        raw = read_bcf_span_bytes(out, span, ds._is_bgzf_bcf)
+        fast = scan_variant_columns(raw, header, g.samples_pad)
+        slow = pack_variant_tiles(VariantBatch(ds.read_span(span), header),
+                                  g)
+        for k in ("chrom", "pos", "flags", "dosage"):
+            np.testing.assert_array_equal(fast[k], slow[k], err_msg=k)
+        total += fast["chrom"].shape[0]
+    assert total == len(recs)
