@@ -131,6 +131,119 @@ class BaiIndex:
         return merged
 
 
+CSI_MAGIC = b"CSI\x01"
+CSI_SUFFIX = ".csi"
+
+
+def csi_reg2bins(beg: int, end: int, min_shift: int, depth: int
+                 ) -> List[int]:
+    """Bins possibly overlapping [beg, end) for a CSI index with the given
+    geometry [SPEC CSIv1] — the generalized reg2bins."""
+    out: List[int] = []
+    end -= 1
+    s = min_shift + depth * 3
+    t = 0
+    for level in range(depth + 1):
+        b = t + (beg >> s)
+        e = t + (end >> s)
+        out.extend(range(b, e + 1))
+        s -= 3
+        t += 1 << (level * 3)
+    return out
+
+
+@dataclass
+class CsiIndex:
+    """CSI (.csi) sidecar: BAI generalized to configurable bin geometry,
+    stored BGZF-compressed.  Read/write + the same query contract as
+    BaiIndex; per-bin ``loffset`` replaces the 16 KiB linear index."""
+    min_shift: int
+    depth: int
+    refs: List[Dict[int, Tuple[int, List[Tuple[int, int]]]]]
+    # refs[rid]: bin -> (loffset, chunks)
+
+    def to_bytes(self) -> bytes:
+        body = [CSI_MAGIC,
+                struct.pack("<iii", self.min_shift, self.depth, 0),
+                struct.pack("<i", len(self.refs))]
+        for bins in self.refs:
+            body.append(struct.pack("<i", len(bins)))
+            for bin_no in sorted(bins):
+                loffset, chunks = bins[bin_no]
+                body.append(struct.pack("<IQi", bin_no, loffset,
+                                        len(chunks)))
+                for beg, end in chunks:
+                    body.append(struct.pack("<QQ", beg, end))
+        from hadoop_bam_tpu.formats import bgzf
+        return bgzf.compress_bytes(b"".join(body))
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "CsiIndex":
+        from hadoop_bam_tpu.formats import bgzf
+        if raw[:2] == b"\x1f\x8b":
+            raw = bgzf.decompress_bytes(raw)
+        if raw[:4] != CSI_MAGIC:
+            raise ValueError("not a CSI index (bad magic)")
+        min_shift, depth, l_aux = struct.unpack_from("<iii", raw, 4)
+        off = 16 + l_aux
+        (n_ref,) = struct.unpack_from("<i", raw, off)
+        off += 4
+        refs = []
+        for _ in range(n_ref):
+            (n_bin,) = struct.unpack_from("<i", raw, off)
+            off += 4
+            bins: Dict[int, Tuple[int, List[Tuple[int, int]]]] = {}
+            for _ in range(n_bin):
+                bin_no, loffset, n_chunk = struct.unpack_from("<IQi", raw,
+                                                              off)
+                off += 16
+                chunks = []
+                for _ in range(n_chunk):
+                    beg, end = struct.unpack_from("<QQ", raw, off)
+                    off += 16
+                    chunks.append((beg, end))
+                if bin_no != _METADATA_BIN:
+                    bins[bin_no] = (loffset, chunks)
+            refs.append(bins)
+        return cls(min_shift=min_shift, depth=depth, refs=refs)
+
+    def query(self, rid: int, beg: int, end: int) -> List[Tuple[int, int]]:
+        if rid < 0 or rid >= len(self.refs):
+            return []
+        bins = self.refs[rid]
+        chunks: List[Tuple[int, int]] = []
+        for bin_no in csi_reg2bins(beg, end, self.min_shift, self.depth):
+            entry = bins.get(bin_no)
+            if entry is None:
+                continue
+            loffset, bin_chunks = entry
+            for cbeg, cend in bin_chunks:
+                chunks.append((cbeg, cend))
+        chunks.sort()
+        merged: List[Tuple[int, int]] = []
+        for cbeg, cend in chunks:
+            if merged and cbeg <= merged[-1][1]:
+                if cend > merged[-1][1]:
+                    merged[-1] = (merged[-1][0], cend)
+            else:
+                merged.append((cbeg, cend))
+        return merged
+
+    @classmethod
+    def from_bai(cls, bai: "BaiIndex", min_shift: int = 14,
+                 depth: int = 5) -> "CsiIndex":
+        """Re-express a BAI as CSI (same 16 KiB / depth-5 geometry —
+        BAI bin numbers are exactly CSI bins at these parameters)."""
+        refs = []
+        for ref in bai.refs:
+            bins: Dict[int, Tuple[int, List[Tuple[int, int]]]] = {}
+            for bin_no, chunks in ref.bins.items():
+                bins[bin_no] = (min((c[0] for c in chunks), default=0),
+                                list(chunks))
+            refs.append(bins)
+        return cls(min_shift=min_shift, depth=depth, refs=refs)
+
+
 def build_bai(bam_path: str, header=None) -> BaiIndex:
     """Build a BAI from a coordinate-sorted BAM in one streaming pass
     (the htsjdk/samtools `index` equivalent, columnar: bins and reference
@@ -192,12 +305,17 @@ def write_bai(bam_path: str, out_path: Optional[str] = None) -> str:
     return out_path
 
 
-def load_bai_for(bam_path: str) -> Optional[BaiIndex]:
+def load_bai_for(bam_path: str):
+    """Load a genomic index sidecar: .bai preferred, .csi fallback (both
+    answer the same query contract)."""
     import os
     p = bam_path + BAI_SUFFIX
-    if not os.path.exists(p):
-        return None
-    return BaiIndex.from_bytes(open(p, "rb").read())
+    if os.path.exists(p):
+        return BaiIndex.from_bytes(open(p, "rb").read())
+    p = bam_path + CSI_SUFFIX
+    if os.path.exists(p):
+        return CsiIndex.from_bytes(open(p, "rb").read())
+    return None
 
 
 def plan_interval_spans(bam_path: str, intervals, header,
