@@ -10,3 +10,9 @@ from hadoop_bam_tpu.api.dispatch import (  # noqa: F401
 from hadoop_bam_tpu.api.dataset import (  # noqa: F401
     open_bam, open_sam, open_any_sam, BamDataset, SamDataset,
 )
+from hadoop_bam_tpu.api.cram_dataset import CramDataset, open_cram  # noqa: F401
+from hadoop_bam_tpu.api.vcf_dataset import VcfDataset, open_vcf  # noqa: F401
+from hadoop_bam_tpu.api.read_datasets import (  # noqa: F401
+    FastaDataset, FastqDataset, QseqDataset, open_fasta, open_fastq,
+    open_qseq,
+)
