@@ -227,6 +227,49 @@ class VcfDataset:
         return variant_stats_file(self.path, mesh=mesh, config=self.config,
                                   header=self.header)
 
+    def query(self, region: str) -> Iterator[VcfRecord]:
+        """Random access via a ``.tbi`` sidecar (BGZF VCF): yields records
+        overlapping the samtools-style region (``chr``, ``chr:start-end``)
+        reading only the index's chunk ranges — build the sidecar with
+        split.tabix.write_tabix or ``hbam index --flavor tbi``."""
+        from hadoop_bam_tpu.split.intervals import parse_interval
+        from hadoop_bam_tpu.split.tabix import TBI_SUFFIX, load_tabix_for
+        from hadoop_bam_tpu.utils.seekable import as_byte_source
+
+        if self.container is not VCFContainer.VCF_BGZF:
+            raise ValueError("query() needs a BGZF-compressed VCF "
+                             "(.vcf.gz); plain text/gzip cannot be "
+                             "random-accessed")
+        idx = load_tabix_for(self.path)
+        if idx is None:
+            raise FileNotFoundError(
+                f"{self.path}{TBI_SUFFIX} not found — build it with "
+                "split.tabix.write_tabix")
+        iv = parse_interval(region)
+        ranges = idx.query(iv.rname, iv.start - 1, iv.end)
+        src = as_byte_source(self.path)
+        try:
+            r = bgzf.BGZFReader(src)
+            for v0, v1 in ranges:
+                r.seek_voffset(v0)
+                text = r.read_to_voffset(v1)
+                for line in text.split(b"\n"):
+                    if not line or line[:1] == b"#":
+                        continue
+                    try:
+                        rec = VcfRecord.from_line(line.decode())
+                    except Exception:
+                        if (self.config.validation_stringency
+                                is ValidationStringency.STRICT):
+                            raise
+                        continue
+                    if rec.chrom != iv.rname:
+                        continue
+                    if rec.pos <= iv.end and rec.pos + rec.rlen - 1 >= iv.start:
+                        yield rec
+        finally:
+            src.close()
+
     # -- checkpoint / resume (SURVEY.md section 5) ---------------------------
     def state_dict(self) -> Dict:
         return {

@@ -249,6 +249,23 @@ class BGZFReader:
         self._uoffset = 0
         return True
 
+    def read_to_voffset(self, v_end: int) -> bytes:
+        """Read inflated bytes from the current position up to exactly
+        ``v_end`` (exclusive) — the primitive index-range readers need to
+        avoid overshooting into a neighboring chunk's records."""
+        out = bytearray()
+        c_end, u_end = v_end >> 16, v_end & 0xFFFF
+        while self.voffset() < v_end:
+            if self._block_coffset == c_end:
+                out += self.read(u_end - self._uoffset)
+                break
+            avail = len(self._block_data) - self._uoffset
+            got = self.read(avail if avail > 0 else 1)
+            if not got:
+                break
+            out += got
+        return bytes(out)
+
     def read(self, n: int) -> bytes:
         """Read exactly n inflated bytes (fewer only at EOF)."""
         out = bytearray()

@@ -132,6 +132,9 @@ def cmd_index(args) -> int:
         if args.flavor == "bai":
             from hadoop_bam_tpu.split.bai import write_bai
             out = write_bai(path)
+        elif args.flavor == "tbi":
+            from hadoop_bam_tpu.split.tabix import write_tabix
+            out = write_tabix(path)
         else:
             out = write_splitting_index(path, granularity=args.granularity,
                                         flavor=args.flavor)
@@ -323,10 +326,12 @@ def build_parser() -> argparse.ArgumentParser:
     i = sub.add_parser("index", help="build splitting index sidecar(s)")
     i.add_argument("paths", nargs="+")
     i.add_argument("-g", "--granularity", type=int, default=4096)
-    i.add_argument("--flavor", choices=["splitting-bai", "sbi", "bai"],
+    i.add_argument("--flavor",
+                   choices=["splitting-bai", "sbi", "bai", "tbi"],
                    default="splitting-bai",
-                   help="bai = genomic BAI (needs coordinate-sorted input; "
-                        "enables interval split trimming)")
+                   help="bai = genomic BAI for BAM; tbi = tabix for BGZF "
+                        "VCF (both need coordinate-sorted input and "
+                        "enable interval queries/trimming)")
     i.set_defaults(fn=cmd_index)
 
     c = sub.add_parser("cat", help="concatenate same-header BAMs")

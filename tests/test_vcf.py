@@ -364,3 +364,44 @@ def test_plain_gzip_vcf_fallback(tmp_path):
     assert len(recs) == 300 and recs[0].pos == 10 and recs[-1].pos == 309
     stats = ds.variant_stats()
     assert stats["n_variants"] == 300 and stats["n_snp"] == 300
+
+
+def test_tabix_query(tmp_path):
+    """Build .tbi over a sorted BGZF VCF; region queries return exactly the
+    overlapping records, reading only indexed chunk ranges."""
+    import random
+
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.formats import bgzf
+    from hadoop_bam_tpu.split.tabix import TabixIndex, write_tabix
+
+    header_text = ("##fileformat=VCFv4.2\n"
+                   "##contig=<ID=c1,length=2000000>\n"
+                   "##contig=<ID=c2,length=2000000>\n"
+                   "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+    rng = random.Random(29)
+    recs = []
+    for chrom in ("c1", "c2"):
+        poss = sorted(rng.sample(range(1, 1999000), 4000))
+        for p in poss:
+            recs.append((chrom, p))
+    lines = [f"{c}\t{p}\t.\tA\tG\t30\tPASS\t." for c, p in recs]
+    path = str(tmp_path / "t.vcf.gz")
+    open(path, "wb").write(
+        bgzf.compress_bytes((header_text + "\n".join(lines) + "\n")
+                            .encode()))
+    out = write_tabix(path)
+    idx = TabixIndex.from_bytes(open(out, "rb").read())
+    assert idx.names == ["c1", "c2"] and idx.fmt == 2
+
+    ds = open_vcf(path)
+    for region, want in (
+        ("c1:500000-700000",
+         [(c, p) for c, p in recs if c == "c1" and 500000 <= p <= 700000]),
+        ("c2:1-1000",
+         [(c, p) for c, p in recs if c == "c2" and p <= 1000]),
+        ("c1", [(c, p) for c, p in recs if c == "c1"]),
+    ):
+        got = [(r.chrom, r.pos) for r in ds.query(region)]
+        assert got == want, (region, len(got), len(want))
+    assert list(ds.query("c9:1-100")) == []
