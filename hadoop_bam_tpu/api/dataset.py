@@ -148,6 +148,27 @@ class BamDataset:
                 "n_records": jax.device_put(cvec, sharding),
             }
 
+    def query(self, region: str) -> Iterator[SamRecord]:
+        """Random access via a ``.bai``/``.csi`` sidecar: yields records
+        overlapping the samtools-style region, reading only the index's
+        chunk ranges (build with ``hbam index --flavor bai``).  Falls back
+        to a full scan + filter when no genomic index exists."""
+        from hadoop_bam_tpu.split.bai import load_bai_for, plan_interval_spans
+        from hadoop_bam_tpu.split.intervals import (
+            batch_overlap_mask, parse_intervals,
+        )
+
+        intervals = parse_intervals(region, self.header.ref_names)
+        spans = plan_interval_spans(self.path, intervals, self.header)
+        if spans is None:
+            spans = self.spans()
+        for span in spans:
+            batch = read_bam_span(self.path, span, header=self.header)
+            mask = batch_overlap_mask(batch, intervals, self.header)
+            idx = np.nonzero(mask)[0]
+            for i in idx:
+                yield SamRecord.from_line(batch.to_sam_line(int(i)))
+
     def seq_stats(self, mesh=None, geometry=None) -> Dict:
         """Distributed GC / quality / base-composition stats via the fused
         Pallas payload kernel (parallel/pipeline.seq_stats_file).  Honors

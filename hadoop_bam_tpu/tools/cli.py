@@ -68,6 +68,18 @@ def _view_sam(args, fmt) -> int:
         sys.stdout.write(header.to_sam_text())
     from hadoop_bam_tpu.api.dataset import BamDataset
     from hadoop_bam_tpu.formats.sam import SamRecord
+    if isinstance(ds, BamDataset) and region and args.region:
+        from hadoop_bam_tpu.split.bai import load_bai_for
+        if load_bai_for(args.path) is not None:
+            # genomic index present: read only the indexed chunk ranges
+            for rec in ds.query(args.region):
+                if args.count:
+                    n += 1
+                else:
+                    print(rec.to_line())
+            if args.count:
+                print(n)
+            return 0
     if isinstance(ds, BamDataset):
         for batch in ds.batches():
             import numpy as np
