@@ -554,6 +554,8 @@ def plausible_record_start(buf: bytes, off: int, n_contigs: int,
 # ---------------------------------------------------------------------------
 
 _SNP_BASES = frozenset(b"ACGTN")
+_GT_NP_DTYPES = {T_INT8: np.dtype("i1"), T_INT16: np.dtype("<i2"),
+                 T_INT32: np.dtype("<i4")}
 
 
 def scan_variant_columns(buf: bytes, header: VCFHeader, samples_pad: int
@@ -623,13 +625,22 @@ def scan_variant_columns(buf: bytes, header: VCFHeader, samples_pad: int
             size = 1 if typ == T_CHAR else (4 if typ == T_FLOAT
                                             else _INT_SIZE.get(typ, 4))
             data_len = size * count * n_sample
-            if key == gt_key and typ == T_INT8 and n_sample:
-                g = np.frombuffer(buf, np.int8, count * n_sample, q
-                                  ).reshape(n_sample, count)
-                valid = (g != INT8_EOV) & (g != 0)     # 0 = missing allele
-                alt = ((g.astype(np.int16) >> 1) - 1) > 0
-                d = np.where(valid.any(axis=1),
-                             (alt & valid).sum(axis=1), -1)
+            if key == gt_key and typ in _GT_NP_DTYPES and n_sample:
+                # GT vectors may be int8/int16/int32 (high allele counts
+                # widen the encoding); all three share the same semantics.
+                g = np.frombuffer(buf, _GT_NP_DTYPES[typ],
+                                  count * n_sample, q
+                                  ).reshape(n_sample, count).astype(np.int64)
+                present = (g != _INT_EOV[typ])          # pre-EOV entries
+                # allele index = (g >> 1) - 1; masking the phase bit is
+                # required: a phased missing allele ('0|.') encodes as 1
+                missing = present & (((g >> 1) == 0)
+                                     | (g == _INT_MISSING[typ]))
+                alt = present & (((g >> 1) - 1) > 0)
+                # Any missing allele ('./.', '0/.') -> -1, matching
+                # VariantBatch.dosage_matrix and the text tokenizer.
+                d = np.where(present.any(axis=1) & ~missing.any(axis=1),
+                             alt.sum(axis=1), -1)
                 dose[:n_sample] = np.minimum(d, 127).astype(np.int8)
             q += data_len
             seen_fmt += 1

@@ -73,7 +73,9 @@ def _seq_stats_kernel(seq_ref, qual_ref, len_ref,
     for code in range(N_CODES):
         c = ((hi == code) & hi_valid).sum() + ((lo == code) & lo_valid).sum()
         counts.append(c)
-    hist = jnp.stack(counts).astype(jnp.float32)[None, :]  # [1, 16]
+    # i32, not f32: float accumulation loses integer precision past 2^24
+    # (one 150bp x 112k-read tile already exceeds 16.7M bases)
+    hist = jnp.stack(counts).astype(jnp.int32)[None, :]  # [1, 16]
 
     @pl.when(i == 0)
     def _init():
@@ -92,7 +94,7 @@ def seq_qual_stats(seq_tile: jnp.ndarray, qual_tile: jnp.ndarray,
     seq_tile: [N, SB] uint8, 2 bases/byte; qual_tile: [N, QB] uint8;
     lengths: [N] int32 (0 for padding rows — they contribute nothing).
     N must be a multiple of block_n.  Returns {"gc": [N] f32,
-    "mean_qual": [N] f32, "base_hist": [16] f32}.
+    "mean_qual": [N] f32, "base_hist": [16] i32}.
 
     ``interpret``: run the kernel in interpreter mode (required on CPU
     devices).  None = infer from the default backend — pass it explicitly
@@ -120,7 +122,7 @@ def seq_qual_stats(seq_tile: jnp.ndarray, qual_tile: jnp.ndarray,
         out_shape=(
             jax.ShapeDtypeStruct((n, 1), jnp.float32),
             jax.ShapeDtypeStruct((n, 1), jnp.float32),
-            jax.ShapeDtypeStruct((1, N_CODES), jnp.float32),
+            jax.ShapeDtypeStruct((1, N_CODES), jnp.int32),
         ),
         interpret=interpret,
     )(seq_tile, qual_tile, lengths[:, None])
@@ -148,7 +150,7 @@ def seq_qual_stats_host(seq_tile: np.ndarray, qual_tile: np.ndarray,
     n = seq_tile.shape[0]
     gc = np.zeros(n, dtype=np.float32)
     mq = np.zeros(n, dtype=np.float32)
-    hist = np.zeros(N_CODES, dtype=np.float32)
+    hist = np.zeros(N_CODES, dtype=np.int64)
     for i in range(n):
         ln = int(lengths[i])
         packed = seq_tile[i]

@@ -139,17 +139,54 @@ def _decode_span_core(source, span: FileVirtualSpan,
         abs_coffs = np.empty(0, dtype=np.int64)
         next_c = start_c
 
-    def append_block(coffset: int) -> int:
-        """Inflate the block at ``coffset`` onto the buffer; returns its
-        compressed size."""
-        nonlocal data, ubase, abs_coffs
-        head = src.pread(coffset, bgzf.MAX_BLOCK_SIZE)
-        info = bgzf.parse_block_header(head, 0)
-        extra = bgzf.inflate_block(head, info, check_crc=check_crc)
-        ubase = np.append(ubase, data.size)
-        abs_coffs = np.append(abs_coffs, coffset)
-        data = np.concatenate([data, np.frombuffer(extra, np.uint8)])
-        return info.block_size
+    def extend_past(tail: int) -> None:
+        """Fetch + inflate the following blocks until the record starting
+        at ``tail`` (cut at the buffer end) is complete, accumulating in a
+        chunk list with ONE final concatenate — per-block np.concatenate
+        re-copied the whole span each iteration (quadratic on long
+        multi-block record chains)."""
+        nonlocal data, ubase, abs_coffs, next_c
+        chunks: List[np.ndarray] = [data]
+        new_bases: List[int] = []
+        new_coffs: List[int] = []
+        cur = data.size
+
+        def fetch_block() -> None:
+            nonlocal cur, next_c
+            head = src.pread(next_c, bgzf.MAX_BLOCK_SIZE)
+            info = bgzf.parse_block_header(head, 0)
+            extra = bgzf.inflate_block(head, info, check_crc=check_crc)
+            new_bases.append(cur)
+            new_coffs.append(next_c)
+            chunks.append(np.frombuffer(extra, np.uint8))
+            cur += len(extra)
+            next_c += info.block_size
+
+        def read_bytes(pos: int, n: int) -> bytes:
+            out = bytearray()
+            base = 0
+            for c in chunks:
+                lo = pos - base
+                if 0 <= lo < c.size and len(out) < n:
+                    out += c[lo:lo + n - len(out)].tobytes()
+                elif lo < 0 and len(out) < n:
+                    out += c[:n - len(out)].tobytes()
+                base += c.size
+            return bytes(out)
+
+        # the 4-byte block_size field itself may be cut
+        while cur < tail + 4 and next_c < src.size:
+            fetch_block()
+        if cur >= tail + 4:
+            bs = int.from_bytes(read_bytes(tail, 4), "little", signed=True)
+            needed = tail + 4 + max(bs, 0)
+            while cur < needed and next_c < src.size:
+                fetch_block()
+        if new_bases:
+            ubase = np.concatenate([ubase, np.asarray(new_bases, np.int64)])
+            abs_coffs = np.concatenate(
+                [abs_coffs, np.asarray(new_coffs, np.int64)])
+            data = np.concatenate(chunks)
 
     # 2. The span may end inside the block at end_c (already inflated as the
     #    final table entry): its first end_u inflated bytes still hold
@@ -173,7 +210,10 @@ def _decode_span_core(source, span: FileVirtualSpan,
         else:
             offs, tail = inflate_ops.walk_records(data, start=start_u)
         if tail < end_inflated and next_c < src.size:
-            next_c += append_block(next_c)
+            prev_size = data.size
+            extend_past(tail)
+            if data.size == prev_size:
+                break  # no more bytes to fetch: truncated file
             continue
         break
     keep = int(np.searchsorted(offs, max(end_inflated, 1)))  # offs ascend
@@ -724,19 +764,19 @@ def make_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
                                block_n=geometry.block_n,
                                interpret=interpret)
         nonpad = valid.astype(jnp.float32)
-        vec = jnp.concatenate([
-            jnp.stack([(stats["gc"] * nonpad).sum(),
-                       (stats["mean_qual"] * nonpad).sum(),
-                       nonpad.sum()]),
-            stats["base_hist"],
-        ])
-        return jax.lax.psum(vec, axis)
+        # counts ride an i32 vector (f32 drifts past 2^24); float sums
+        # (for the means) stay f32 — the host accumulates both in 64-bit
+        fvec = jnp.stack([(stats["gc"] * nonpad).sum(),
+                          (stats["mean_qual"] * nonpad).sum()])
+        ivec = jnp.concatenate([
+            valid.astype(jnp.int32).sum()[None], stats["base_hist"]])
+        return jax.lax.psum(fvec, axis), jax.lax.psum(ivec, axis)
 
     # check_vma=False: pallas_call's out_shape has no varying-mesh-axes
     # annotation, which the default shard_map VMA check rejects
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(P(axis), P(axis), P(axis), P(axis)),
-                   out_specs=P(), check_vma=False)
+                   out_specs=(P(), P()), check_vma=False)
     step = jax.jit(fn)
     _STEP_CACHE[key] = step
     return step
@@ -828,16 +868,14 @@ def make_read_stats_step(mesh: Mesh, geometry: PayloadGeometry,
                                block_n=geometry.block_n,
                                interpret=interpret)
         nonpad = valid.astype(jnp.float32)
-        vec = jnp.concatenate([
-            jnp.stack([(stats["gc"] * nonpad).sum(),
-                       (stats["mean_qual"] * nonpad).sum(),
-                       nonpad.sum()]),
-            stats["base_hist"],
-        ])
-        return jax.lax.psum(vec, axis)
+        fvec = jnp.stack([(stats["gc"] * nonpad).sum(),
+                          (stats["mean_qual"] * nonpad).sum()])
+        ivec = jnp.concatenate([
+            valid.astype(jnp.int32).sum()[None], stats["base_hist"]])
+        return jax.lax.psum(fvec, axis), jax.lax.psum(ivec, axis)
 
     fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis),) * 4,
-                   out_specs=P(), check_vma=False)
+                   out_specs=(P(), P()), check_vma=False)
     step = jax.jit(fn)
     _STEP_CACHE[key] = step
     return step
@@ -877,7 +915,12 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     sharding = NamedSharding(mesh, P("data"))
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     window = max(1, prefetch) * n_workers
-    totals_vec = None
+    # host-side 64-bit accumulators: per-group device sums are exact
+    # (i32 counts / f32 sums over one bounded tile group), the running
+    # totals must not be (WGS base counts blow through both 2^24 and 2^31)
+    totals_f = np.zeros(2, dtype=np.float64)
+    totals_i = np.zeros(1 + N_CODES, dtype=np.int64)
+    seen = False
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         def decode(span):
             def inner(s):
@@ -896,7 +939,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         counts: List[int] = []
 
         def dispatch():
-            nonlocal totals_vec
+            nonlocal seen
             seqs = np.stack([g[0] for g in group] + [
                 np.zeros((cap, geometry.seq_stride), np.uint8)
                 for _ in range(n_dev - len(group))])
@@ -911,9 +954,10 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
             args = [jax.device_put(a, sharding)
                     for a in (seqs, quals, lens)]
             c = jax.device_put(cvec, sharding)
-            vec = step(*args, c)
-            totals_vec = vec if totals_vec is None else _ADD(totals_vec,
-                                                             vec)
+            fvec, ivec = step(*args, c)
+            totals_f[:] += np.asarray(jax.device_get(fvec), np.float64)
+            totals_i[:] += np.asarray(jax.device_get(ivec), np.int64)
+            seen = True
             group.clear()
             counts.clear()
 
@@ -926,13 +970,14 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                 dispatch()
         if group:
             dispatch()
-    if totals_vec is None:
+    if not seen:
         return {"n_reads": 0, "mean_gc": 0.0, "mean_qual": 0.0,
-                "base_hist": np.zeros(N_CODES)}
-    host = np.asarray(jax.device_get(totals_vec), dtype=np.float64)
-    n = max(host[2], 1.0)
-    return {"n_reads": int(host[2]), "mean_gc": float(host[0] / n),
-            "mean_qual": float(host[1] / n), "base_hist": host[3:]}
+                "base_hist": np.zeros(N_CODES, np.int64)}
+    n = max(float(totals_i[0]), 1.0)
+    return {"n_reads": int(totals_i[0]),
+            "mean_gc": float(totals_f[0] / n),
+            "mean_qual": float(totals_f[1] / n),
+            "base_hist": totals_i[1:]}
 
 
 def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
@@ -969,20 +1014,25 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
 
     step = make_seq_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
-    totals_vec = None
+    totals_f = np.zeros(2, dtype=np.float64)
+    totals_i = np.zeros(1 + N_CODES, dtype=np.int64)
+    seen = False
     for stacked, cvec in iter_payload_tile_groups(
             path, spans, geometry, n_dev, config, prefetch, header=header):
         args = [jax.device_put(a, sharding) for a in stacked]
         c = jax.device_put(cvec, sharding)
-        vec = step(*args, c)
-        totals_vec = vec if totals_vec is None else _ADD(totals_vec, vec)
-    if totals_vec is None:
+        fvec, ivec = step(*args, c)
+        totals_f[:] += np.asarray(jax.device_get(fvec), np.float64)
+        totals_i[:] += np.asarray(jax.device_get(ivec), np.int64)
+        seen = True
+    if not seen:
         return {"n_reads": 0, "mean_gc": 0.0, "mean_qual": 0.0,
-                "base_hist": np.zeros(N_CODES)}
-    host = np.asarray(jax.device_get(totals_vec), dtype=np.float64)
-    n = max(host[2], 1.0)
-    return {"n_reads": int(host[2]), "mean_gc": float(host[0] / n),
-            "mean_qual": float(host[1] / n), "base_hist": host[3:]}
+                "base_hist": np.zeros(N_CODES, np.int64)}
+    n = max(float(totals_i[0]), 1.0)
+    return {"n_reads": int(totals_i[0]),
+            "mean_gc": float(totals_f[0] / n),
+            "mean_qual": float(totals_f[1] / n),
+            "base_hist": totals_i[1:]}
 
 
 def flagstat_file(path: str, mesh: Optional[Mesh] = None,

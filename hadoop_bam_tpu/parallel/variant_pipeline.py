@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.vcf import VariantBatch, VCFHeader
-from hadoop_bam_tpu.parallel.pipeline import _ADD, _STEP_CACHE, _iter_windowed
+from hadoop_bam_tpu.parallel.pipeline import _STEP_CACHE, _iter_windowed
 
 
 def _round_up(x: int, m: int) -> int:
@@ -192,29 +192,34 @@ def make_variant_stats_step(mesh: Mesh, geometry: VariantGeometry,
         dosage, count = dosage[0], count[0]
         cap = flags.shape[0]
         valid = jnp.arange(cap, dtype=jnp.int32) < count
-        vf = valid.astype(jnp.float32)
-        n_variants = vf.sum()
-        n_snp = (vf * ((flags & FLAG_SNP) != 0)).sum()
-        n_pass = (vf * ((flags & FLAG_PASS) != 0)).sum()
+        # count-like quantities stay integer end to end (f32 accumulation
+        # drifts past 2^24 — realistic for WGS-scale call sets)
+        vi = valid.astype(jnp.int32)
+        n_variants = vi.sum()
+        n_snp = (valid & ((flags & FLAG_SNP) != 0)).sum().astype(jnp.int32)
+        n_pass = (valid & ((flags & FLAG_PASS) != 0)).sum().astype(jnp.int32)
         d = dosage.astype(jnp.int32)
         called = (d >= 0) & valid[:, None]
-        n_called = called.sum(axis=1).astype(jnp.float32)       # [cap]
+        n_called = called.sum(axis=1)                           # [cap] i32
         alt_sum = jnp.where(called, d, 0).sum(axis=1
                                               ).astype(jnp.float32)
         has_calls = n_called > 0
-        af = jnp.where(has_calls, alt_sum / (2.0 * jnp.maximum(n_called, 1)),
+        af = jnp.where(has_calls,
+                       alt_sum / (2.0 * jnp.maximum(n_called, 1)
+                                  .astype(jnp.float32)),
                        0.0)
-        sum_af = (af * vf).sum()
-        n_af = (has_calls.astype(jnp.float32) * vf).sum()
-        per_sample_called = called.astype(jnp.float32).sum(axis=0)  # [S]
-        vec = jnp.concatenate([
-            jnp.stack([n_variants, n_snp, n_pass, sum_af, n_af]),
+        sum_af = (af * valid.astype(jnp.float32)).sum()
+        n_af = (has_calls & valid).sum().astype(jnp.int32)
+        per_sample_called = called.astype(jnp.int32).sum(axis=0)  # [S]
+        ivec = jnp.concatenate([
+            jnp.stack([n_variants, n_snp, n_pass, n_af]),
             per_sample_called,
         ])
-        return jax.lax.psum(vec, axis)
+        return (jax.lax.psum(sum_af[None], axis),
+                jax.lax.psum(ivec, axis))
 
     fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(P(axis),) * 5, out_specs=P())
+                   in_specs=(P(axis),) * 5, out_specs=(P(), P()))
     step = jax.jit(fn)
     _STEP_CACHE[key] = step
     return step
@@ -287,8 +292,12 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
             args = [jax.device_put(stacked[k], sharding)
                     for k in ("chrom", "pos", "flags", "dosage")]
             c = jax.device_put(cvec, sharding)
-            vec = step(*args, c)
-            totals = vec if totals is None else _ADD(totals, vec)
+            fvec, ivec = step(*args, c)
+            if totals is None:
+                totals = [np.zeros(1, np.float64),
+                          np.zeros(ivec.shape, np.int64)]
+            totals[0] += np.asarray(jax.device_get(fvec), np.float64)
+            totals[1] += np.asarray(jax.device_get(ivec), np.int64)
             group.clear()
             counts.clear()
 
@@ -299,18 +308,18 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
                 dispatch()
         if group:
             dispatch()
-    S = geometry.samples_pad
     if totals is None:
         return {"n_variants": 0, "n_snp": 0, "n_pass": 0, "mean_af": 0.0,
                 "sample_callrate": np.zeros(header.n_samples)}
-    host = np.asarray(jax.device_get(totals), dtype=np.float64)
-    n_variants = host[0]
-    callrate = (host[5:5 + header.n_samples] / max(n_variants, 1.0)
+    sum_af, ints = float(totals[0][0]), totals[1]
+    n_variants = int(ints[0])
+    callrate = (ints[4:4 + header.n_samples].astype(np.float64)
+                / max(n_variants, 1)
                 if header.n_samples else np.zeros(0))
     return {
-        "n_variants": int(host[0]),
-        "n_snp": int(host[1]),
-        "n_pass": int(host[2]),
-        "mean_af": float(host[3] / max(host[4], 1.0)),
+        "n_variants": n_variants,
+        "n_snp": int(ints[1]),
+        "n_pass": int(ints[2]),
+        "mean_af": float(sum_af / max(int(ints[3]), 1)),
         "sample_callrate": callrate,
     }

@@ -207,18 +207,37 @@ class CsiIndex:
             refs.append(bins)
         return cls(min_shift=min_shift, depth=depth, refs=refs)
 
+    def _min_offset(self, bins, beg: int) -> int:
+        """Smallest virtual offset that can hold records overlapping
+        positions >= ``beg``: the loffset of the nearest present bin at or
+        before beg, walking previous-sibling-then-parent from the leaf bin
+        (the CSI analog of BAI's linear-index pruning)."""
+        bin_no = ((1 << (3 * self.depth)) - 1) // 7 + \
+            (beg >> self.min_shift)
+        while bin_no:
+            entry = bins.get(bin_no)
+            if entry is not None:
+                return entry[0]
+            first_sibling = (((bin_no - 1) >> 3) << 3) + 1
+            bin_no = bin_no - 1 if bin_no > first_sibling \
+                else (bin_no - 1) >> 3
+        entry = bins.get(0)
+        return entry[0] if entry is not None else 0
+
     def query(self, rid: int, beg: int, end: int) -> List[Tuple[int, int]]:
         if rid < 0 or rid >= len(self.refs):
             return []
         bins = self.refs[rid]
+        min_off = self._min_offset(bins, beg)
         chunks: List[Tuple[int, int]] = []
         for bin_no in csi_reg2bins(beg, end, self.min_shift, self.depth):
             entry = bins.get(bin_no)
             if entry is None:
                 continue
-            loffset, bin_chunks = entry
+            _loffset, bin_chunks = entry
             for cbeg, cend in bin_chunks:
-                chunks.append((cbeg, cend))
+                if cend > min_off:
+                    chunks.append((max(cbeg, min_off), cend))
         chunks.sort()
         merged: List[Tuple[int, int]] = []
         for cbeg, cend in chunks:
@@ -238,8 +257,24 @@ class CsiIndex:
         for ref in bai.refs:
             bins: Dict[int, Tuple[int, List[Tuple[int, int]]]] = {}
             for bin_no, chunks in ref.bins.items():
-                bins[bin_no] = (min((c[0] for c in chunks), default=0),
-                                list(chunks))
+                # loffset must lower-bound the start of ANY record
+                # overlapping the bin's region — records assigned to
+                # ancestor bins included.  The BAI linear index has
+                # exactly that for the bin's first 16 KiB window; the
+                # bin's own min chunk start alone could overestimate.
+                level = 0
+                while level < depth and \
+                        ((1 << (3 * (level + 1))) - 1) // 7 <= bin_no:
+                    level += 1
+                region_start = (bin_no - ((1 << (3 * level)) - 1) // 7) \
+                    << (min_shift + 3 * (depth - level))
+                win = region_start >> _LINEAR_SHIFT
+                lin = ref.linear[win] if win < len(ref.linear) else 0
+                # lin == 0 (window unset) stays 0: "no pruning" is the
+                # only safe fallback — the bin's own min chunk start can
+                # exceed the start of an ancestor-bin record overlapping
+                # this bin's region
+                bins[bin_no] = (lin, list(chunks))
             refs.append(bins)
         return cls(min_shift=min_shift, depth=depth, refs=refs)
 

@@ -35,8 +35,12 @@ def coordinate_key(rec: bytes) -> Tuple[int, int]:
 
 
 def name_key(rec: bytes) -> bytes:
-    """Read name bytes (NUL excluded) from raw record bytes."""
-    l_read_name = rec[16]
+    """Read name bytes (NUL excluded) from raw record bytes.
+
+    Layout is block_size-prefixed: l_read_name lives at byte 12 of the raw
+    record (4 block_size + 8 refid/pos) [SPEC alignment section].
+    """
+    l_read_name = rec[12]
     return rec[36:36 + l_read_name - 1]
 
 

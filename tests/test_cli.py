@@ -98,12 +98,21 @@ def test_sort_coordinate(bam_file, tmp_path, capsys):
 
 
 def test_sort_by_name(bam_file, tmp_path):
-    path, _, recs = bam_file
+    # Write a shuffled copy first so a no-op "sort" cannot pass.
+    path, header, recs = bam_file
+    shuffled = recs[:]
+    random.Random(11).shuffle(shuffled)
+    src = str(tmp_path / "shuffled.bam")
+    with BamWriter(src, header) as w:
+        for r in shuffled:
+            w.write_sam_record(r)
     out = str(tmp_path / "nsorted.bam")
-    assert main(["sort", "-n", path, out]) == 0
+    assert main(["sort", "-n", src, out]) == 0
     _, batch = read_bam(out)
     names = [batch.read_name(i) for i in range(len(batch))]
     assert names == sorted(names)
+    assert sorted(names) == sorted(r.qname for r in recs)
+    assert names != [r.qname for r in shuffled]  # the sort actually moved records
 
 
 def test_fixmate(tmp_path, capsys):
@@ -200,11 +209,18 @@ def test_external_sort_multiple_runs(tmp_path):
     hdr = open_bam(out_ext).header
     assert "SO:coordinate" in hdr.text
 
-    # queryname mode
+    # queryname mode — assert on decoded read names, not name_key itself
+    # (keying the check on name_key would be circular: a broken key that
+    # returns b'' for every record would trivially "sort").
     out_qn = str(tmp_path / "sorted_qn.bam")
     sort_bam(path, out_qn, by_name=True, run_records=256)
-    qn = [name_key(r) for r in record_bytes(out_qn)]
+    ds_qn = open_bam(out_qn)
+    qn = [bt.read_name(i) for bt in ds_qn.batches() for i in range(len(bt))]
     assert qn == sorted(qn)
+    assert sorted(qn) == sorted(r.qname for r in records)
+    # and name_key agrees with the decoded names on real records
+    qn_keys = [name_key(r).decode() for r in record_bytes(out_qn)]
+    assert qn_keys == qn
 
 
 def test_external_vcf_sort_multiple_runs(tmp_path):

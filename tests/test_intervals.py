@@ -294,6 +294,20 @@ def test_csi_round_trip_and_query_matches_bai(tmp_path):
             assert c_r and c_r[0][0] <= b_r[0][0] and \
                 c_r[-1][1] >= b_r[-1][1]
 
+    # adversarial loffset case: a long record in an ancestor bin overlaps
+    # a leaf bin whose own chunks start later; with an unset linear window
+    # the leaf's loffset must NOT prune the ancestor's chunk
+    from hadoop_bam_tpu.split.bai import BaiIndex, RefIndex
+    adv = BaiIndex(refs=[RefIndex(
+        bins={73: [(100, 200)],          # record A: pos 20000-140000
+              585: [(200, 300)]},        # record B: pos 35000-50000
+        # linear windows 0..4 unset (no record STARTS there after A),
+        # window 1 holds A's start
+        linear=[0, 100, 100, 100, 100, 100])])
+    adv_csi = CsiIndex.from_bai(adv)
+    got = adv_csi.query(0, 81920, 81921)     # window 5, only A overlaps
+    assert got and got[0][0] <= 100, got     # A's chunk must survive
+
     # full-scan oracle BEFORE any sidecar exists
     iv = f"{header.ref_names[0]}:5000-20000"
     cfg = dataclasses.replace(DEFAULT_CONFIG, bam_intervals=iv)

@@ -95,6 +95,25 @@ def test_kernel_matches_host_oracle(bam):
                                ref["base_hist"])
 
 
+def test_base_hist_exact_past_2_24():
+    """Histogram counts stay exact past 2^24 total bases — the f32
+    accumulator this replaced loses integer precision there (and cannot
+    represent the odd total at all)."""
+    n, block_n = 2048, 256
+    L = 16383
+    seq = np.full((n, (L + 1) // 2), 0x11, np.uint8)   # all 'A' (code 1)
+    qual = np.full((n, L), 40, np.uint8)
+    lengths = np.full(n, L, np.int32)
+    lengths[0] = L - 1                                  # odd total
+    out = seq_qual_stats(seq, qual, lengths, block_n=block_n)
+    hist = np.asarray(out["base_hist"])
+    assert hist.dtype.kind == "i"
+    total = int(lengths.astype(np.int64).sum())
+    assert total > (1 << 24) and total % 2 == 1
+    assert int(hist[1]) == total
+    assert int(hist.sum()) == total
+
+
 def test_seq_stats_file_matches_oracle(bam):
     path, header, recs = bam
     stats = seq_stats_file(path, header=header, geometry=GEOM)

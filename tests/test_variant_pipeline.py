@@ -135,6 +135,51 @@ def test_fast_tokenizer_matches_generic(vcf):
             np.testing.assert_array_equal(fast[k], slow[k], err_msg=k)
 
 
+def test_bcf_fast_scan_wide_gt_and_half_missing(tmp_path):
+    """scan_variant_columns must (a) decode GT vectors the encoder widened
+    to int16 (allele index >= 63 -> value 128 > int8 max) and (b) agree
+    with VariantBatch.dosage_matrix on half-missing genotypes ('0/.' ->
+    -1), across text and binary containers."""
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.formats.bcf import scan_variant_columns
+    from hadoop_bam_tpu.parallel.variant_pipeline import pack_variant_tiles
+    from hadoop_bam_tpu.split.vcf_planners import read_bcf_span_bytes
+
+    n_alts = 70  # forces (70+1)<<1 = 142 -> int16 GT encoding
+    alts = ",".join("ACGT"[i % 4] * (i // 4 + 2) for i in range(n_alts))
+    hdr_text = (
+        "##fileformat=VCFv4.2\n"
+        "##contig=<ID=c1,length=1000000>\n"
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="Genotype">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\t"
+        "s0\ts1\ts2\ts3\n")
+    header = VCFHeader.from_text(hdr_text)
+    lines = [
+        f"c1\t100\t.\tA\t{alts}\t30\tPASS\t.\tGT\t0/70\t70/70\t0/0\t./.",
+        f"c1\t200\t.\tA\t{alts}\t30\tPASS\t.\tGT\t0/.\t./0\t1/.\t0|70",
+        "c1\t300\t.\tA\tC\t30\tPASS\t.\tGT\t0/1\t0|.\t./.\t1/1",
+    ]
+    recs = [VcfRecord.from_line(ln) for ln in lines]
+    out = str(tmp_path / "wide.bcf")
+    with open_vcf_writer(out, header) as w:
+        for r in recs:
+            w.write_record(r)
+    ds = open_vcf(out)
+    g = VariantGeometry(n_samples=header.n_samples)
+    (span,) = ds.spans(1)
+    raw = read_bcf_span_bytes(out, span, ds._is_bgzf_bcf)
+    fast = scan_variant_columns(raw, header, g.samples_pad)
+    # oracle: the generic per-record path
+    slow = pack_variant_tiles(VariantBatch(ds.read_span(span), header), g)
+    for k in ("chrom", "pos", "flags", "dosage"):
+        np.testing.assert_array_equal(fast[k], slow[k], err_msg=k)
+    # explicit semantics: int16 GT decoded, half-missing -> -1
+    np.testing.assert_array_equal(
+        fast["dosage"][:, :4],
+        [[1, 2, 0, -1], [-1, -1, -1, 1], [1, -1, -1, 2]])
+
+
 def test_bcf_fast_scan_matches_generic(vcf, tmp_path):
     """scan_variant_columns == VariantBatch packing for BCF spans."""
     path, header, recs = vcf
