@@ -1,31 +1,57 @@
-"""Benchmark: BAM decode records/sec/chip vs single-thread CPU baseline.
+"""Benchmark: the BASELINE.md measurement matrix, one JSON line.
 
-Prints ONE JSON line:
+Prints ONE JSON line.  The top-level keys keep the driver contract
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+for the headline metric (BAM decode records/sec/chip), and add
+    "components": [ {metric, value, unit[, vs_baseline]}, ... ]
+covering the whole matrix (BASELINE.md rows): BGZF inflate GB/s, CRAM
+records/s, VCF variants/s, FASTQ reads/s, split-guess p50 latency —
+so per-component regressions are visible in BENCH_r*.json.
 
-- Baseline: single-thread host decode — per-block zlib inflate + full
-  fixed-field decode in NumPy (the htsjdk-single-thread-equivalent of
-  BASELINE.md config #1; real htsjdk/pysam are not in this image).
-- Measured: the framework pipeline on the default JAX device — threaded
-  native C++ inflate + record walk feeding the jitted device unpack+flagstat
-  step (the reference hot loop of SURVEY.md section 3.2 rebuilt).
+- Baselines, where present, are measured in-process on this host:
+  single-thread zlib + NumPy decode (the htsjdk-single-thread analog;
+  pysam/htsjdk are not in the image).
+- Measured paths run on the default JAX device (the real TPU chip when
+  present) through the same drivers the library exposes.
+
+Fixture sizes scale with env vars (BENCH_RECORDS etc.) so a quick smoke
+run is cheap; fixtures cache under bench_data/.
 """
 from __future__ import annotations
 
-import io
 import json
 import os
 import random
-import sys
 import time
 
 import numpy as np
 
 BENCH_RECORDS = int(os.environ.get("BENCH_RECORDS", "300000"))
+CRAM_RECORDS = int(os.environ.get("BENCH_CRAM_RECORDS", "20000"))
+VCF_RECORDS = int(os.environ.get("BENCH_VCF_RECORDS", "100000"))
+FASTQ_RECORDS = int(os.environ.get("BENCH_FASTQ_RECORDS", "200000"))
 BENCH_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_data")
 BENCH_BAM = os.path.join(BENCH_DIR, f"bench_{BENCH_RECORDS}.bam")
 
+_HDR_TEXT = ("@HD\tVN:1.6\tSO:coordinate\n"
+             "@SQ\tSN:chr20\tLN:64444167\n@SQ\tSN:chr21\tLN:46709983\n")
+
+
+def _median_time(fn, reps: int = 3):
+    """Median wall time of fn() over reps runs (first result returned)."""
+    out = fn()  # warmup (jit compile, file cache)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        times.append(time.perf_counter() - t0)
+    return out, sorted(times)[len(times) // 2]
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
 
 def build_fixture() -> str:
     if os.path.exists(BENCH_BAM):
@@ -34,9 +60,7 @@ def build_fixture() -> str:
     from hadoop_bam_tpu.formats.bam import SAMHeader, encode_record
     from hadoop_bam_tpu.formats.bamio import BamWriter
 
-    header = SAMHeader.from_sam_text(
-        "@HD\tVN:1.6\tSO:coordinate\n"
-        "@SQ\tSN:chr20\tLN:64444167\n@SQ\tSN:chr21\tLN:46709983\n")
+    header = SAMHeader.from_sam_text(_HDR_TEXT)
     rng = random.Random(1234)
     bases = "ACGT"
     with BamWriter(BENCH_BAM + ".tmp", header) as w:
@@ -57,12 +81,88 @@ def build_fixture() -> str:
     return BENCH_BAM
 
 
+def build_cram_fixture() -> str:
+    path = os.path.join(BENCH_DIR, f"bench_{CRAM_RECORDS}.cram")
+    if os.path.exists(path):
+        return path
+    from hadoop_bam_tpu.api.writers import CramShardWriter
+    from hadoop_bam_tpu.formats.bam import SAMHeader
+    from hadoop_bam_tpu.formats.sam import SamRecord
+
+    header = SAMHeader.from_sam_text(_HDR_TEXT)
+    rng = random.Random(99)
+    pos = 1
+    with CramShardWriter(path + ".tmp", header) as w:
+        for i in range(CRAM_RECORDS):
+            l = 151
+            seq = "".join(rng.choice("ACGT") for _ in range(l))
+            qual = "".join(chr(33 + rng.randint(2, 40)) for _ in range(l))
+            pos += rng.randint(0, 40)
+            w.write_sam_record(SamRecord(
+                qname=f"read{i:09d}", flag=99 if i % 2 == 0 else 147,
+                rname="chr20", pos=pos, mapq=60, cigar=f"{l}M",
+                rnext="=", pnext=pos + 200, tlen=351, seq=seq, qual=qual))
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def build_vcf_fixture() -> str:
+    path = os.path.join(BENCH_DIR, f"bench_{VCF_RECORDS}.vcf.gz")
+    if os.path.exists(path):
+        return path
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+
+    hdr_text = (
+        "##fileformat=VCFv4.2\n"
+        "##contig=<ID=chr20,length=64444167>\n"
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="Depth">\n'
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="Genotype">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\t"
+        "s0\ts1\ts2\n")
+    header = VCFHeader.from_text(hdr_text)
+    rng = random.Random(77)
+    gts = ["0/0", "0/1", "1/1", "./."]
+    with open_vcf_writer(path + ".tmp.vcf.gz", header) as w:
+        pos = 1
+        for i in range(VCF_RECORDS):
+            pos += rng.randint(1, 50)
+            ref = rng.choice("ACGT")
+            alt = rng.choice([c for c in "ACGT" if c != ref])
+            g = "\t".join(rng.choice(gts) for _ in range(3))
+            w.write_record(VcfRecord.from_line(
+                f"chr20\t{pos}\t.\t{ref}\t{alt}\t{30 + i % 40}\tPASS\t"
+                f"DP={i % 100}\tGT\t{g}"))
+    os.replace(path + ".tmp.vcf.gz", path)
+    return path
+
+
+def build_fastq_fixture() -> str:
+    path = os.path.join(BENCH_DIR, f"bench_{FASTQ_RECORDS}.fastq")
+    if os.path.exists(path):
+        return path
+    rng = random.Random(55)
+    with open(path + ".tmp", "w") as f:
+        for i in range(FASTQ_RECORDS):
+            seq = "".join(rng.choice("ACGT") for _ in range(151))
+            qual = "".join(chr(33 + rng.randint(2, 40)) for _ in range(151))
+            f.write(f"@read{i:09d}\n{seq}\n+\n{qual}\n")
+    os.replace(path + ".tmp", path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# 1. BAM decode (headline)
+# ---------------------------------------------------------------------------
+
 def baseline_single_thread(path: str) -> float:
     """records/sec: single-thread zlib + NumPy full fixed-field decode."""
     import zlib
 
     from hadoop_bam_tpu.formats import bgzf
-    from hadoop_bam_tpu.formats.bam import BamBatch, SAMHeader, walk_record_offsets
+    from hadoop_bam_tpu.formats.bam import (
+        BamBatch, SAMHeader, walk_record_offsets,
+    )
 
     raw = open(path, "rb").read()
     t0 = time.perf_counter()
@@ -100,30 +200,136 @@ def measured_pipeline(path: str) -> float:
     geometry = DecodeGeometry()
     header, _ = read_bam_header(path)
 
-    # warmup (compile)
-    stats = flagstat_file(path, mesh=mesh, geometry=geometry, header=header)
-    n_records = stats["total"]
-    # timed runs: median-of-5 (tunneled TPU links are jittery)
-    reps = 5
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        stats = flagstat_file(path, mesh=mesh, geometry=geometry,
-                              header=header)
-        times.append(time.perf_counter() - t0)
-    dt = sorted(times)[reps // 2]
+    def run():
+        return flagstat_file(path, mesh=mesh, geometry=geometry,
+                             header=header)
+
+    # median-of-5: tunneled TPU links are jittery
+    stats, dt = _median_time(run, reps=5)
     return stats["total"] / dt / n_dev
+
+
+# ---------------------------------------------------------------------------
+# 2. BGZF inflate GB/s
+# ---------------------------------------------------------------------------
+
+def bench_bgzf_inflate(path: str):
+    import zlib
+
+    from hadoop_bam_tpu.formats import bgzf
+    from hadoop_bam_tpu.ops import inflate as inflate_ops
+
+    raw_b = open(path, "rb").read()
+
+    def native_run():
+        table = inflate_ops.block_table(raw_b)
+        data, _ = inflate_ops.inflate_span(raw_b, table)
+        return data.size
+
+    isize, dt = _median_time(native_run, reps=3)
+
+    # single-thread zlib baseline, one timed pass
+    t0 = time.perf_counter()
+    total = 0
+    for info in bgzf.scan_blocks(raw_b):
+        if info.isize:
+            total += len(zlib.decompress(
+                raw_b[info.cdata_offset:info.cdata_offset + info.cdata_size],
+                wbits=-15))
+    base_dt = time.perf_counter() - t0
+    gbps = isize / dt / 1e9
+    base_gbps = total / base_dt / 1e9
+    return {"metric": "bgzf_inflate_gbps", "value": round(gbps, 3),
+            "unit": "GB/s", "vs_baseline": round(gbps / base_gbps, 3)}
+
+
+# ---------------------------------------------------------------------------
+# 3. CRAM decode records/s
+# ---------------------------------------------------------------------------
+
+def bench_cram(path: str):
+    from hadoop_bam_tpu.api.cram_dataset import open_cram
+
+    def run():
+        ds = open_cram(path)
+        return sum(1 for _ in ds.records())
+
+    n, dt = _median_time(run, reps=3)
+    return {"metric": "cram_decode_records_per_sec",
+            "value": round(n / dt, 1), "unit": "records/s"}
+
+
+# ---------------------------------------------------------------------------
+# 4. VCF variants/s (device stats driver over BGZF VCF)
+# ---------------------------------------------------------------------------
+
+def bench_vcf(path: str):
+    from hadoop_bam_tpu.parallel.variant_pipeline import variant_stats_file
+
+    def run():
+        return variant_stats_file(path)
+
+    stats, dt = _median_time(run, reps=3)
+    return {"metric": "vcf_variants_per_sec",
+            "value": round(stats["n_variants"] / dt, 1), "unit": "variants/s"}
+
+
+# ---------------------------------------------------------------------------
+# 5. FASTQ reads/s (device payload stats driver)
+# ---------------------------------------------------------------------------
+
+def bench_fastq(path: str):
+    from hadoop_bam_tpu.parallel.pipeline import fastq_seq_stats_file
+
+    def run():
+        return fastq_seq_stats_file(path)
+
+    stats, dt = _median_time(run, reps=3)
+    return {"metric": "fastq_reads_per_sec",
+            "value": round(stats["n_reads"] / dt, 1), "unit": "reads/s"}
+
+
+# ---------------------------------------------------------------------------
+# 6. split-guess p50 latency (index-less BAM split planning)
+# ---------------------------------------------------------------------------
+
+def bench_split_guess(path: str):
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.split.planners import plan_bam_spans
+
+    header, _ = read_bam_header(path)
+    n_spans = 16
+
+    def run():
+        return plan_bam_spans(path, num_spans=n_spans, header=header)
+
+    spans, dt = _median_time(run, reps=3)
+    boundaries = max(len(spans) - 1, 1)  # first boundary is free (header)
+    return {"metric": "split_guess_p50_ms_per_boundary",
+            "value": round(dt / boundaries * 1e3, 3), "unit": "ms"}
 
 
 def main() -> None:
     path = build_fixture()
     base = baseline_single_thread(path)
     meas = measured_pipeline(path)
+
+    components = [
+        {"metric": "bam_decode_records_per_sec_per_chip",
+         "value": round(meas, 1), "unit": "records/s",
+         "vs_baseline": round(meas / base, 3)},
+        bench_bgzf_inflate(path),
+        bench_cram(build_cram_fixture()),
+        bench_vcf(build_vcf_fixture()),
+        bench_fastq(build_fastq_fixture()),
+        bench_split_guess(path),
+    ]
     print(json.dumps({
         "metric": "bam_decode_records_per_sec_per_chip",
         "value": round(meas, 1),
         "unit": "records/s",
         "vs_baseline": round(meas / base, 3),
+        "components": components,
     }))
 
 

@@ -731,11 +731,62 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
             yield emit()
 
 
+class _StatTotals:
+    """Deferred 64-bit host accumulation of per-group device stat sums.
+
+    ``add`` just enqueues the (f32 sums, i32 counts) device arrays —
+    dispatch stays async so host decode overlaps device compute; ``drain``
+    fetches them all at the end and reduces in float64/int64 (per-group
+    device sums are exact; the running totals must be 64-bit)."""
+
+    def __init__(self):
+        self._pairs: List[Tuple] = []
+
+    def add(self, fvec, ivec) -> None:
+        self._pairs.append((fvec, ivec))
+
+    def __bool__(self) -> bool:
+        return bool(self._pairs)
+
+    def drain(self) -> Tuple[np.ndarray, np.ndarray]:
+        f0, i0 = self._pairs[0]
+        tf = np.zeros(np.shape(f0), np.float64)
+        ti = np.zeros(np.shape(i0), np.int64)
+        for f, i in self._pairs:
+            tf += np.asarray(jax.device_get(f), np.float64)
+            ti += np.asarray(jax.device_get(i), np.int64)
+        return tf, ti
+
+
+def _payload_stats_tail(stats, valid, axis: str):
+    """Shared psum tail of the payload-stats steps: (f32[2] mean sums,
+    i32[1+16] n_reads + base_hist) — counts ride the int vector because
+    f32 accumulation drifts past 2^24."""
+    nonpad = valid.astype(jnp.float32)
+    fvec = jnp.stack([(stats["gc"] * nonpad).sum(),
+                      (stats["mean_qual"] * nonpad).sum()])
+    ivec = jnp.concatenate([
+        valid.astype(jnp.int32).sum()[None], stats["base_hist"]])
+    return jax.lax.psum(fvec, axis), jax.lax.psum(ivec, axis)
+
+
+def _payload_stats_result(totals: _StatTotals) -> Dict[str, object]:
+    from hadoop_bam_tpu.ops.seq_pallas import N_CODES
+    if not totals:
+        return {"n_reads": 0, "mean_gc": 0.0, "mean_qual": 0.0,
+                "base_hist": np.zeros(N_CODES, np.int64)}
+    tf, ti = totals.drain()
+    n = max(float(ti[0]), 1.0)
+    return {"n_reads": int(ti[0]), "mean_gc": float(tf[0] / n),
+            "mean_qual": float(tf[1] / n), "base_hist": ti[1:]}
+
+
 def make_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
                         axis: str = "data") -> Callable:
     """Jitted sharded step over payload tiles: (prefix [n, cap, 36],
     seq [n, cap, SB], qual [n, cap, QB], counts [n]) -> psum'd
-    [3 + 16] vector: (sum_gc, sum_mean_qual, n_reads, base_hist).
+    (f32 [2] (sum_gc, sum_mean_qual), i32 [1 + 16] (n_reads, base_hist))
+    pair — see _payload_stats_tail.
 
     Lengths come from the prefix tile's l_seq column on device, clipped to
     max_len (the pack truncates there); padding rows get length 0 via the
@@ -763,14 +814,7 @@ def make_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
         stats = seq_qual_stats(seq, qual, lengths,
                                block_n=geometry.block_n,
                                interpret=interpret)
-        nonpad = valid.astype(jnp.float32)
-        # counts ride an i32 vector (f32 drifts past 2^24); float sums
-        # (for the means) stay f32 — the host accumulates both in 64-bit
-        fvec = jnp.stack([(stats["gc"] * nonpad).sum(),
-                          (stats["mean_qual"] * nonpad).sum()])
-        ivec = jnp.concatenate([
-            valid.astype(jnp.int32).sum()[None], stats["base_hist"]])
-        return jax.lax.psum(fvec, axis), jax.lax.psum(ivec, axis)
+        return _payload_stats_tail(stats, valid, axis)
 
     # check_vma=False: pallas_call's out_shape has no varying-mesh-axes
     # annotation, which the default shard_map VMA check rejects
@@ -848,9 +892,10 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
 
 def make_read_stats_step(mesh: Mesh, geometry: PayloadGeometry,
                          axis: str = "data") -> Callable:
-    """Like make_seq_stats_step but with explicit per-read lengths instead
-    of a BAM prefix tile — the step for text read formats (FASTQ/QSEQ)
-    whose payload tiles come from fragments_to_payload_tiles."""
+    """Like make_seq_stats_step (same (f32[2], i32[1+16]) return pair) but
+    with explicit per-read lengths instead of a BAM prefix tile — the step
+    for text read formats (FASTQ/QSEQ) whose payload tiles come from
+    fragments_to_payload_tiles."""
     key = ("read_stats", tuple(mesh.devices.flat), mesh.axis_names, axis,
            geometry)
     if key in _STEP_CACHE:
@@ -867,12 +912,7 @@ def make_read_stats_step(mesh: Mesh, geometry: PayloadGeometry,
         stats = seq_qual_stats(seq, qual, lengths,
                                block_n=geometry.block_n,
                                interpret=interpret)
-        nonpad = valid.astype(jnp.float32)
-        fvec = jnp.stack([(stats["gc"] * nonpad).sum(),
-                          (stats["mean_qual"] * nonpad).sum()])
-        ivec = jnp.concatenate([
-            valid.astype(jnp.int32).sum()[None], stats["base_hist"]])
-        return jax.lax.psum(fvec, axis), jax.lax.psum(ivec, axis)
+        return _payload_stats_tail(stats, valid, axis)
 
     fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis),) * 4,
                    out_specs=(P(), P()), check_vma=False)
@@ -899,7 +939,6 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         fragments_to_payload_tiles, open_fastq, open_qseq,
     )
     from hadoop_bam_tpu.parallel.mesh import make_mesh
-    from hadoop_bam_tpu.ops.seq_pallas import N_CODES
 
     if mesh is None:
         mesh = make_mesh()
@@ -915,12 +954,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     sharding = NamedSharding(mesh, P("data"))
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     window = max(1, prefetch) * n_workers
-    # host-side 64-bit accumulators: per-group device sums are exact
-    # (i32 counts / f32 sums over one bounded tile group), the running
-    # totals must not be (WGS base counts blow through both 2^24 and 2^31)
-    totals_f = np.zeros(2, dtype=np.float64)
-    totals_i = np.zeros(1 + N_CODES, dtype=np.int64)
-    seen = False
+    totals = _StatTotals()
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         def decode(span):
             def inner(s):
@@ -939,7 +973,6 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         counts: List[int] = []
 
         def dispatch():
-            nonlocal seen
             seqs = np.stack([g[0] for g in group] + [
                 np.zeros((cap, geometry.seq_stride), np.uint8)
                 for _ in range(n_dev - len(group))])
@@ -954,10 +987,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
             args = [jax.device_put(a, sharding)
                     for a in (seqs, quals, lens)]
             c = jax.device_put(cvec, sharding)
-            fvec, ivec = step(*args, c)
-            totals_f[:] += np.asarray(jax.device_get(fvec), np.float64)
-            totals_i[:] += np.asarray(jax.device_get(ivec), np.int64)
-            seen = True
+            totals.add(*step(*args, c))   # async; drained once at the end
             group.clear()
             counts.clear()
 
@@ -970,14 +1000,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                 dispatch()
         if group:
             dispatch()
-    if not seen:
-        return {"n_reads": 0, "mean_gc": 0.0, "mean_qual": 0.0,
-                "base_hist": np.zeros(N_CODES, np.int64)}
-    n = max(float(totals_i[0]), 1.0)
-    return {"n_reads": int(totals_i[0]),
-            "mean_gc": float(totals_f[0] / n),
-            "mean_qual": float(totals_f[1] / n),
-            "base_hist": totals_i[1:]}
+    return _payload_stats_result(totals)
 
 
 def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
@@ -992,7 +1015,6 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     mesh.  The payload analog of flagstat_file."""
     from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.parallel.mesh import make_mesh
-    from hadoop_bam_tpu.ops.seq_pallas import N_CODES
 
     if mesh is None:
         mesh = make_mesh()
@@ -1014,25 +1036,13 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
 
     step = make_seq_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
-    totals_f = np.zeros(2, dtype=np.float64)
-    totals_i = np.zeros(1 + N_CODES, dtype=np.int64)
-    seen = False
+    totals = _StatTotals()
     for stacked, cvec in iter_payload_tile_groups(
             path, spans, geometry, n_dev, config, prefetch, header=header):
         args = [jax.device_put(a, sharding) for a in stacked]
         c = jax.device_put(cvec, sharding)
-        fvec, ivec = step(*args, c)
-        totals_f[:] += np.asarray(jax.device_get(fvec), np.float64)
-        totals_i[:] += np.asarray(jax.device_get(ivec), np.int64)
-        seen = True
-    if not seen:
-        return {"n_reads": 0, "mean_gc": 0.0, "mean_qual": 0.0,
-                "base_hist": np.zeros(N_CODES, np.int64)}
-    n = max(float(totals_i[0]), 1.0)
-    return {"n_reads": int(totals_i[0]),
-            "mean_gc": float(totals_f[0] / n),
-            "mean_qual": float(totals_f[1] / n),
-            "base_hist": totals_i[1:]}
+        totals.add(*step(*args, c))       # async; drained once at the end
+    return _payload_stats_result(totals)
 
 
 def flagstat_file(path: str, mesh: Optional[Mesh] = None,

@@ -28,7 +28,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.vcf import VariantBatch, VCFHeader
-from hadoop_bam_tpu.parallel.pipeline import _STEP_CACHE, _iter_windowed
+from hadoop_bam_tpu.parallel.pipeline import (
+    _STEP_CACHE, _StatTotals, _iter_windowed,
+)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -251,7 +253,7 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
     sharding = NamedSharding(mesh, P("data"))
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     window = max(1, prefetch) * n_workers
-    totals = None
+    totals = _StatTotals()
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
 
@@ -280,7 +282,6 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
         counts: List[int] = []
 
         def dispatch():
-            nonlocal totals
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts
             stacked = {}
@@ -292,12 +293,7 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
             args = [jax.device_put(stacked[k], sharding)
                     for k in ("chrom", "pos", "flags", "dosage")]
             c = jax.device_put(cvec, sharding)
-            fvec, ivec = step(*args, c)
-            if totals is None:
-                totals = [np.zeros(1, np.float64),
-                          np.zeros(ivec.shape, np.int64)]
-            totals[0] += np.asarray(jax.device_get(fvec), np.float64)
-            totals[1] += np.asarray(jax.device_get(ivec), np.int64)
+            totals.add(*step(*args, c))   # async; drained once at the end
             group.clear()
             counts.clear()
 
@@ -308,10 +304,11 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
                 dispatch()
         if group:
             dispatch()
-    if totals is None:
+    if not totals:
         return {"n_variants": 0, "n_snp": 0, "n_pass": 0, "mean_af": 0.0,
                 "sample_callrate": np.zeros(header.n_samples)}
-    sum_af, ints = float(totals[0][0]), totals[1]
+    tf, ints = totals.drain()
+    sum_af = float(tf[0])
     n_variants = int(ints[0])
     callrate = (ints[4:4 + header.n_samples].astype(np.float64)
                 / max(n_variants, 1)
