@@ -20,6 +20,7 @@ orchestration in cramio.py.
 """
 from __future__ import annotations
 
+import os
 import struct
 import zlib
 from dataclasses import dataclass, field
@@ -216,26 +217,52 @@ class Block:
 
     @classmethod
     def from_buffer(cls, buf: bytes, pos: int) -> Tuple["Block", int]:
-        start = pos
-        method = buf[pos]
-        ctype = buf[pos + 1]
-        pos += 2
-        cid, pos = read_itf8(buf, pos)
-        csize, pos = read_itf8(buf, pos)
-        rsize, pos = read_itf8(buf, pos)
-        payload = bytes(buf[pos:pos + csize])
-        if len(payload) != csize:
-            raise CRAMError("truncated block payload")
-        pos += csize
-        (crc,) = struct.unpack_from("<I", buf, pos)
-        pos += 4
-        if zlib.crc32(buf[start:pos - 4]) & 0xFFFFFFFF != crc:
-            raise CRAMError("block CRC32 mismatch")
-        data = decompress_block_payload(method, payload, rsize)
-        if len(data) != rsize:
+        raw, pos = parse_raw_block(buf, pos)
+        return cls.from_raw(raw), pos
+
+    @classmethod
+    def from_raw(cls, raw: "RawBlock",
+                 data: Optional[bytes] = None) -> "Block":
+        """Materialize from a parsed-but-compressed block; ``data``
+        overrides decompression (the batched rANS path)."""
+        if data is None:
+            data = decompress_block_payload(raw.method, raw.payload,
+                                            raw.rsize)
+        if len(data) != raw.rsize:
             raise CRAMError(
-                f"block inflated to {len(data)} bytes, expected {rsize}")
-        return cls(ctype, cid, data, method), pos
+                f"block inflated to {len(data)} bytes, expected "
+                f"{raw.rsize}")
+        return cls(raw.content_type, raw.content_id, data, raw.method)
+
+
+@dataclass
+class RawBlock:
+    """A block header + still-compressed payload (CRC already checked) —
+    the unit the batched entropy decoders consume."""
+    method: int
+    content_type: int
+    content_id: int
+    payload: bytes
+    rsize: int
+
+
+def parse_raw_block(buf: bytes, pos: int) -> Tuple[RawBlock, int]:
+    start = pos
+    method = buf[pos]
+    ctype = buf[pos + 1]
+    pos += 2
+    cid, pos = read_itf8(buf, pos)
+    csize, pos = read_itf8(buf, pos)
+    rsize, pos = read_itf8(buf, pos)
+    payload = bytes(buf[pos:pos + csize])
+    if len(payload) != csize:
+        raise CRAMError("truncated block payload")
+    pos += csize
+    (crc,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    if zlib.crc32(buf[start:pos - 4]) & 0xFFFFFFFF != crc:
+        raise CRAMError("block CRC32 mismatch")
+    return RawBlock(method, ctype, cid, payload, rsize), pos
 
 
 def decompress_block_payload(method: int, payload: bytes, rsize: int) -> bytes:
@@ -342,16 +369,36 @@ assert len(EOF_CONTAINER) == 38, len(EOF_CONTAINER)
 # Scanning (the split grain — hb/CRAMInputFormat.java's container iterator)
 # ---------------------------------------------------------------------------
 
-def read_container(buf: bytes, pos: int) -> Tuple[Container, int]:
+def read_container(buf: bytes, pos: int,
+                   rans_backend: Optional[str] = None
+                   ) -> Tuple[Container, int]:
+    """Parse one container.  All rANS blocks decode in ONE batch — the
+    intra-container block parallelism the device decoder (ops/rans.py)
+    exploits; ``rans_backend`` (default env HBAM_RANS_BACKEND or "host")
+    picks where."""
     offset = pos
     hdr, pos = ContainerHeader.from_buffer(buf, pos)
     end = pos + hdr.length
-    blocks = []
+    raws: List[RawBlock] = []
     while pos < end:
-        blk, pos = Block.from_buffer(buf, pos)
-        blocks.append(blk)
+        raw, pos = parse_raw_block(buf, pos)
+        raws.append(raw)
     if pos != end:
         raise CRAMError("container blocks overran the declared length")
+
+    backend = rans_backend or os.environ.get("HBAM_RANS_BACKEND", "host")
+    if backend not in ("host", "device", "auto"):
+        raise CRAMError(f"unknown rANS backend {backend!r} "
+                        "(expected host/device/auto)")
+    decoded: dict = {}
+    rans_idx = [i for i, r in enumerate(raws) if r.method == RANS4x8]
+    if backend == "device" and rans_idx:
+        from hadoop_bam_tpu.ops.rans import rans_decode_batch
+        outs = rans_decode_batch([raws[i].payload for i in rans_idx],
+                                 backend=backend)
+        decoded = dict(zip(rans_idx, outs))
+    blocks = [Block.from_raw(r, decoded.get(i))
+              for i, r in enumerate(raws)]
     return Container(hdr, blocks, offset), pos
 
 

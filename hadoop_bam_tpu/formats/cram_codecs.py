@@ -179,14 +179,7 @@ def _read_freq_table_order0(buf: bytes, pos: int
 
 
 def _decode_order0(buf: bytes, pos: int, out_size: int) -> bytes:
-    freqs, pos = _read_freq_table_order0(buf, pos)
-    cum = np.zeros(257, dtype=np.int64)
-    np.cumsum(freqs, out=cum[1:])
-    # dense lookup: 12-bit slot -> symbol
-    slot2sym = np.zeros(TOTFREQ, dtype=np.uint8)
-    for s in range(256):
-        if freqs[s]:
-            slot2sym[cum[s]:cum[s + 1]] = s
+    freqs, cum, slot2sym, pos = read_order0_tables(buf, pos)
 
     from hadoop_bam_tpu.utils import native
     if native.available():
@@ -300,11 +293,28 @@ def _encode_order1(data: bytes) -> bytes:
         "<II", len(table) + len(body), n) + table + body
 
 
-def _decode_order1(buf: bytes, pos: int, out_size: int) -> bytes:
+def read_order0_tables(buf: bytes, pos: int
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Parse an order-0 frequency table: (freqs [256], cum [257],
+    slot2sym [4096], next pos) — the host half shared by the NumPy,
+    native, and device (ops/rans.py) decoders."""
+    freqs, pos = _read_freq_table_order0(buf, pos)
+    cum = np.zeros(257, dtype=np.int64)
+    np.cumsum(freqs, out=cum[1:])
+    slot2sym = np.zeros(TOTFREQ, dtype=np.uint8)
+    for s in range(256):
+        if freqs[s]:
+            slot2sym[cum[s]:cum[s + 1]] = s
+    return freqs, cum, slot2sym, pos
+
+
+def read_order1_tables(buf: bytes, pos: int
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Parse the order-1 context tables: (freqs [256, 256],
+    cums [256, 257], slot2sym [256, 4096], next pos)."""
     freqs = np.zeros((256, 256), dtype=np.int64)
     cums = np.zeros((256, 257), dtype=np.int64)
     slot2sym = np.zeros((256, TOTFREQ), dtype=np.uint8)
-
     # outer context table with the same RLE grammar
     rle = 0
     c = buf[pos]
@@ -331,6 +341,11 @@ def _decode_order1(buf: bytes, pos: int, out_size: int) -> bytes:
                 break
             else:
                 c = nxt
+    return freqs, cums, slot2sym, pos
+
+
+def _decode_order1(buf: bytes, pos: int, out_size: int) -> bytes:
+    freqs, cums, slot2sym, pos = read_order1_tables(buf, pos)
     from hadoop_bam_tpu.utils import native
     if native.available():
         return native.rans_decode(
