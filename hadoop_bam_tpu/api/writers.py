@@ -95,6 +95,7 @@ class CramShardWriter:
         from hadoop_bam_tpu.formats.cramio import CramWriter
         kw.setdefault("write_header", config.write_header)
         kw.setdefault("write_eof", config.write_terminator)
+        kw.setdefault("version", tuple(config.cram_version))
         self._w = CramWriter(sink, header, **kw)
         self.header = header
         self.records_written = 0

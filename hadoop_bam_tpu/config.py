@@ -88,6 +88,7 @@ class HBamConfig:
     vcf_output_format: str = "VCF"   # "VCF" | "BCF" (hb/VCFOutputFormat.java)
     write_header: bool = True        # per-shard header (KeyIgnoring*RecordWriter)
     write_terminator: bool = True    # BGZF EOF block on close
+    cram_version: Tuple[int, int] = (3, 0)  # (3, 1) writes rANS Nx16 blocks
 
     # --- FASTQ / QSEQ (hb/FormatConstants.java) ---
     fastq_base_quality_encoding: BaseQualityEncoding = BaseQualityEncoding.SANGER

@@ -30,8 +30,13 @@ CRAM_MAGIC = b"CRAM"
 CRAM_MAJOR = 3
 CRAM_MINOR = 0
 
-# Block compression methods [SPEC section 8]
+# Block compression methods [SPEC section 8; CRAM 3.1 adds 5-8]
 RAW, GZIP, BZIP2, LZMA, RANS4x8 = 0, 1, 2, 3, 4
+RANSNx16, ARITH, FQZCOMP, NAME_TOK = 5, 6, 7, 8
+
+_METHOD_31_NAMES = {ARITH: "adaptive arithmetic coder",
+                    FQZCOMP: "fqzcomp quality codec",
+                    NAME_TOK: "name tokenizer (tok3)"}
 
 # Block content types [SPEC section 8.1]
 FILE_HEADER = 0
@@ -204,6 +209,11 @@ class Block:
         elif method == RANS4x8:
             from hadoop_bam_tpu.formats.cram_codecs import rans4x8_encode
             comp = rans4x8_encode(raw, order=0)
+        elif method == RANSNx16:
+            from hadoop_bam_tpu.formats.cram_codecs_nx16 import (
+                NX16_PACK, NX16_RLE, rans_nx16_encode,
+            )
+            comp = rans_nx16_encode(raw, NX16_PACK | NX16_RLE)
         elif method == RAW:
             comp = raw
         else:
@@ -279,6 +289,15 @@ def decompress_block_payload(method: int, payload: bytes, rsize: int) -> bytes:
     if method == RANS4x8:
         from hadoop_bam_tpu.formats.cram_codecs import rans4x8_decode
         return rans4x8_decode(payload)
+    if method == RANSNx16:
+        from hadoop_bam_tpu.formats.cram_codecs_nx16 import rans_nx16_decode
+        return rans_nx16_decode(payload, rsize)
+    if method in _METHOD_31_NAMES:
+        raise CRAMError(
+            f"CRAM 3.1 block method {method} "
+            f"({_METHOD_31_NAMES[method]}) is not supported yet — "
+            f"re-encode the file with rANS blocks (e.g. samtools view "
+            f"--output-fmt-option version=3.0)")
     raise CRAMError(f"unknown block compression method {method}")
 
 

@@ -89,8 +89,13 @@ def _ref_span(cigar: List[Tuple[int, str]]) -> int:
 
 
 def encode_container(records: List[SamRecord], header: SAMHeader,
-                     record_counter: int) -> bytes:
-    """Encode one container holding one slice of ``records``."""
+                     record_counter: int,
+                     version: Tuple[int, int] = (3, 0)) -> bytes:
+    """Encode one container holding one slice of ``records``.
+
+    ``version`` selects the entropy codecs: (3, 0) uses rANS 4x8 /
+    gzip; (3, 1) upgrades byte series to rANS Nx16 with PACK/RLE
+    transforms [SPEC CRAM 3.1]."""
     name_to_id = {n: i for i, n in enumerate(header.ref_names)}
     rg_ids = _read_group_ids(header)
 
@@ -130,7 +135,7 @@ def encode_container(records: List[SamRecord], header: SAMHeader,
     # blocks: compression header, slice header, core, externals
     ext_blocks: List[Block] = []
     content_ids: List[int] = []
-    for cid, data, method in _external_payloads(streams):
+    for cid, data, method in _external_payloads(streams, version):
         if data:
             ext_blocks.append(Block(EXTERNAL_DATA, cid, bytes(data), method))
             content_ids.append(cid)
@@ -286,12 +291,16 @@ def _tag_cids(key: int) -> Tuple[int, int]:
     return 100 + 2 * key, 101 + 2 * key
 
 
-def _external_payloads(s: _Streams):
+def _external_payloads(s: _Streams, version: Tuple[int, int] = (3, 0)):
+    from hadoop_bam_tpu.formats.cram import RANSNx16
+    # qualities through rANS like htslib's default; rest gzip.  3.1
+    # upgrades the rANS series to Nx16 (+PACK/RLE) [SPEC CRAM 3.1]
+    rans = RANSNx16 if version >= (3, 1) else RANS4x8
     for k, data in s.ints.items():
         yield _CID_INT[k], data, GZIP
     for k, data in s.bytes_.items():
-        # qualities through rANS like htslib's default; rest gzip
-        yield _CID_BYTE[k], data, (RANS4x8 if k == "QS" else GZIP)
+        # QS = qualities, BA = literal bases: the two bulk byte series
+        yield _CID_BYTE[k], data, (rans if k in ("QS", "BA") else GZIP)
     for k in _ARRAY_SERIES:
         yield _CID_ALEN[k], s.arr_len[k], GZIP
         yield _CID_AVAL[k], s.arr_val[k], GZIP

@@ -49,7 +49,10 @@ class CramWriter:
 
     def __init__(self, path_or_sink: Union[str, BinaryIO], header: SAMHeader,
                  records_per_container: int = DEFAULT_RECORDS_PER_CONTAINER,
-                 write_header: bool = True, write_eof: bool = True):
+                 write_header: bool = True, write_eof: bool = True,
+                 version: Tuple[int, int] = (3, 0)):
+        if version not in ((3, 0), (3, 1)):
+            raise ValueError(f"unsupported CRAM write version {version}")
         if isinstance(path_or_sink, str):
             self._sink: BinaryIO = open(path_or_sink, "wb")
             self._owns = True
@@ -57,13 +60,15 @@ class CramWriter:
             self._sink = path_or_sink
             self._owns = False
         self.header = header
+        self.version = version
         self.records_per_container = records_per_container
         self._write_eof = write_eof
         self._pending: List[SamRecord] = []
         self._record_counter = 0
         self._closed = False
         if write_header:
-            self._sink.write(FileDefinition().to_bytes())
+            self._sink.write(FileDefinition(
+                major=version[0], minor=version[1]).to_bytes())
             self._sink.write(_header_container_bytes(header))
 
     def write_record(self, rec: SamRecord) -> None:
@@ -80,7 +85,8 @@ class CramWriter:
             return
         # split runs so each container's slice is single-ref where possible
         self._sink.write(encode_container(
-            self._pending, self.header, self._record_counter))
+            self._pending, self.header, self._record_counter,
+            version=self.version))
         self._record_counter += len(self._pending)
         self._pending = []
 
