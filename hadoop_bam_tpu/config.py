@@ -88,7 +88,11 @@ class HBamConfig:
     vcf_output_format: str = "VCF"   # "VCF" | "BCF" (hb/VCFOutputFormat.java)
     write_header: bool = True        # per-shard header (KeyIgnoring*RecordWriter)
     write_terminator: bool = True    # BGZF EOF block on close
-    cram_version: Tuple[int, int] = (3, 0)  # (3, 1) writes rANS Nx16 blocks
+    # (3, 1) writes rANS Nx16 blocks.  EXPERIMENTAL: the Nx16 transform
+    # metadata layouts are pinned by golden-byte tests against this repo's
+    # own encoder only — no htslib cross-validation was possible in-image
+    # (SURVEY.md section 0), so 3.1 output may not interop with samtools.
+    cram_version: Tuple[int, int] = (3, 0)
 
     # --- FASTQ / QSEQ (hb/FormatConstants.java) ---
     fastq_base_quality_encoding: BaseQualityEncoding = BaseQualityEncoding.SANGER

@@ -29,6 +29,7 @@ output positions / 4.
 """
 from __future__ import annotations
 
+import contextlib
 import struct
 from typing import List, Tuple
 
@@ -178,6 +179,32 @@ def _read_freq_table_order0(buf: bytes, pos: int
     return freqs, pos
 
 
+def _check_final_states(states, low: int = RANS_LOW,
+                        label: str = "rANS") -> None:
+    """A well-formed stream decodes every state back to ``low`` (the
+    encoder's initial value); anything else is corruption or a lying
+    out_size.  Shared by the 4x8 NumPy decoders here, the Nx16 decoders
+    (low=RANS_LOW_16), and mirrored by the native (-2) and device
+    (ops/rans._check_final) decoders."""
+    if any(int(x) != low for x in states):
+        raise RansError(
+            f"corrupt {label} stream (final-state integrity check "
+            f"failed): {[int(x) for x in states]}")
+
+
+@contextlib.contextmanager
+def normalize_truncation(label: str):
+    """Corrupt/truncated streams surface as RansError, never a bare
+    IndexError (byte reads), struct.error (state words), or ValueError
+    (frombuffer) — one normalization shared by every decoder path."""
+    try:
+        yield
+    except RansError:
+        raise
+    except (IndexError, ValueError, struct.error) as e:
+        raise RansError(f"truncated {label} stream: {e}") from e
+
+
 def _decode_order0(buf: bytes, pos: int, out_size: int) -> bytes:
     freqs, cum, slot2sym, pos = read_order0_tables(buf, pos)
 
@@ -220,6 +247,7 @@ def _decode_order0(buf: bytes, pos: int, out_size: int) -> bytes:
             x = (x << 8) | data[ptr]
             ptr += 1
         states[j] = x
+    _check_final_states(states)
     return out.tobytes()
 
 
@@ -384,6 +412,7 @@ def _decode_order1(buf: bytes, pos: int, out_size: int) -> bytes:
             idx[j] += 1
             if idx[j] >= ends[j]:
                 done[j] = True
+    _check_final_states(states)
     return out.tobytes()
 
 
@@ -408,8 +437,9 @@ def rans4x8_decode(payload: bytes) -> bytes:
         return b""
     if len(payload) < 9 + comp_size:
         raise RansError("truncated rANS stream")
-    if order == RANS_ORDER_0:
-        return _decode_order0(payload, 9, out_size)
-    if order == RANS_ORDER_1:
-        return _decode_order1(payload, 9, out_size)
+    with normalize_truncation("rANS"):
+        if order == RANS_ORDER_0:
+            return _decode_order0(payload, 9, out_size)
+        if order == RANS_ORDER_1:
+            return _decode_order1(payload, 9, out_size)
     raise RansError(f"unknown rANS order {order}")

@@ -39,7 +39,8 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from hadoop_bam_tpu.formats.cram_codecs import (
-    RansError, _normalize_freqs, _read_symbol_table, _write_symbol_table,
+    RansError, _check_final_states, _normalize_freqs, _read_symbol_table,
+    _write_symbol_table, normalize_truncation,
 )
 
 # flag bits [SPEC]
@@ -188,6 +189,7 @@ def _decode_order0_core(buf: bytes, pos: int, out_size: int, N: int,
             x = (x << 16) | (buf[pos] | (buf[pos + 1] << 8))
             pos += 2
         states[j] = x
+    _check_final_states(states, RANS_LOW_16, "rANS Nx16")
     return out.tobytes()
 
 
@@ -314,6 +316,7 @@ def _decode_order1_core(buf: bytes, pos: int, out_size: int, N: int
             idx[j] += 1
             if idx[j] >= ends[j]:
                 done[j] = True
+    _check_final_states(states, RANS_LOW_16, "rANS Nx16")
     return out.tobytes()
 
 
@@ -493,6 +496,12 @@ def rans_nx16_decode(payload: bytes, out_size: Optional[int] = None
                      ) -> bytes:
     """Decode one rANS Nx16 stream.  ``out_size`` is required when the
     stream carries the NOSZ flag (the CRAM block header supplies it)."""
+    with normalize_truncation("rANS Nx16"):
+        return _rans_nx16_decode(payload, out_size)
+
+
+def _rans_nx16_decode(payload: bytes, out_size: Optional[int] = None
+                      ) -> bytes:
     if not payload:
         raise RansError("empty rANS Nx16 stream")
     pos = 0
@@ -529,8 +538,6 @@ def rans_nx16_decode(payload: bytes, out_size: Optional[int] = None
         pos += 1
         pack_syms = payload[pos:pos + nsym]
         pos += nsym
-        pack_out = out_size
-        # payload size after unpack reversal comes from the stage below
 
     rle_meta = None
     lit_len = None

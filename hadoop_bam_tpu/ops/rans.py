@@ -37,7 +37,8 @@ import numpy as np
 
 from hadoop_bam_tpu.formats.cram_codecs import (
     RANS_LOW, RANS_ORDER_0, RANS_ORDER_1, RansError, TF_SHIFT, TOTFREQ,
-    rans4x8_decode, read_order0_tables, read_order1_tables,
+    normalize_truncation, rans4x8_decode, read_order0_tables,
+    read_order1_tables,
 )
 
 _MASK = TOTFREQ - 1
@@ -84,9 +85,10 @@ def _decode0_batch(data, states0, ptr0, freqs, cums, slot2sym, n_out,
             outs.append(sym.astype(jnp.uint8))
         return (states, ptr), jnp.stack(outs, axis=1)   # [B, 4]
 
-    (_, _), ys = jax.lax.scan(body, (states0, ptr0),
-                              jnp.arange(steps, dtype=jnp.int32))
-    return jnp.transpose(ys, (1, 0, 2)).reshape(ys.shape[1], -1)
+    (fstates, fptr), ys = jax.lax.scan(body, (states0, ptr0),
+                                       jnp.arange(steps, dtype=jnp.int32))
+    return (jnp.transpose(ys, (1, 0, 2)).reshape(ys.shape[1], -1),
+            fstates, fptr)
 
 
 @functools.partial(jax.jit, static_argnames=("steps",))
@@ -126,9 +128,9 @@ def _decode1_batch(data, states0, ptr0, freqs, cums, slot2sym, q, rem,
         return (states, ptr, ctxs), jnp.stack(outs, axis=1)
 
     ctxs0 = jnp.zeros_like(states0, dtype=jnp.int32)
-    (_, _, _), ys = jax.lax.scan(body, (states0, ptr0, ctxs0),
-                                 jnp.arange(steps, dtype=jnp.int32))
-    return jnp.transpose(ys, (1, 2, 0))                 # [B, 4, steps]
+    (fstates, fptr, _), ys = jax.lax.scan(body, (states0, ptr0, ctxs0),
+                                          jnp.arange(steps, dtype=jnp.int32))
+    return jnp.transpose(ys, (1, 2, 0)), fstates, fptr  # [B, 4, steps]
 
 
 # ---------------------------------------------------------------------------
@@ -168,6 +170,26 @@ def _pad_batch(blocks: Sequence[Tuple[np.ndarray, np.ndarray, int, int]],
     return data, states, ptr, n_out, B
 
 
+def _check_final(fstates: np.ndarray, fptr: np.ndarray, chunk) -> None:
+    """Integrity check after a batched device decode.
+
+    The encoder initializes every state to RANS_LOW, so a well-formed
+    stream decodes back to exactly RANS_LOW with the shared byte pointer
+    landing on the end of the renorm bytes.  A corrupt/truncated payload
+    (whose out-of-range gathers clamp silently under JAX semantics) fails
+    one of the two — raise instead of returning garbage, matching the
+    host decoder's error behavior.  ``chunk`` is the [(payload index,
+    block)] list so errors name the batch-level payload, not the
+    chunk-local row."""
+    for k, (i, (body, _st, _pos, _osz)) in enumerate(chunk):
+        if fptr[k] != body.size or (fstates[k] != RANS_LOW).any():
+            raise RansError(
+                f"device rANS decode integrity failure on payload {i}: "
+                f"consumed {int(fptr[k])}/{body.size} renorm bytes, "
+                f"final states {fstates[k].tolist()} (want all "
+                f"{RANS_LOW}) — corrupt or truncated stream")
+
+
 def rans_decode_batch_device(payloads: Sequence[bytes]) -> List[bytes]:
     """Decode many rANS 4x8 streams on the default JAX device, batched.
 
@@ -184,18 +206,20 @@ def rans_decode_batch_device(payloads: Sequence[bytes]) -> List[bytes]:
             results[i] = b""
             continue
         body = np.frombuffer(p, dtype=np.uint8, count=comp_size, offset=9)
-        if order == RANS_ORDER_0:
-            freqs, cum, slot2sym, pos = read_order0_tables(p, 9)
+        with normalize_truncation(f"rANS (payload {i})"):
+            if order == RANS_ORDER_0:
+                freqs, cum, slot2sym, pos = read_order0_tables(p, 9)
+                tables0.append((freqs, cum[:256], slot2sym))
+            elif order == RANS_ORDER_1:
+                freqs, cums, slot2sym, pos = read_order1_tables(p, 9)
+                tables1.append((freqs, cums[:, :256], slot2sym))
+            else:
+                raise RansError(f"unknown rANS order {order}")
+            if len(p) < pos + 16:
+                raise RansError("truncated rANS stream (state words)")
             st = np.frombuffer(p[pos:pos + 16], dtype="<u4").copy()
-            o0.append((i, (body[pos - 9 + 16:], st, 0, out_size)))
-            tables0.append((freqs, cum[:256], slot2sym))
-        elif order == RANS_ORDER_1:
-            freqs, cums, slot2sym, pos = read_order1_tables(p, 9)
-            st = np.frombuffer(p[pos:pos + 16], dtype="<u4").copy()
-            o1.append((i, (body[pos - 9 + 16:], st, 0, out_size)))
-            tables1.append((freqs, cums[:, :256], slot2sym))
-        else:
-            raise RansError(f"unknown rANS order {order}")
+            (o0 if order == RANS_ORDER_0 else o1).append(
+                (i, (body[pos - 9 + 16:], st, 0, out_size)))
 
     # --- order-0: vectorize across up to 256 blocks per dispatch
     CH0 = 256
@@ -212,10 +236,12 @@ def rans_decode_batch_device(payloads: Sequence[bytes]) -> List[bytes]:
             freqs[k], cums[k], slot[k] = f, c, s
         freqs[B:, :] = 1  # dummy rows: nonzero freq keeps states sane
         steps = _round_pow2((int(n_out.max()) + 3) // 4)
-        out = np.asarray(_decode0_batch(
+        out, fstates, fptr = _decode0_batch(
             jnp.asarray(data), jnp.asarray(states), jnp.asarray(ptr),
             jnp.asarray(freqs), jnp.asarray(cums), jnp.asarray(slot),
-            jnp.asarray(n_out), steps))
+            jnp.asarray(n_out), steps)
+        out = np.asarray(out)
+        _check_final(np.asarray(fstates), np.asarray(fptr), chunk)
         for k, (i, (_b, _s, _p, osz)) in enumerate(chunk):
             results[i] = out[k, :osz].tobytes()
 
@@ -238,10 +264,12 @@ def rans_decode_batch_device(payloads: Sequence[bytes]) -> List[bytes]:
         q = n_out >> 2
         rem = n_out - 3 * q - q
         steps = _round_pow2(int((q + rem).max()))
-        out = np.asarray(_decode1_batch(
+        out, fstates, fptr = _decode1_batch(
             jnp.asarray(data), jnp.asarray(states), jnp.asarray(ptr),
             jnp.asarray(freqs), jnp.asarray(cums), jnp.asarray(slot),
-            jnp.asarray(q), jnp.asarray(rem), steps))   # [B, 4, steps]
+            jnp.asarray(q), jnp.asarray(rem), steps)    # [B, 4, steps]
+        out = np.asarray(out)
+        _check_final(np.asarray(fstates), np.asarray(fptr), chunk)
         for k, (i, (_b, _s, _p, osz)) in enumerate(chunk):
             qq, rr = osz >> 2, osz - 4 * (osz >> 2)
             parts = [out[k, 0, :qq], out[k, 1, :qq], out[k, 2, :qq],

@@ -236,7 +236,10 @@ def rans_decode(order: int, buf: np.ndarray, ptr: int, freqs: np.ndarray,
             _ptr(slot2sym, ctypes.c_uint8), _ptr(out, ctypes.c_uint8),
             out_size)
     if rc != 0:
-        raise ValueError("corrupt rANS stream (ran out of bytes)")
+        from hadoop_bam_tpu.formats.cram_codecs import RansError
+        raise RansError(
+            "corrupt rANS stream (ran out of bytes)" if rc == -1 else
+            "corrupt rANS stream (final-state integrity check failed)")
     return out
 
 

@@ -346,6 +346,10 @@ int hbam_rans0_decode(const uint8_t* buf, int64_t buf_len, int64_t ptr,
     }
     states[j] = x;
   }
+  // a well-formed stream decodes every state back to the encoder's
+  // initial value; anything else is corruption (or a lying out_size)
+  for (int j = 0; j < 4; ++j)
+    if (states[j] != kRansLow) return -2;
   return 0;
 }
 
@@ -389,6 +393,8 @@ int hbam_rans1_decode(const uint8_t* buf, int64_t buf_len, int64_t ptr,
       if (++idx[j] < ends[j]) done_all = false;
     }
   }
+  for (int j = 0; j < 4; ++j)
+    if (states[j] != kRansLow) return -2;
   return 0;
 }
 

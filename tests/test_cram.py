@@ -306,3 +306,32 @@ def test_cram_tensor_batches(tmp_path):
         total += int(counts.sum())
     assert total == len(recs)
     assert first_seq == recs[0].seq[:160]
+
+
+@pytest.mark.parametrize("order", [0, 1])
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_host_decode_rejects_corrupt_stream(order, force_numpy, monkeypatch):
+    """Both host decoders (native C++ and the NumPy fallback) raise on a
+    bit-flipped stream instead of returning garbage — same contract as
+    the device decoder (ops/rans.py _check_final)."""
+    from hadoop_bam_tpu.formats.cram_codecs import RansError
+    from hadoop_bam_tpu.utils import native
+
+    if force_numpy:
+        monkeypatch.setattr(native, "available", lambda: False)
+    rng = random.Random(9)
+    data = bytes(rng.choice(b"ACGTN") for _ in range(2000))
+    p = bytearray(rans4x8_encode(data, order=order))
+    p[-40] ^= 0xFF
+    with pytest.raises(RansError):
+        rans4x8_decode(bytes(p))
+
+
+def test_host_decode_rejects_lying_out_size():
+    from hadoop_bam_tpu.formats.cram_codecs import RansError
+
+    data = b"ACGT" * 500
+    p = bytearray(rans4x8_encode(data, order=0))
+    p[5:9] = (len(data) + 64).to_bytes(4, "little")
+    with pytest.raises(RansError):
+        rans4x8_decode(bytes(p))

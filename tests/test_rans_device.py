@@ -107,3 +107,29 @@ def test_cram_read_through_device_backend(tmp_path, monkeypatch):
            for r in open_cram(path).records()]
     assert host == dev
     assert len(host) == 500
+
+
+@pytest.mark.parametrize("order", [0, 1])
+def test_corrupt_stream_raises_not_garbage(order):
+    """A corrupt payload must raise RansError from the device path, not
+    silently return junk (out-of-range gathers clamp under JAX)."""
+    from hadoop_bam_tpu.formats.cram_codecs import RansError
+
+    rng = random.Random(9)
+    data = bytes(rng.choice(b"ACGTN") for _ in range(2000))
+    p = bytearray(rans4x8_encode(data, order=order))
+    p[-40] ^= 0xFF          # flip a renorm byte deep in the body
+    with pytest.raises(RansError):
+        rans_decode_batch_device([bytes(p)])
+
+
+def test_truncated_out_size_raises():
+    """An inflated out_size (stream claims more symbols than encoded)
+    must be detected by the final-state/pointer integrity check."""
+    from hadoop_bam_tpu.formats.cram_codecs import RansError
+
+    data = b"ACGT" * 500
+    p = bytearray(rans4x8_encode(data, order=0))
+    p[5:9] = (len(data) + 64).to_bytes(4, "little")   # lie about out_size
+    with pytest.raises(RansError):
+        rans_decode_batch_device([bytes(p)])
