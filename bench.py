@@ -309,6 +309,42 @@ def bench_split_guess(path: str):
             "value": round(dt / boundaries * 1e3, 3), "unit": "ms"}
 
 
+def bench_deflate_tokenize(path: str):
+    """Host half of the device-DEFLATE experiment (BASELINE.md r3 "Device
+    DEFLATE"): Huffman tokenize GB/s, with vs_baseline = tokenize/full-
+    native-inflate speed ratio.  vs_baseline < 1 records that the
+    two-stage device split cannot beat host inflate even granting a free
+    device stage — the measured negative result."""
+    import numpy as np
+
+    from hadoop_bam_tpu.ops import inflate as inflate_ops
+    from hadoop_bam_tpu.utils import native as nat
+
+    if not nat.available():
+        return {"metric": "deflate_tokenize_gbps", "value": 0.0,
+                "unit": "GB/s", "note": "native tokenizer unavailable"}
+    raw_b = open(path, "rb").read()
+    table = inflate_ops.block_table(raw_b)
+    src = np.frombuffer(raw_b, np.uint8)
+    total = int(table["isize"].sum())
+    stride = max(16, int(table["isize"].max()))
+
+    def run():
+        return nat.deflate_tokenize_batch(
+            src, table["cdata_off"], table["cdata_len"], stride, 1)
+
+    _, dt = _median_time(run, reps=3)
+
+    def base_run():
+        return inflate_ops.inflate_span(raw_b, table, backend="native",
+                                        n_threads=1)
+
+    _, bdt = _median_time(base_run, reps=3)
+    return {"metric": "deflate_tokenize_gbps",
+            "value": round(total / dt / 1e9, 3), "unit": "GB/s",
+            "vs_baseline": round(bdt / dt, 3)}
+
+
 def main() -> None:
     path = build_fixture()
     base = baseline_single_thread(path)
@@ -319,6 +355,7 @@ def main() -> None:
          "value": round(meas, 1), "unit": "records/s",
          "vs_baseline": round(meas / base, 3)},
         bench_bgzf_inflate(path),
+        bench_deflate_tokenize(path),
         bench_cram(build_cram_fixture()),
         bench_vcf(build_vcf_fixture()),
         bench_fastq(build_fastq_fixture()),

@@ -7,9 +7,11 @@ Paths, in preference order:
   (native/hbam_native.cpp) — the production host path feeding device batches.
 - ``zlib``: Python zlib per block (portable fallback, still batched at the
   span level).
-- ``device``: experimental Pallas DEFLATE (ops/inflate_device.py, later
-  rounds) — blocks inflate *on the TPU*, removing the host decompress from
-  the critical path entirely.
+- ``device``: two-stage device DEFLATE (ops/inflate_device.py) — host
+  Huffman tokenize (native, threaded) + on-device LZ77 copy resolution by
+  pointer doubling.  Measured, not default: the Huffman stage dominates
+  inflate cost and is bit-serial, so the host stage bounds throughput; see
+  BASELINE.md "Device DEFLATE" for the numbers.
 
 All paths share one contract: given the raw compressed span bytes and the
 parsed block table, produce a contiguous inflated buffer + per-block inflated
@@ -58,6 +60,9 @@ def inflate_span(raw: bytes, table: Optional[dict] = None,
     """
     if table is None:
         table = block_table(raw)
+    if backend == "device":
+        from hadoop_bam_tpu.ops.inflate_device import inflate_span_device
+        return inflate_span_device(raw, table, n_threads=n_threads)
     isize = table["isize"]
     ubase = np.zeros(isize.size + 1, dtype=np.int64)
     np.cumsum(isize, out=ubase[1:])

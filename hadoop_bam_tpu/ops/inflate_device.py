@@ -1,0 +1,143 @@
+"""Device DEFLATE: LZ77 back-reference resolution on the accelerator.
+
+The reference hot loop inflates every 64 KiB BGZF block through zlib's JNI
+(SURVEY.md section 3.2); the inflate CPU cost splits into two very
+different halves:
+
+1. **Huffman symbol decode** — a bit-serial, data-dependent branch cascade
+   with no intra-stream parallelism.  This stays on the host
+   (native/hbam_native.cpp::hbam_deflate_tokenize_batch, threaded across
+   blocks), emitting fixed-width u32 LZ77 tokens:
+   bit31 set -> copy (bits 16-24 length, bits 0-15 distance-1),
+   bit31 clear -> literal byte.
+2. **LZ77 copy resolution** — embarrassingly parallel across blocks AND,
+   via pointer doubling, log-depth parallel across bytes.  This is the
+   device half below.
+
+Kernel shape (pure jnp/lax — batched gathers on the VPU, no scalar loops):
+
+- token lengths -> exclusive cumsum gives each token's output start;
+- scatter-add marks at starts, cumsum -> per-byte token id;
+- per byte: ``src[p] = p - dist`` for copy bytes, ``src[p] = p`` (fixed
+  point) for literals — an acyclic pointer forest rooted at literals;
+- pointer doubling ``src = src[src]`` inside ``lax.while_loop`` until
+  converged (<= ceil(log2(chain depth)) rounds; overlapping RLE-style
+  copies are the deep-chain worst case), then one gather from the
+  scattered literal bytes.
+
+Measurement discipline (BASELINE.md "Device DEFLATE"): the host tokenize
+stage, the on-chip resolve (jitted, inputs device-resident, excludes the
+H2D link), and the end-to-end span inflate are timed separately so the
+conclusion transfers to non-tunneled hardware.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.ops.rans import _round_pow2
+from hadoop_bam_tpu.utils import native
+
+# BGZF caps a block's inflated size at 64 KiB [SPEC SAMv1 4.1]
+BGZF_MAX_ISIZE = 1 << 16
+
+
+@functools.partial(jax.jit, static_argnames=("P",))
+def resolve_tokens(tokens: jax.Array, n_tokens: jax.Array, P: int
+                   ) -> jax.Array:
+    """Resolve LZ77 tokens to inflated bytes: [B, T] u32 + [B] i32 -> [B, P] u8.
+
+    Positions past each block's output length hold junk; the caller slices
+    by out_lens.  P must be >= every block's inflated size."""
+    B, T = tokens.shape
+    is_copy = (tokens >> 31).astype(jnp.int32)
+    tok_len = jnp.where(is_copy == 1,
+                        ((tokens >> 16) & 0x1FF).astype(jnp.int32), 1)
+    tid = jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = tid < n_tokens[:, None]
+    tok_len = jnp.where(valid, tok_len, 0)
+    starts = jnp.cumsum(tok_len, axis=1) - tok_len          # exclusive
+
+    # per-byte token id: scatter 1 at each token start (zero-length pads
+    # land in a sacrificial extra column), cumsum, -1
+    scat = jnp.where((tok_len > 0) & valid, starts, P)
+    marks = jnp.zeros((B, P + 1), jnp.int32).at[
+        jnp.arange(B, dtype=jnp.int32)[:, None], scat].add(1)
+    tok_of_byte = jnp.cumsum(marks[:, :P], axis=1) - 1
+    tok_of_byte = jnp.clip(tok_of_byte, 0, T - 1)
+
+    w = jnp.take_along_axis(tokens, tok_of_byte, axis=1)    # token per byte
+    pos = jnp.arange(P, dtype=jnp.int32)[None, :]
+    byte_is_copy = (w >> 31).astype(jnp.int32)
+    dist = (w & 0xFFFF).astype(jnp.int32) + 1
+    src = jnp.where(byte_is_copy == 1, pos - dist, pos)
+    src = jnp.clip(src, 0, P - 1)   # tokenizer guarantees dist <= position
+    lit = jnp.where(byte_is_copy == 1, 0, w & 0xFF).astype(jnp.uint8)
+
+    # pointer doubling until every byte points at its literal root; the
+    # forest is acyclic (src[p] < p for copies) so this terminates in
+    # <= ceil(log2(P)) rounds, far fewer for typical shallow chains
+    def cond(c):
+        return c[1]
+
+    def body(c):
+        s, _ = c
+        s2 = jnp.take_along_axis(s, s, axis=1)
+        return s2, jnp.any(s2 != s)
+
+    src, _ = jax.lax.while_loop(cond, body, (src, jnp.bool_(True)))
+    return jnp.take_along_axis(lit, src, axis=1)
+
+
+def inflate_span_device(raw: bytes, table: Optional[dict] = None,
+                        chunk: int = 64, n_threads: int = 0
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Inflate a BGZF span with host Huffman tokenize + device LZ77 resolve.
+
+    Same contract as ops.inflate.inflate_span: returns (contiguous
+    inflated bytes, per-block starting offsets)."""
+    from hadoop_bam_tpu.ops.inflate import block_table
+    if table is None:
+        table = block_table(raw)
+    if not native.available():
+        raise RuntimeError(
+            "device inflate needs the native tokenizer "
+            "(hbam_deflate_tokenize_batch); native library unavailable")
+    isize = table["isize"]
+    n = isize.size
+    ubase = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(isize, out=ubase[1:])
+    dst = np.empty(int(ubase[-1]), dtype=np.uint8)
+    src = np.frombuffer(raw, dtype=np.uint8)
+
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        sub_isize = isize[lo:hi]
+        stride = max(16, int(sub_isize.max())) if hi > lo else 16
+        tokens, n_tokens, out_lens = native.deflate_tokenize_batch(
+            src, table["cdata_off"][lo:hi], table["cdata_len"][lo:hi],
+            stride, n_threads)
+        if not np.array_equal(out_lens, sub_isize):
+            bad = int(np.nonzero(out_lens != sub_isize)[0][0])
+            raise bgzf.BGZFError(
+                f"ISIZE mismatch in block {lo + bad}: tokenized "
+                f"{int(out_lens[bad])}, footer says {int(sub_isize[bad])}")
+        P = _round_pow2(stride, 256)
+        b_cap = _round_pow2(hi - lo, 8)
+        # pad the token axis to P too, so (B, T, P) are all canonical and
+        # heterogeneous chunks reuse one jit cache entry
+        tok_pad = np.zeros((b_cap, P), dtype=np.uint32)
+        tok_pad[: hi - lo, : tokens.shape[1]] = tokens
+        nt_pad = np.zeros(b_cap, dtype=np.int32)
+        nt_pad[: hi - lo] = n_tokens
+        out = np.asarray(resolve_tokens(
+            jnp.asarray(tok_pad), jnp.asarray(nt_pad), P))
+        for k in range(hi - lo):
+            i = lo + k
+            dst[int(ubase[i]):int(ubase[i + 1])] = out[k, : int(isize[i])]
+    return dst, ubase[:-1]
