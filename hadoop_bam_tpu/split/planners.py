@@ -100,6 +100,46 @@ def plan_bam_spans(path: str, *, num_spans: Optional[int] = None,
         src.close()
 
 
+def plan_bam_spans_balanced(path: str, num_spans: int, *,
+                            header: Optional[SAMHeader] = None,
+                            index: Optional[SplittingIndex] = None,
+                            granularity: int = 0,
+                            ) -> List[FileVirtualSpan]:
+    """Record-balanced spans via the splitting index: partition sampled
+    record voffsets into ``num_spans`` contiguous runs of near-equal record
+    count.  Unlike hb/BAMInputFormat.getSplits' byte-range snapping (which
+    cannot cut inside a BGZF block, so a small file yields fewer spans than
+    devices), the boundaries here are full virtual offsets — in-block cuts
+    are allowed, so even a one-block BAM saturates an n-device mesh.
+
+    When no sidecar index exists one is built in memory; ``granularity``
+    0 picks a sampling step fine enough for ~8 samples per span."""
+    from hadoop_bam_tpu.split.splitting_index import build_splitting_index
+    if index is None:
+        index = SplittingIndex.load_for(path)
+    if index is None:
+        if granularity <= 0:
+            # one cheap counting pass (granularity=1 keeps every voffset;
+            # acceptable for the small files this planner exists for)
+            granularity = 1
+        index = build_splitting_index(path, granularity=granularity)
+    samples = index.voffsets[:-1]           # drop the end sentinel
+    end_sentinel = index.voffsets[-1]
+    if not samples:
+        return []
+    num_spans = max(1, min(num_spans, len(samples)))
+    bounds = np.linspace(0, len(samples), num_spans + 1).astype(np.int64)
+    bounds = np.unique(bounds)
+    spans: List[FileVirtualSpan] = []
+    for i in range(len(bounds) - 1):
+        s = samples[int(bounds[i])]
+        e = (end_sentinel if i == len(bounds) - 2
+             else samples[int(bounds[i + 1])])
+        if s < e:
+            spans.append(FileVirtualSpan(path, s, e))
+    return spans
+
+
 def _next_name_group_start(path: str, boundary: int, header: SAMHeader,
                            first_voffset: int, end_sentinel: int,
                            index, guesser) -> int:
@@ -212,7 +252,10 @@ def read_bam_span(source, span: FileVirtualSpan,
         end_inflated = len(buf)
         for base, c in block_bases:
             if c == end_c:
-                end_inflated = base + end_u + (start_u if c == start_c else 0)
+                # base already accounts for a trimmed first block (it is
+                # stored as total - start_u), so base + end_u is the buffer
+                # offset of in-block offset end_u in every case.
+                end_inflated = base + end_u
                 break
 
     offs = walk_record_offsets(buf, 0, None)

@@ -19,7 +19,17 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "hbam_native.cpp")
 _OUT_DIR = os.path.join(_REPO_ROOT, "native", "build")
-_SO = os.path.join(_OUT_DIR, "libhbam_native.so")
+
+# HBAM_NATIVE_SANITIZE=address|thread builds and loads a sanitized variant
+# (the reference side got memory safety for free from the JVM; our C++ has
+# threads + raw offset arithmetic, so CI exercises it under ASan/TSan —
+# SURVEY.md section 5 sanitizers row).  The sanitized .so only loads when
+# the runtime (libasan/libtsan) is preloaded; tests spawn a subprocess with
+# LD_PRELOAD set (tests/test_native_sanitize.py).
+_SANITIZE = os.environ.get("HBAM_NATIVE_SANITIZE", "")
+_SO = os.path.join(
+    _OUT_DIR, f"libhbam_native_{_SANITIZE}.so" if _SANITIZE
+    else "libhbam_native.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -30,6 +40,9 @@ def _compile() -> bool:
     os.makedirs(_OUT_DIR, exist_ok=True)
     base = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
             _SRC, "-o", _SO]
+    if _SANITIZE:
+        base[1:1] = [f"-fsanitize={_SANITIZE}", "-fno-omit-frame-pointer",
+                     "-g"]
     # Prefer libdeflate (~2x zlib inflate speed); fall back to plain zlib.
     for extra in (["-DHBAM_USE_LIBDEFLATE", "-lz", "-ldeflate"], ["-lz"]):
         try:

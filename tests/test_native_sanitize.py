@@ -1,0 +1,112 @@
+"""Sanitizer pass over the native C++ helper (SURVEY.md section 5, race
+detection/sanitizers row).
+
+The reference's Java got memory safety from the JVM; our native library
+(native/hbam_native.cpp) has threads and raw offset arithmetic, so every
+exported entry point is exercised here under AddressSanitizer: the library
+is rebuilt with -fsanitize=address and driven from a subprocess that
+preloads the ASan runtime (a non-instrumented python can only host an
+ASan .so via LD_PRELOAD).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The subprocess body: build fixtures in memory and push them through every
+# native entry point (inflate, CRC, record walks, packed/payload walks,
+# deflate, rANS 4x8 + Nx16, DEFLATE tokenize).  Multi-threaded calls are
+# explicit so ASan sees the pthread paths.
+DRIVER = r"""
+import io, random, sys
+import numpy as np
+from hadoop_bam_tpu.utils import native
+assert native.available(), "sanitized native build failed to load"
+
+from hadoop_bam_tpu.formats.bamio import BamWriter
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.sam import SamRecord
+
+header = SAMHeader.from_sam_text("@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000000\n")
+rng = random.Random(7)
+sink = io.BytesIO()
+with BamWriter(sink, header) as w:
+    for i in range(400):
+        l = rng.randint(30, 150)
+        w.write_sam_record(SamRecord(
+            qname=f"r{i}", flag=rng.choice([0, 16, 99]), rname="chr1",
+            pos=1 + i * 10, mapq=60, cigar=f"{l}M", rnext="=",
+            pnext=1 + i, tlen=200,
+            seq="".join(rng.choice("ACGT") for _ in range(l)),
+            qual="".join(chr(33 + rng.randint(2, 40)) for _ in range(l))))
+raw = sink.getvalue()
+
+from hadoop_bam_tpu.ops import inflate as inflate_ops
+table = inflate_ops.block_table(raw)
+data, ubase = inflate_ops.inflate_span(raw, table, backend="native",
+                                       n_threads=4)
+inflate_ops.verify_crcs(raw, table, data, ubase, n_threads=4)
+
+hdr, after = SAMHeader.from_bam_bytes(data.tobytes())
+offs, tail = native.walk_bam_records(data, after, 1024)
+assert offs.size == 400, offs.size
+
+rows, offs2, _ = native.walk_bam_packed(
+    data, after, 1024, [(0, 4), (4, 4), (12, 2)], 10)
+assert (offs2 == offs).all()
+prefix, seq, qual, offs3, _ = native.walk_bam_payload(
+    data, after, 1024, 160, 80, 160)
+assert (offs3 == offs).all()
+
+comp = native.deflate_raw(data.tobytes()[:4096], level=6)
+assert comp is not None
+
+# rANS 4x8 both orders (decode dispatches to the native loop when loaded)
+from hadoop_bam_tpu.formats import cram_codecs
+payload = bytes(rng.choice(b"ACGT!#") for _ in range(5000))
+for order in (0, 1):
+    enc = cram_codecs.rans4x8_encode(payload, order=order)
+    got = cram_codecs.rans4x8_decode(enc)
+    assert got == payload, order
+
+# DEFLATE tokenize (host half of the device inflate), threaded
+src = np.frombuffer(raw, dtype=np.uint8)
+tokens, n_tokens, out_lens = native.deflate_tokenize_batch(
+    src, table["cdata_off"], table["cdata_len"],
+    int(table["isize"].max()) + 16, n_threads=4)
+assert (out_lens == table["isize"]).all()
+print("SANITIZED-OK")
+"""
+
+
+def _asan_runtime():
+    try:
+        out = subprocess.run(["g++", "-print-file-name=libasan.so"],
+                             capture_output=True, text=True, timeout=30)
+    except Exception:
+        return None
+    path = out.stdout.strip()
+    return path if path and os.path.sep in path and os.path.exists(path) \
+        else None
+
+
+@pytest.mark.skipif(_asan_runtime() is None,
+                    reason="g++/libasan not available")
+def test_native_asan_clean():
+    env = dict(os.environ)
+    env.update({
+        "HBAM_NATIVE_SANITIZE": "address",
+        "LD_PRELOAD": _asan_runtime(),
+        # CPython itself "leaks" interned objects; only instrument our .so's
+        # heap errors, overflows, and races with the preloaded runtime.
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.run([sys.executable, "-c", DRIVER], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "SANITIZED-OK" in proc.stdout
+    assert "AddressSanitizer" not in proc.stderr, proc.stderr[-4000:]

@@ -106,6 +106,40 @@ def test_span_union_exactly_once(bam_file, tmp_path, num_spans, use_index):
     assert got_names == [r.qname for r in records]
 
 
+@pytest.mark.parametrize("num_spans", [2, 8, 64])
+def test_plan_balanced_saturates(bam_file, num_spans):
+    """Record-balanced planning cuts inside BGZF blocks so every span gets
+    near-equal record counts — no idle devices on small files."""
+    from hadoop_bam_tpu.split.planners import plan_bam_spans_balanced
+    path, header, records, voffs = bam_file
+    spans = plan_bam_spans_balanced(path, num_spans, header=header)
+    assert len(spans) == num_spans
+    counts, got_voffs = [], []
+    for span in spans:
+        batch = read_bam_span(path, span, header=header)
+        counts.append(len(batch))
+        got_voffs.extend(int(v) for v in batch.voffsets)
+    assert got_voffs == voffs                       # exactly-once union
+    assert min(counts) > 0
+    assert max(counts) - min(counts) <= len(records) // num_spans + 1
+
+
+def test_plan_balanced_respects_sidecar_granularity(bam_file):
+    """With a coarse index provided, boundaries land on sampled voffsets."""
+    from hadoop_bam_tpu.split.planners import plan_bam_spans_balanced
+    path, header, records, voffs = bam_file
+    idx = build_splitting_index(path, granularity=100)
+    spans = plan_bam_spans_balanced(path, 8, header=header, index=idx)
+    sampled = set(idx.voffsets)
+    for s in spans:
+        assert s.start_voffset in sampled
+    got = []
+    for span in spans:
+        got.extend(int(v) for v in read_bam_span(path, span,
+                                                 header=header).voffsets)
+    assert got == voffs
+
+
 def test_plan_respects_sidecar(bam_file, tmp_path):
     path, header, records, voffs = bam_file
     sidecar = write_splitting_index(path, granularity=50)
