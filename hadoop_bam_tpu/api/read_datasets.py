@@ -94,14 +94,17 @@ class FastqDataset(_SpannedDataset):
                 return [FileByteSpan(self.path, 0, src.size)]
         return super()._plan_spans(num_spans)
 
-    def read_span(self, span: FileByteSpan) -> List[SequencedFragment]:
+    def read_span_text(self, span: FileByteSpan) -> bytes:
+        """Raw record-aligned text of a span (whole file when gzipped) —
+        the input to both the object parse and the vectorized tile path."""
         if span.start == 0 and self._is_compressed():
             import gzip
             with open(self.path, "rb") as f:
-                text = gzip.decompress(f.read())
-        else:
-            text = read_fastq_span(self.path, span)
-        return parse_fastq(text,
+                return gzip.decompress(f.read())
+        return read_fastq_span(self.path, span)
+
+    def read_span(self, span: FileByteSpan) -> List[SequencedFragment]:
+        return parse_fastq(self.read_span_text(span),
                            encoding=self.config.fastq_base_quality_encoding,
                            filter_failed_qc=self.config.fastq_filter_failed_qc)
 
@@ -242,6 +245,91 @@ for _c, _code in (("=", 0), ("A", 1), ("C", 2), ("M", 3), ("G", 4),
                   ("N", 15)):
     _NIBBLE_CODE[ord(_c)] = _code
     _NIBBLE_CODE[ord(_c.lower())] = _code
+
+
+def fastq_text_to_payload_tiles(text: bytes, seq_stride: int,
+                                qual_stride: int, max_len: int,
+                                qual_offset: int = 33
+                                ) -> Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]:
+    """Vectorized FASTQ span -> payload tiles, no per-read Python objects.
+
+    The stats drivers only need (packed bases, qualities, lengths); going
+    through parse_fastq costs a SequencedFragment (with run-metadata name
+    parsing) per read and dominates the FASTQ pipeline wall clock.  This
+    path tokenizes the whole span with NumPy: newline scan -> line table ->
+    4-line record grid -> one clamped gather per payload matrix.
+
+    Validation matches parse_fastq's strictness where cheap (4n lines,
+    '@'/'+' leads, SEQ/QUAL length equality); it raises the same FastqError.
+    """
+    from hadoop_bam_tpu.formats.fastq import FastqError
+
+    buf = np.frombuffer(text, dtype=np.uint8)
+    if buf.size == 0:
+        return (np.zeros((0, seq_stride), np.uint8),
+                np.zeros((0, qual_stride), np.uint8),
+                np.zeros((0,), np.int32))
+    nl = np.flatnonzero(buf == 0x0A)
+    # A final line without a terminating newline still counts as a line
+    # (parse_fastq's split-then-pop yields the same set); track whether we
+    # synthesized it so only THAT line is dropped when empty — a real
+    # zero-length final line (legal zero-length read) must be kept.
+    synthesized_last = nl.size == 0 or nl[-1] != buf.size - 1
+    if synthesized_last:
+        nl = np.append(nl, buf.size)
+    starts = np.empty(nl.size, dtype=np.int64)
+    starts[0] = 0
+    starts[1:] = nl[:-1] + 1
+    ends = nl.copy()
+    # CRLF-safe: shrink lines whose last byte is \r
+    has_cr = (ends > starts) & (buf[np.minimum(ends - 1, buf.size - 1)]
+                                == 0x0D)
+    ends = ends - has_cr
+    if synthesized_last and starts[-1] >= ends[-1]:
+        starts, ends = starts[:-1], ends[:-1]
+    if starts.size % 4:
+        raise FastqError(f"FASTQ span has {starts.size} lines (not 4n)")
+    n = starts.size // 4
+    if n == 0:
+        return (np.zeros((0, seq_stride), np.uint8),
+                np.zeros((0, qual_stride), np.uint8),
+                np.zeros((0,), np.int32))
+    s4 = starts.reshape(n, 4)
+    e4 = ends.reshape(n, 4)
+    if not (buf[s4[:, 0]] == ord("@")).all() \
+            or not (buf[s4[:, 2]] == ord("+")).all():
+        bad = int(np.flatnonzero((buf[s4[:, 0]] != ord("@"))
+                                 | (buf[s4[:, 2]] != ord("+")))[0])
+        raise FastqError(f"malformed FASTQ record at line {bad * 4}")
+    seq_len = e4[:, 1] - s4[:, 1]
+    if not (seq_len == e4[:, 3] - s4[:, 3]).all():
+        raise FastqError("SEQ/QUAL length mismatch")
+    lengths = np.minimum(seq_len, max_len).astype(np.int32)
+
+    L = int(lengths.max()) if n else 0
+    L_even = L + (L & 1)
+    col = np.arange(L_even, dtype=np.int64)[None, :]
+    mask = col < lengths[:, None]
+    gather = np.minimum(s4[:, 1:2] + col, buf.size - 1)
+    codes = np.where(mask, _NIBBLE_CODE[buf[gather]], 0).astype(np.uint8)
+    packed = (codes[:, 0::2] << 4) | codes[:, 1::2]
+    seq = np.zeros((n, seq_stride), dtype=np.uint8)
+    ks = min(packed.shape[1], seq_stride)
+    seq[:, :ks] = packed[:, :ks]
+
+    gq = np.minimum(s4[:, 3:4] + col[:, :L], buf.size - 1)
+    q = np.where(mask[:, :L], buf[gq].astype(np.int16) - qual_offset, 0)
+    if qual_offset != 33 and q.size:
+        # mirror convert_quality's wrong-encoding guard: re-based ASCII
+        # must stay printable, i.e. Phred in [0, 93]
+        if int(q.min()) < 0 or int(q.max()) > 93:
+            raise FastqError("quality out of range after re-encoding — "
+                             "wrong base-quality-encoding config?")
+    qual = np.zeros((n, qual_stride), dtype=np.uint8)
+    kq = min(L, qual_stride)
+    qual[:, :kq] = np.clip(q, 0, 255).astype(np.uint8)[:, :kq]
+    return seq, qual, lengths
 
 
 def fragments_to_payload_tiles(frags: List[SequencedFragment],

@@ -936,7 +936,8 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     the text-format twin of seq_stats_file, through the same fused Pallas
     payload kernel."""
     from hadoop_bam_tpu.api.read_datasets import (
-        fragments_to_payload_tiles, open_fastq, open_qseq,
+        fastq_text_to_payload_tiles, fragments_to_payload_tiles,
+        open_fastq, open_qseq,
     )
     from hadoop_bam_tpu.parallel.mesh import make_mesh
 
@@ -947,8 +948,12 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         geometry = PayloadGeometry()
     cap = geometry.tile_records
     lower = path.lower()
-    ds = open_qseq(path, config) if lower.endswith(QSEQ_EXTS) \
-        else open_fastq(path, config)
+    is_qseq = lower.endswith(QSEQ_EXTS)
+    ds = open_qseq(path, config) if is_qseq else open_fastq(path, config)
+    # Vectorized tokenize (no per-read Python objects) whenever the config
+    # doesn't force the object path: failed-QC filtering needs parsed names.
+    fast_tiles = not is_qseq and not config.fastq_filter_failed_qc
+    qual_offset = config.fastq_base_quality_encoding.value
     spans = ds.spans()
     step = make_read_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
@@ -958,6 +963,10 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         def decode(span):
             def inner(s):
+                if fast_tiles:
+                    return fastq_text_to_payload_tiles(
+                        ds.read_span_text(s), geometry.seq_stride,
+                        geometry.qual_stride, geometry.max_len, qual_offset)
                 frags = ds.read_span(s)
                 return fragments_to_payload_tiles(
                     frags, geometry.seq_stride, geometry.qual_stride,

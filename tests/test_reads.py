@@ -266,3 +266,91 @@ def test_bare_fasta_header_raises():
     import pytest as _pytest
     with _pytest.raises(FastaError):
         parse_fasta(b">\nACGT\n")
+
+
+# ---------------------------------------------------------------------------
+# Vectorized FASTQ tokenize (the stats drivers' fast path)
+# ---------------------------------------------------------------------------
+
+def _tiles_via_objects(text, seq_stride, qual_stride, max_len, enc):
+    from hadoop_bam_tpu.api.read_datasets import fragments_to_payload_tiles
+    frags = parse_fastq(text, encoding=enc)
+    return fragments_to_payload_tiles(frags, seq_stride, qual_stride,
+                                      max_len)
+
+
+@pytest.mark.parametrize("crlf", [False, True])
+@pytest.mark.parametrize("trailing_newline", [False, True])
+def test_fastq_vectorized_tiles_parity(crlf, trailing_newline):
+    """fastq_text_to_payload_tiles must match the per-object path exactly:
+    mixed lengths, lowercase, N/ambiguity codes, reads longer than max_len."""
+    from hadoop_bam_tpu.api.read_datasets import fastq_text_to_payload_tiles
+    rng = random.Random(3)
+    reads = []
+    for i in range(137):
+        n = rng.choice([1, 2, 37, 40, 160, 161, 300])
+        seq = "".join(rng.choice("ACGTNacgtnRYKM") for _ in range(n))
+        qual = "".join(chr(33 + rng.randint(0, 41)) for _ in range(n))
+        reads.append(f"@r{i} extra meta\n{seq}\n+\n{qual}")
+    sep = "\r\n" if crlf else "\n"
+    text = sep.join(r.replace("\n", sep) for r in reads)
+    if trailing_newline:
+        text += sep
+    text = text.encode()
+    enc = BaseQualityEncoding.SANGER
+    for seq_stride, qual_stride, max_len in ((80, 160, 160), (16, 32, 32)):
+        want = _tiles_via_objects(text, seq_stride, qual_stride, max_len,
+                                  enc)
+        got = fastq_text_to_payload_tiles(text, seq_stride, qual_stride,
+                                          max_len)
+        for w, g in zip(want, got):
+            assert w.dtype == g.dtype and w.shape == g.shape
+            assert (w == g).all()
+
+
+def test_fastq_vectorized_tiles_illumina_offset():
+    from hadoop_bam_tpu.api.read_datasets import fastq_text_to_payload_tiles
+    text = b"@a\nACGT\n+\nhhhi\n"   # 'h' = Phred 40 at +64
+    _, qual, lens = fastq_text_to_payload_tiles(text, 8, 8, 8,
+                                                qual_offset=64)
+    assert lens.tolist() == [4]
+    assert qual[0, :4].tolist() == [40, 40, 40, 41]
+
+
+def test_fastq_vectorized_tiles_malformed():
+    from hadoop_bam_tpu.api.read_datasets import fastq_text_to_payload_tiles
+    from hadoop_bam_tpu.formats.fastq import FastqError
+    with pytest.raises(FastqError):
+        fastq_text_to_payload_tiles(b"@a\nACGT\n+\n", 8, 8, 8)  # 3 lines
+    with pytest.raises(FastqError):
+        fastq_text_to_payload_tiles(b"@a\nACGT\n+\nII\n", 8, 8, 8)  # len
+    with pytest.raises(FastqError):
+        fastq_text_to_payload_tiles(b"a\nACGT\n+\nIIII\n", 8, 8, 8)  # no @
+    empty = fastq_text_to_payload_tiles(b"", 8, 8, 8)
+    assert all(a.size == 0 for a in empty)
+
+
+def test_fastq_vectorized_tiles_zero_length_read():
+    """A legal zero-length final read must parse in both paths; a stray
+    trailing blank line must raise in both paths."""
+    from hadoop_bam_tpu.api.read_datasets import fastq_text_to_payload_tiles
+    from hadoop_bam_tpu.formats.fastq import FastqError
+    ok = b"@r0\nACGT\n+\nIIII\n@r1\n\n+\n\n"
+    assert len(parse_fastq(ok)) == 2
+    _, _, lens = fastq_text_to_payload_tiles(ok, 8, 8, 8)
+    assert lens.tolist() == [4, 0]
+    bad = b"@r0\nACGT\n+\nIIII\n\n"
+    with pytest.raises(FastqError):
+        parse_fastq(bad)
+    with pytest.raises(FastqError):
+        fastq_text_to_payload_tiles(bad, 8, 8, 8)
+
+
+def test_fastq_vectorized_tiles_wrong_encoding_guard():
+    """Sanger-encoded qualities under an Illumina-64 config must raise, as
+    convert_quality does on the object path."""
+    from hadoop_bam_tpu.api.read_datasets import fastq_text_to_payload_tiles
+    from hadoop_bam_tpu.formats.fastq import FastqError
+    text = b"@a\nACGT\n+\n!!!!\n"   # '!' = 33, below the +64 offset
+    with pytest.raises(FastqError):
+        fastq_text_to_payload_tiles(text, 8, 8, 8, qual_offset=64)
