@@ -269,7 +269,13 @@ def bench_cram(path: str):
     meas, base = n / dt, bn / bdt
     return {"metric": "cram_tensor_records_per_sec",
             "value": round(meas, 1), "unit": "records/s",
-            "vs_baseline": round(meas / base, 3)}
+            "vs_baseline": round(meas / base, 3),
+            # tensor_batches currently WRAPS the record iterator (decode ->
+            # objects -> tiles), so this ratio is structurally <= 1: it
+            # tracks tensor-path overhead, not a speedup.  It becomes a
+            # real speedup metric when a columnar CRAM tile path lands.
+            "note": "ratio = tensor path / record iterator (overhead "
+                    "metric; tensor path is a superset of the baseline)"}
 
 
 # ---------------------------------------------------------------------------
@@ -363,10 +369,16 @@ def bench_split_guess(path: str):
     spans, dt = _median_time(run, reps=3)
     boundaries = max(len(spans) - 1, 1)  # first boundary is free (header)
     ms = dt / boundaries * 1e3
-    return {"metric": "split_guess_p50_ms_per_boundary",
-            "value": round(ms, 3), "unit": "ms",
-            # latency metric: >1 means faster than the pinned r2 baseline
-            "vs_baseline": round(SPLIT_GUESS_BASELINE_MS / ms, 3)}
+    out = {"metric": "split_guess_p50_ms_per_boundary",
+           "value": round(ms, 3), "unit": "ms"}
+    if BENCH_RECORDS == 300000:
+        # latency metric: >1 means faster than the pinned r2 baseline
+        out["vs_baseline"] = round(SPLIT_GUESS_BASELINE_MS / ms, 3)
+    else:
+        # a smoke-size fixture makes the pinned baseline meaningless
+        out["note"] = (f"no vs_baseline: fixture is {BENCH_RECORDS} "
+                       f"records, baseline pinned at 300000")
+    return out
 
 
 def bench_deflate_tokenize(path: str):

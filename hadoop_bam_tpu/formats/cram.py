@@ -34,9 +34,9 @@ CRAM_MINOR = 0
 RAW, GZIP, BZIP2, LZMA, RANS4x8 = 0, 1, 2, 3, 4
 RANSNx16, ARITH, FQZCOMP, NAME_TOK = 5, 6, 7, 8
 
+# 3.1 methods still unimplemented (tok3 is supported; see cram_name_tok3)
 _METHOD_31_NAMES = {ARITH: "adaptive arithmetic coder",
-                    FQZCOMP: "fqzcomp quality codec",
-                    NAME_TOK: "name tokenizer (tok3)"}
+                    FQZCOMP: "fqzcomp quality codec"}
 
 # Block content types [SPEC section 8.1]
 FILE_HEADER = 0
@@ -214,6 +214,19 @@ class Block:
                 NX16_PACK, NX16_RLE, rans_nx16_encode,
             )
             comp = rans_nx16_encode(raw, NX16_PACK | NX16_RLE)
+        elif method == NAME_TOK:
+            from hadoop_bam_tpu.formats.cram_name_tok3 import (
+                Tok3Error, tok3_encode,
+            )
+            try:
+                comp = tok3_encode(raw)
+            except Tok3Error:
+                # payload isn't a clean name block; general codec instead
+                from hadoop_bam_tpu.formats.cram_codecs_nx16 import (
+                    NX16_PACK, NX16_RLE, rans_nx16_encode,
+                )
+                method = RANSNx16
+                comp = rans_nx16_encode(raw, NX16_PACK | NX16_RLE)
         elif method == RAW:
             comp = raw
         else:
@@ -292,6 +305,9 @@ def decompress_block_payload(method: int, payload: bytes, rsize: int) -> bytes:
     if method == RANSNx16:
         from hadoop_bam_tpu.formats.cram_codecs_nx16 import rans_nx16_decode
         return rans_nx16_decode(payload, rsize)
+    if method == NAME_TOK:
+        from hadoop_bam_tpu.formats.cram_name_tok3 import tok3_decode
+        return tok3_decode(payload, rsize)
     if method in _METHOD_31_NAMES:
         raise CRAMError(
             f"CRAM 3.1 block method {method} "

@@ -292,10 +292,12 @@ def _tag_cids(key: int) -> Tuple[int, int]:
 
 
 def _external_payloads(s: _Streams, version: Tuple[int, int] = (3, 0)):
-    from hadoop_bam_tpu.formats.cram import RANSNx16
+    from hadoop_bam_tpu.formats.cram import NAME_TOK, RANSNx16
     # qualities through rANS like htslib's default; rest gzip.  3.1
-    # upgrades the rANS series to Nx16 (+PACK/RLE) [SPEC CRAM 3.1]
+    # upgrades the rANS series to Nx16 (+PACK/RLE) and tokenizes read
+    # names (tok3), matching htslib's 3.1 defaults [SPEC CRAM 3.1]
     rans = RANSNx16 if version >= (3, 1) else RANS4x8
+    names_method = NAME_TOK if version >= (3, 1) else GZIP
     for k, data in s.ints.items():
         yield _CID_INT[k], data, GZIP
     for k, data in s.bytes_.items():
@@ -304,7 +306,7 @@ def _external_payloads(s: _Streams, version: Tuple[int, int] = (3, 0)):
     for k in _ARRAY_SERIES:
         yield _CID_ALEN[k], s.arr_len[k], GZIP
         yield _CID_AVAL[k], s.arr_val[k], GZIP
-    yield _CID_NAMES, s.names, GZIP
+    yield _CID_NAMES, s.names, names_method
     for key in s.tag_len:
         lo, hi = _tag_cids(key)
         yield lo, s.tag_len[key], GZIP

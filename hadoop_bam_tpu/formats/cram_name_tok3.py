@@ -1,0 +1,381 @@
+"""CRAM 3.1 name tokenizer codec ("tok3", block compression method 8).
+
+[SPEC] CRAMcodecs "Name tokenisation": read names are highly structured
+(instrument:run:flowcell:lane:tile:x:y), so the codec splits each name
+into typed tokens (alpha runs, digit runs with/without leading zeros,
+single chars), expresses each name as a reference to a previous name
+(whole-name duplicate, or a token-by-token diff), and entropy-codes each
+<token position, token type> stream independently with rANS Nx16
+(cram_codecs_nx16.py) — small deltas in the hot fields collapse to
+near-zero entropy.
+
+Serialized layout::
+
+    uint32 LE  ulen        total uncompressed bytes (names + separators)
+    uint32 LE  nnames
+    byte       flags       bit0 = arithmetic coder (unsupported here),
+                           bit1 = names are '\\n'-separated (else '\\0')
+    repeated stream frames:
+        byte   descriptor  low 4 bits token type; 0x80 = first stream of
+                           the next token position; 0x40 reserved
+                           (htscodecs' duplicate-stream flag — rejected
+                           loudly, never produced)
+        uint7  clen        compressed length
+        bytes  rANS Nx16 stream (carries its own uncompressed size)
+
+Token types (values follow the public htscodecs enum)::
+
+    TYPE 0   per-position type selector stream
+    ALPHA 1  non-digit run, '\\0'-terminated in its data stream
+    CHAR 2   single byte
+    DZLEN 3  zero-padded digit-run length byte (companion of DIGITS0)
+    DIGITS0 4  digit run with leading zeros: uint32 LE value + DZLEN
+    DUP 5    whole name identical to the name <dist> back (uint32 LE)
+    DIFF 6   name diffs against the name <dist> back (uint32 LE; 0 for
+             the first name = no reference, every token fresh)
+    DIGITS 7 digit run, no leading zeros, value < 2^32 (uint32 LE)
+    DDELTA 11  digits delta to the reference token, one byte in [0,255]
+    DDELTA0 12 zero-padded variant (same pad width as the reference)
+    MATCH 13 token identical to the reference token
+    NOP 14   nothing (accepted on decode, never produced)
+    END 15   end of this name's token list
+
+Provenance: the token model, type values, and 9-byte header follow the
+public htscodecs layout; the stream-frame descriptor bits and the
+separator flag (bit1) are [SPEC-recalled]/[LAYOUT-CHOICE] reconstructions
+pinned by round-trip + frozen-golden tests (tests/test_cram_tok3.py) —
+no htslib exists in this image to cross-validate (SURVEY.md section 0).
+Reference-side equivalent: htscodecs tokenise_name3 reached through CRAM
+3.1 RN-series decode (SURVEY.md section 2.8 CRAM codecs row).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_bam_tpu.formats.cram_codecs import (
+    RansError, normalize_truncation,
+)
+from hadoop_bam_tpu.formats.cram_codecs_nx16 import (
+    NX16_ORDER1, rans_nx16_decode, rans_nx16_encode, var_get_u32,
+    var_put_u32,
+)
+
+T_TYPE = 0
+T_ALPHA = 1
+T_CHAR = 2
+T_DZLEN = 3
+T_DIGITS0 = 4
+T_DUP = 5
+T_DIFF = 6
+T_DIGITS = 7
+T_DDELTA = 11
+T_DDELTA0 = 12
+T_MATCH = 13
+T_NOP = 14
+T_END = 15
+
+MAX_TOKENS = 128               # token positions per name (spec bound)
+
+F_ARITH = 0x01
+F_NEWLINE_SEP = 0x02           # [LAYOUT-CHOICE] see module docstring
+
+_D_NEW_POS = 0x80
+_D_DUP_STREAM = 0x40
+
+
+class Tok3Error(RansError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# tokenization
+# ---------------------------------------------------------------------------
+
+def _tokenize(name: bytes) -> List[Tuple[int, bytes]]:
+    """Split a name into (type, text) tokens: digit runs become DIGITS /
+    DIGITS0, everything else ALPHA (multi-byte) or CHAR (single byte).
+    Digit runs too long for uint32 degrade to ALPHA."""
+    toks: List[Tuple[int, bytes]] = []
+    i, n = 0, len(name)
+    while i < n:
+        c = name[i]
+        if 0x30 <= c <= 0x39:                      # digit run
+            j = i + 1
+            while j < n and 0x30 <= name[j] <= 0x39:
+                j += 1
+            run = name[i:j]
+            if len(run) > 9 or int(run) > 0xFFFFFFFF:
+                toks.append((T_ALPHA, run))
+            elif run[0] == 0x30 and len(run) > 1:
+                toks.append((T_DIGITS0, run))
+            else:
+                toks.append((T_DIGITS, run))
+            i = j
+        else:                                      # non-digit run
+            j = i + 1
+            while j < n and not (0x30 <= name[j] <= 0x39):
+                j += 1
+            run = name[i:j]
+            toks.append((T_CHAR, run) if len(run) == 1
+                        else (T_ALPHA, run))
+            i = j
+    if len(toks) >= MAX_TOKENS:                    # overflow tail -> ALPHA
+        head, tail = toks[:MAX_TOKENS - 1], toks[MAX_TOKENS - 1:]
+        head.append((T_ALPHA, b"".join(t for _, t in tail)))
+        toks = head
+    return toks
+
+
+class _Streams:
+    """B[token position][token type] byte streams under construction."""
+
+    def __init__(self):
+        self.b: Dict[Tuple[int, int], bytearray] = {}
+        self.max_pos = 0
+
+    def put(self, pos: int, ttype: int, data: bytes):
+        self.b.setdefault((pos, ttype), bytearray()).extend(data)
+        self.max_pos = max(self.max_pos, pos)
+
+
+def _encode_token(s: _Streams, pos: int, tok: Tuple[int, bytes],
+                  ref: Optional[Tuple[int, bytes]]) -> None:
+    ttype, text = tok
+    if ref is not None and ref[1] == text:
+        s.put(pos, T_TYPE, bytes([T_MATCH]))
+        return
+    if ttype == T_DIGITS and ref is not None and ref[0] == T_DIGITS:
+        delta = int(text) - int(ref[1])
+        if 0 <= delta <= 255:
+            s.put(pos, T_TYPE, bytes([T_DDELTA]))
+            s.put(pos, T_DDELTA, bytes([delta]))
+            return
+    if ttype == T_DIGITS0 and ref is not None and ref[0] == T_DIGITS0 \
+            and len(ref[1]) == len(text):
+        delta = int(text) - int(ref[1])
+        if 0 <= delta <= 255:
+            s.put(pos, T_TYPE, bytes([T_DDELTA0]))
+            s.put(pos, T_DDELTA0, bytes([delta]))
+            return
+    s.put(pos, T_TYPE, bytes([ttype]))
+    if ttype == T_ALPHA:
+        s.put(pos, T_ALPHA, text + b"\0")
+    elif ttype == T_CHAR:
+        s.put(pos, T_CHAR, text)
+    elif ttype == T_DIGITS:
+        s.put(pos, T_DIGITS, struct.pack("<I", int(text)))
+    elif ttype == T_DIGITS0:
+        s.put(pos, T_DIGITS0, struct.pack("<I", int(text)))
+        s.put(pos, T_DZLEN, bytes([len(text)]))
+    else:                                          # pragma: no cover
+        raise Tok3Error(f"internal: unexpected token type {ttype}")
+
+
+def _compress_stream(data: bytes) -> bytes:
+    """Smallest of order-0 / order-1 Nx16 (both auto-fall back to CAT for
+    tiny inputs)."""
+    enc = rans_nx16_encode(data, 0)
+    if len(data) >= 64:
+        enc1 = rans_nx16_encode(data, NX16_ORDER1)
+        if len(enc1) < len(enc):
+            enc = enc1
+    return enc
+
+
+def tok3_encode(payload: bytes) -> bytes:
+    """Compress a '\\0'- or '\\n'-separated name block.
+
+    The payload must be a sequence of names each followed by the
+    separator (the exact shape of a CRAM RN external block, see
+    cram_encode.py::_RN_STOP) — anything else raises Tok3Error and the
+    block writer falls back to a general codec."""
+    if not payload:
+        raise Tok3Error("empty name block")
+    sep = payload[-1]
+    if sep not in (0x00, 0x0A):
+        raise Tok3Error("name block does not end with a separator")
+    names = payload.split(bytes([sep]))
+    if names[-1] != b"":
+        raise Tok3Error("trailing bytes after the last separator")
+    names = names[:-1]
+    if any(len(n) == 0 for n in names):
+        raise Tok3Error("empty name in block")
+    if sep != 0x00 and any(b"\0" in n for n in names):
+        # ALPHA data streams are NUL-terminated; a NUL inside a name
+        # cannot be represented — let the caller fall back
+        raise Tok3Error("name contains a NUL byte")
+
+    s = _Streams()
+    prev_tokens: List[List[Tuple[int, bytes]]] = []
+    last_seen: Dict[bytes, int] = {}
+    for i, name in enumerate(names):
+        dup = last_seen.get(name)
+        if dup is not None:
+            s.put(0, T_TYPE, bytes([T_DUP]))
+            s.put(0, T_DUP, struct.pack("<I", i - dup))
+            prev_tokens.append(prev_tokens[dup])
+        else:
+            dist = 1 if i > 0 else 0
+            s.put(0, T_TYPE, bytes([T_DIFF]))
+            s.put(0, T_DIFF, struct.pack("<I", dist))
+            toks = _tokenize(name)
+            ref = prev_tokens[i - dist] if dist else []
+            for pos, tok in enumerate(toks, start=1):
+                rtok = ref[pos - 1] if pos - 1 < len(ref) else None
+                _encode_token(s, pos, tok, rtok)
+            s.put(len(toks) + 1, T_TYPE, bytes([T_END]))
+            prev_tokens.append(toks)
+        last_seen[name] = i
+
+    flags = F_NEWLINE_SEP if sep == 0x0A else 0
+    out = bytearray(struct.pack("<II", len(payload), len(names)))
+    out.append(flags)
+    for pos in range(s.max_pos + 1):
+        first = True
+        for ttype in range(16):
+            stream = s.b.get((pos, ttype))
+            if stream is None:
+                continue
+            out.append(ttype | (_D_NEW_POS if first and pos > 0 else 0))
+            first = False
+            comp = _compress_stream(bytes(stream))
+            out += var_put_u32(len(comp))
+            out += comp
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+class _Cursor:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise Tok3Error("token stream exhausted")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def take_cstr(self) -> bytes:
+        end = self.data.find(b"\0", self.pos)
+        if end < 0:
+            raise Tok3Error("unterminated ALPHA token")
+        out = self.data[self.pos:end]
+        self.pos = end + 1
+        return out
+
+
+def tok3_decode(payload: bytes, rsize: Optional[int] = None) -> bytes:
+    """Decompress a tok3 name block back to its exact original bytes."""
+    with normalize_truncation("tok3"):
+        return _tok3_decode(payload, rsize)
+
+
+def _tok3_decode(payload: bytes, rsize: Optional[int]) -> bytes:
+    if len(payload) < 9:
+        raise Tok3Error("tok3 payload shorter than its 9-byte header")
+    ulen, nnames = struct.unpack_from("<II", payload, 0)
+    flags = payload[8]
+    if flags & F_ARITH:
+        raise Tok3Error(
+            "tok3 stream uses the adaptive arithmetic coder, which is "
+            "not supported — re-encode with rANS (use_arith=0)")
+    sep = b"\n" if flags & F_NEWLINE_SEP else b"\0"
+    if rsize is not None and rsize != ulen:
+        raise Tok3Error(f"tok3 header says {ulen} bytes, "
+                        f"block header says {rsize}")
+
+    streams: Dict[Tuple[int, int], _Cursor] = {}
+    i, pos = 9, 0
+    while i < len(payload):
+        desc = payload[i]
+        i += 1
+        if desc & _D_DUP_STREAM:
+            raise Tok3Error(
+                "tok3 duplicate-stream frames are not supported (never "
+                "produced by this encoder; layout unverified)")
+        if desc & _D_NEW_POS:
+            pos += 1
+        ttype = desc & 0x0F
+        clen, i = var_get_u32(payload, i)
+        if i + clen > len(payload):
+            raise Tok3Error("truncated tok3 stream frame")
+        streams[(pos, ttype)] = _Cursor(
+            rans_nx16_decode(payload[i:i + clen]))
+        i += clen
+
+    def cur(p: int, t: int) -> _Cursor:
+        c = streams.get((p, t))
+        if c is None:
+            raise Tok3Error(f"missing tok3 stream (pos {p}, type {t})")
+        return c
+
+    names: List[bytes] = []
+    out = bytearray()
+    for _ in range(nnames):
+        sel = cur(0, T_TYPE).take(1)[0]
+        if sel == T_DUP:
+            (dist,) = struct.unpack("<I", cur(0, T_DUP).take(4))
+            if not 0 < dist <= len(names):
+                raise Tok3Error(f"DUP distance {dist} out of range")
+            name = names[len(names) - dist]
+        elif sel == T_DIFF:
+            (dist,) = struct.unpack("<I", cur(0, T_DIFF).take(4))
+            if dist > len(names):
+                raise Tok3Error(f"DIFF distance {dist} out of range")
+            ref = (_tokenize(names[len(names) - dist]) if dist else [])
+            parts: List[bytes] = []
+            p = 1
+            while True:
+                t = cur(p, T_TYPE).take(1)[0]
+                if t == T_END:
+                    break
+                if t == T_NOP:
+                    p += 1
+                    continue
+                rtok = ref[p - 1] if p - 1 < len(ref) else None
+                if t == T_MATCH:
+                    if rtok is None:
+                        raise Tok3Error("MATCH token without a reference")
+                    parts.append(rtok[1])
+                elif t == T_ALPHA:
+                    parts.append(cur(p, T_ALPHA).take_cstr())
+                elif t == T_CHAR:
+                    parts.append(cur(p, T_CHAR).take(1))
+                elif t == T_DIGITS:
+                    (v,) = struct.unpack("<I", cur(p, T_DIGITS).take(4))
+                    parts.append(b"%d" % v)
+                elif t == T_DIGITS0:
+                    (v,) = struct.unpack("<I", cur(p, T_DIGITS0).take(4))
+                    w = cur(p, T_DZLEN).take(1)[0]
+                    parts.append(b"%0*d" % (w, v))
+                elif t == T_DDELTA:
+                    if rtok is None or rtok[0] != T_DIGITS:
+                        raise Tok3Error("DDELTA without a DIGITS reference")
+                    d = cur(p, T_DDELTA).take(1)[0]
+                    parts.append(b"%d" % (int(rtok[1]) + d))
+                elif t == T_DDELTA0:
+                    if rtok is None or rtok[0] != T_DIGITS0:
+                        raise Tok3Error(
+                            "DDELTA0 without a DIGITS0 reference")
+                    d = cur(p, T_DDELTA0).take(1)[0]
+                    parts.append(b"%0*d" % (len(rtok[1]),
+                                            int(rtok[1]) + d))
+                else:
+                    raise Tok3Error(f"unknown tok3 token type {t}")
+                p += 1
+            name = b"".join(parts)
+        else:
+            raise Tok3Error(f"name selector {sel} is neither DUP nor DIFF")
+        names.append(name)
+        out += name + sep
+    if len(out) != ulen:
+        raise Tok3Error(f"tok3 decoded {len(out)} bytes, header says {ulen}")
+    return bytes(out)

@@ -1,0 +1,204 @@
+"""CRAM 3.1 name tokenizer (tok3, block method 8) tests.
+
+Round-trips over adversarial name shapes, frozen golden bytes pinning the
+wire layout (the reference mount is empty — SURVEY.md section 0 — so the
+encoder's own output is the only available oracle and drift must at least
+be loud), container-level 3.1 write/read with the RN block really using
+method 8, and corrupt-stream handling.
+"""
+import random
+
+import pytest
+
+from hadoop_bam_tpu.formats.cram_name_tok3 import (
+    Tok3Error, tok3_decode, tok3_encode,
+)
+
+from fixtures import make_header, make_records
+
+
+def _roundtrip(names, sep=b"\0"):
+    payload = sep.join(names) + sep
+    enc = tok3_encode(payload)
+    assert tok3_decode(enc) == payload
+    return enc
+
+
+def test_tok3_illumina_names():
+    rng = random.Random(0)
+    names, x, y = [], 1000, 2000
+    for i in range(3000):
+        x += rng.randint(0, 30)
+        y += rng.randint(0, 30)
+        names.append(f"EAS139:136:FC706VJ:2:{2104 + i // 500}:{x}:{y}"
+                     .encode())
+    enc = _roundtrip(names)
+    # the whole point: structured names compress far better than gzip
+    import gzip
+    payload = b"\0".join(names) + b"\0"
+    assert len(enc) < len(gzip.compress(payload)) / 2
+
+
+@pytest.mark.parametrize("sep", [b"\0", b"\n"])
+def test_tok3_adversarial_shapes(sep):
+    other = b"\n" if sep == b"\0" else b"\0"
+    names = [
+        b"a",                                   # single char
+        b"read_0001", b"read_0002", b"read_0002",   # leading zeros + dup
+        b"0",                                   # lone zero digit
+        b"00",                                  # zero with leading zero
+        b"99999999999999999999",                # digit run > 2^32 -> ALPHA
+        b"4294967295",                          # exactly u32 max
+        b"4294967296",                          # u32 max + 1 -> ALPHA
+        b"x" * 300,                             # long alpha run
+        b":".join(b"%d" % i for i in range(200)),   # > MAX_TOKENS tokens
+        b"mixed123text456",
+        b"[]{}~!@#$%^&*()",                     # punctuation alpha
+    ]
+    if other == b"\n":
+        names.append(other + b"name")          # '\n' inside a NUL-sep name
+    # duplicate whole set (exercises DUP at distance > 1)
+    _roundtrip(names + names, sep)
+
+
+def test_tok3_nul_inside_newline_separated_name_rejected():
+    """A NUL inside a '\\n'-separated name cannot ride the NUL-terminated
+    ALPHA streams; the encoder must refuse (callers fall back) rather
+    than corrupt."""
+    with pytest.raises(Tok3Error, match="NUL"):
+        tok3_encode(b"a\0b\nnext\n")
+
+
+def test_tok3_delta_paths():
+    # consecutive digit fields differing by small deltas hit DDELTA;
+    # zero-padded ones hit DDELTA0 (including width carries)
+    names = [b"r:0001:5", b"r:0002:5", b"r:0009:260", b"r:0010:261",
+             b"r:0099:300", b"r:0100:300", b"r:0999:1", b"r:1000:1"]
+    _roundtrip(names)
+
+
+def test_tok3_single_and_identical():
+    _roundtrip([b"only"])
+    _roundtrip([b"same"] * 100)
+
+
+def test_tok3_rejects_unsuitable_payloads():
+    for bad in (b"", b"no-separator", b"a\0b"):    # b"a\0b": trailing bytes
+        with pytest.raises(Tok3Error):
+            tok3_encode(bad)
+    with pytest.raises(Tok3Error):
+        tok3_encode(b"a\0\0")                      # empty name
+
+
+def test_tok3_corrupt_streams_fail_loudly():
+    names = [b"EAS1:2:3", b"EAS1:2:4", b"EAS1:2:5"] * 20
+    payload = b"\0".join(names) + b"\0"
+    enc = bytearray(tok3_encode(payload))
+    # arithmetic-coder flag: clear unsupported error
+    bad = bytearray(enc)
+    bad[8] |= 0x01
+    with pytest.raises(Tok3Error, match="arithmetic"):
+        tok3_decode(bytes(bad))
+    # duplicate-stream descriptor: loud rejection, not speculative decode
+    bad = bytearray(enc)
+    bad[9] |= 0x40
+    with pytest.raises(Tok3Error, match="duplicate-stream"):
+        tok3_decode(bytes(bad))
+    # truncation at every prefix must raise, never return garbage
+    from hadoop_bam_tpu.formats.cram_codecs import RansError
+    for cut in range(0, len(enc), 7):
+        with pytest.raises((Tok3Error, RansError)):
+            tok3_decode(bytes(enc[:cut]))
+    # single-byte corruptions: either a loud error or (rarely) a decode,
+    # but NEVER a silent wrong-length result
+    rng = random.Random(4)
+    for _ in range(40):
+        bad = bytearray(enc)
+        i = rng.randrange(9, len(bad))
+        bad[i] ^= 1 << rng.randrange(8)
+        try:
+            out = tok3_decode(bytes(bad))
+            assert len(out) == len(payload)
+        except (Tok3Error, RansError):
+            pass
+
+
+def test_tok3_header_size_crosscheck():
+    enc = tok3_encode(b"abc\0")
+    with pytest.raises(Tok3Error, match="block header"):
+        tok3_decode(enc, rsize=5)
+    assert tok3_decode(enc, rsize=4) == b"abc\0"
+
+
+# ---------------------------------------------------------------------------
+# Frozen golden bytes: pin the wire layout against drift.  If an
+# intentional layout change breaks these, re-freeze AND note the break in
+# PARITY.md — any 3.1 file written before the change becomes unreadable.
+# ---------------------------------------------------------------------------
+
+GOLDEN_NAMES = [b"EAS139:136:FC706VJ:2:2104:15343:197393",
+                b"EAS139:136:FC706VJ:2:2104:15370:197401",
+                b"EAS139:136:FC706VJ:2:2104:15370:197401",
+                b"read_007", b"read_008"]
+GOLDEN_SHA256 = \
+    "5ec855f46facc1fedf4d28dc063d5bc0ca93ddc017fc331ceb3fe1563559661a"
+# Header region frozen byte-for-byte too (ulen=0x87, nnames=5, flags=0,
+# first frame = slot-0 TYPE stream): cheap to eyeball in a hexdump.
+GOLDEN_PREFIX_HEX = "870000000500000000"
+
+
+def test_tok3_golden_bytes():
+    enc = tok3_encode(b"\0".join(GOLDEN_NAMES) + b"\0")
+    import hashlib
+    assert enc.hex().startswith(GOLDEN_PREFIX_HEX)
+    digest = hashlib.sha256(enc).hexdigest()
+    assert digest == GOLDEN_SHA256, (
+        f"tok3 wire layout drifted: sha256 {digest}; if intentional, "
+        f"re-freeze and document in PARITY.md")
+    assert tok3_decode(enc) == b"\0".join(GOLDEN_NAMES) + b"\0"
+
+
+# ---------------------------------------------------------------------------
+# Container level: a 3.1 CRAM really tokenizes its RN series
+# ---------------------------------------------------------------------------
+
+def _block_methods(path):
+    from hadoop_bam_tpu.formats.cram import (
+        ContainerHeader, FileDefinition, parse_raw_block,
+    )
+    buf = open(path, "rb").read()
+    pos = FileDefinition.SIZE
+    methods = []
+    while pos < len(buf):
+        hdr, pos = ContainerHeader.from_buffer(buf, pos)
+        end = pos + hdr.length
+        while pos < end:
+            raw, pos = parse_raw_block(buf, pos)
+            methods.append(raw.method)
+    return methods
+
+
+def test_cram31_names_use_tok3(tmp_path):
+    from hadoop_bam_tpu.formats.cram import NAME_TOK
+    from hadoop_bam_tpu.formats.cramio import CramWriter, read_cram
+
+    header = make_header()
+    recs = make_records(header, 300, seed=17)
+    path = str(tmp_path / "tok3.cram")
+    with CramWriter(path, header, records_per_container=60,
+                    version=(3, 1)) as w:
+        w.write_records(recs)
+    assert NAME_TOK in _block_methods(path)
+    _, out = read_cram(path)
+    assert [r.to_line() for r in out] == [r.to_line() for r in recs]
+
+
+def test_cram30_has_no_tok3_blocks(tmp_path):
+    from hadoop_bam_tpu.formats.cram import NAME_TOK
+    from hadoop_bam_tpu.formats.cramio import write_cram
+
+    header = make_header()
+    recs = make_records(header, 100, seed=18)
+    path = str(tmp_path / "v30.cram")
+    write_cram(path, header, recs)
+    assert NAME_TOK not in _block_methods(path)
