@@ -1,0 +1,241 @@
+"""Mesh bucketed sort — the MapReduce-shuffle analog on a device mesh.
+
+The reference's CLI ``sort`` keyed records into the MR shuffle and let
+Hadoop's distributed external merge do the work (SURVEY.md section 2.9
+shuffle row).  This module is that shuffle as XLA collectives:
+
+1. span planning assigns each device a record-balanced slice of the file
+   (split/planners.py::plan_bam_spans_balanced);
+2. each device extracts sort keys from its records ON DEVICE
+   (ops/unpack_bam.py::unpack_fixed_fields over the shard's span tile);
+3. keys are range-partitioned into per-device buckets (boundaries from a
+   host-side key sample — the planner's job, like split guessing) and
+   exchanged with ``lax.all_to_all`` over the data axis;
+4. each device sorts its bucket with a multi-key ``lax.sort`` over
+   (key_hi, key_lo, global input index) — the index key makes ties
+   deterministic, reproducing a stable sort exactly;
+5. hosts apply the resulting permutation to the record bytes and write
+   bucket 0..n-1 sequentially — byte-identical output to the
+   single-process spill-merge sort (utils/sort.py::sort_bam).
+
+Device memory bound: one span tile + two [n_dev, records_cap] u32 bucket
+matrices per device.  Host memory bound: the inflated input (spans stay
+resident so the permutation can gather record bytes); for inputs larger
+than host RAM use utils/sort.py, whose spill-merge bound is independent
+of file size.  Single-host only for now: every span is decoded on the
+calling host, so a multi-host mesh is rejected — sharding the decode per
+host the way the stats drivers do (parallel/distributed.py) is the
+extension point.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats.bam import SAMHeader
+
+_I32_SENTINEL = np.int32(2**31 - 1)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((max(x, 1) + m - 1) // m) * m
+
+
+def _keys_of(data: np.ndarray, offs: np.ndarray) -> Tuple[np.ndarray,
+                                                          np.ndarray]:
+    """(hi, lo) uint32 coordinate keys from raw record bytes on host —
+    used only for boundary sampling; the sharded step re-derives keys on
+    device.  hi = refid (unmapped -> 2^32-1, sorting last, matching
+    utils/sort.py::coordinate_key); lo = pos + 1 in uint32 wraparound."""
+    base = offs.astype(np.int64)
+    refid = (data[base[:, None] + np.arange(4, 8)]
+             .view(np.int32).ravel())
+    pos = (data[base[:, None] + np.arange(8, 12)]
+           .view(np.int32).ravel())
+    hi = np.where(refid < 0, np.uint32(0xFFFFFFFF),
+                  refid.astype(np.uint32))
+    lo = pos.astype(np.uint32) + np.uint32(1)
+    return hi, lo
+
+
+def _sample_bounds(his: List[np.ndarray], los: List[np.ndarray],
+                   n_dev: int, max_sample: int = 1 << 16
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """n_dev - 1 lexicographic (hi, lo) bucket boundaries from a key
+    sample: bucket b receives keys in [bound_{b-1}, bound_b)."""
+    hi = np.concatenate(his) if his else np.zeros(0, np.uint32)
+    lo = np.concatenate(los) if los else np.zeros(0, np.uint32)
+    n = hi.size
+    if n > max_sample:
+        step = n // max_sample
+        hi, lo = hi[::step], lo[::step]
+        n = hi.size
+    order = np.lexsort((lo, hi))
+    hi, lo = hi[order], lo[order]
+    picks = (np.arange(1, n_dev) * n) // n_dev if n else np.zeros(
+        0, np.int64)
+    bhi = hi[picks] if n else np.zeros(n_dev - 1, np.uint32)
+    blo = lo[picks] if n else np.zeros(n_dev - 1, np.uint32)
+    return bhi.astype(np.uint32), blo.astype(np.uint32)
+
+
+def _make_sort_step(mesh, records_cap: int):
+    """shard_map step: tiles -> device keys -> all_to_all bucket exchange
+    -> per-device multi-key sort.  Returns per-device sorted global
+    indices (sentinel-padded) as a [n_dev, n_dev * records_cap] array."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from hadoop_bam_tpu.ops.unpack_bam import unpack_fixed_fields
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    R = records_cap
+
+    def per_device(data, offsets, count, base, bhi, blo):
+        data, offsets = data[0], offsets[0]
+        count, base = count[0], base[0]
+        cols = unpack_fixed_fields(data, offsets)
+        valid = jnp.arange(R, dtype=jnp.int32) < count
+        refid, pos = cols["refid"], cols["pos"]
+        hi = jnp.where(refid < 0, jnp.uint32(0xFFFFFFFF),
+                       refid.astype(jnp.uint32))
+        lo = pos.astype(jnp.uint32) + jnp.uint32(1)
+        hi = jnp.where(valid, hi, jnp.uint32(0xFFFFFFFF))
+        lo = jnp.where(valid, lo, jnp.uint32(0xFFFFFFFF))
+        gidx = jnp.where(valid, base + jnp.arange(R, dtype=jnp.int32),
+                         _I32_SENTINEL)
+
+        # lexicographic bucket id: how many boundaries are <= key
+        ge = ((hi[:, None] > bhi[None, :])
+              | ((hi[:, None] == bhi[None, :])
+                 & (lo[:, None] >= blo[None, :])))
+        bucket = jnp.sum(ge.astype(jnp.int32), axis=1)      # [R] 0..n_dev-1
+
+        # pack per-destination rows: stable order within each bucket
+        perm = jnp.argsort(bucket, stable=True)
+        sb = bucket[perm]
+        rank = jnp.arange(R, dtype=jnp.int32) - jnp.searchsorted(
+            sb, sb, side="left").astype(jnp.int32)
+        send_hi = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
+                           ).at[sb, rank].set(hi[perm])
+        send_lo = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
+                           ).at[sb, rank].set(lo[perm])
+        send_ix = jnp.full((n_dev, R), _I32_SENTINEL, jnp.int32
+                           ).at[sb, rank].set(gidx[perm])
+
+        # the shuffle: row b of each device goes to device b
+        recv_hi = jax.lax.all_to_all(send_hi, "data", 0, 0, tiled=True)
+        recv_lo = jax.lax.all_to_all(send_lo, "data", 0, 0, tiled=True)
+        recv_ix = jax.lax.all_to_all(send_ix, "data", 0, 0, tiled=True)
+
+        # bucket-local sort; the global-index key makes ties deterministic
+        _, _, six = jax.lax.sort(
+            (recv_hi.ravel(), recv_lo.ravel(), recv_ix.ravel()),
+            num_keys=3)
+        return six[None]
+
+    return jax.jit(jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P("data"), P(), P()),
+        out_specs=P("data"), check_vma=False))
+
+
+def sort_bam_mesh(input_path: str, output_path: str, *,
+                  mesh=None, config: HBamConfig = DEFAULT_CONFIG,
+                  header: Optional[SAMHeader] = None) -> int:
+    """Coordinate-sort a BAM over the mesh; byte-identical to
+    utils/sort.py::sort_bam(by_name=False).  Returns the record count.
+
+    Queryname sort keys are variable-length byte strings with no fixed-
+    width device representation; use sort_bam for those.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hadoop_bam_tpu.formats.bamio import BamWriter, read_bam_header
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.parallel.pipeline import _decode_span_core
+    from hadoop_bam_tpu.split.planners import plan_bam_spans_balanced
+    from hadoop_bam_tpu.utils.sort import _sorted_header
+
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "sort_bam_mesh decodes every span on the calling host; "
+            "multi-host meshes are not supported yet — run per host or "
+            "use utils.sort.sort_bam")
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = int(np.prod(mesh.devices.shape))
+    if header is None:
+        header, _ = read_bam_header(input_path)
+
+    spans = plan_bam_spans_balanced(input_path, n_dev, header=header)
+    raw: List[Tuple[np.ndarray, np.ndarray]] = []   # (data, offsets)
+    his: List[np.ndarray] = []
+    los: List[np.ndarray] = []
+    for s in spans:
+        data, offs, _voffs, _ = _decode_span_core(input_path, s, False,
+                                                  "auto")
+        if data.size > 2**31 - 64:
+            raise ValueError(
+                f"span inflates to {data.size} bytes — offsets exceed "
+                f"the device int32 tile layout; use utils.sort.sort_bam "
+                f"for inputs this large")
+        raw.append((data, offs.astype(np.int32)))
+        h, l = _keys_of(data, offs)
+        his.append(h)
+        los.append(l)
+    counts = [o.size for _, o in raw]
+    total = int(sum(counts))
+    base = np.zeros(n_dev, dtype=np.int32)
+    if counts:
+        base[1:len(counts)] = np.cumsum(counts[:-1])
+
+    bytes_cap = _round_up(max((d.size for d, _ in raw), default=1), 256)
+    records_cap = _round_up(max(counts, default=1), 8)
+    datas = np.zeros((n_dev, bytes_cap), np.uint8)
+    offsets = np.zeros((n_dev, records_cap), np.int32)
+    cvec = np.zeros(n_dev, np.int32)
+    for d, (dat, off) in enumerate(raw):
+        datas[d, :dat.size] = dat
+        offsets[d, :off.size] = off
+        cvec[d] = off.size
+    bhi, blo = _sample_bounds(his, los, n_dev)
+
+    step = _make_sort_step(mesh, records_cap)
+    sharding = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    six = step(jax.device_put(datas, sharding),
+               jax.device_put(offsets, sharding),
+               jax.device_put(cvec, sharding),
+               jax.device_put(base, sharding),
+               jax.device_put(bhi, rep), jax.device_put(blo, rep))
+    six = np.asarray(six)                     # [n_dev, n_dev * records_cap]
+    del datas, offsets                        # padded copies; raw suffices
+
+    # apply the permutation: buckets in device order ARE the global order
+    span_of = np.searchsorted(
+        np.cumsum(counts), np.arange(total), side="right")
+    out_header = _sorted_header(header, by_name=False)
+    written = 0
+    with BamWriter(output_path, out_header) as w:
+        for d in range(n_dev):
+            idxs = six[d]
+            idxs = idxs[idxs != _I32_SENTINEL]
+            for g in idxs:
+                s = int(span_of[g])
+                data, offs = raw[s]
+                r = int(g) - int(base[s])
+                o = int(offs[r])
+                bs = int.from_bytes(data[o:o + 4].tobytes(), "little",
+                                    signed=True)
+                w.write_record_bytes(data[o:o + 4 + bs].tobytes())
+                written += 1
+    if written != total:
+        raise RuntimeError(
+            f"mesh sort wrote {written} of {total} records — bucket "
+            f"exchange lost data (capacity bug); output is invalid")
+    return total

@@ -243,10 +243,24 @@ def cmd_vcf_stats(args) -> int:
 # ---------------------------------------------------------------------------
 
 def cmd_sort(args) -> int:
+    if args.mesh:
+        if args.by_name:
+            raise SystemExit(
+                "--mesh supports coordinate sort only (queryname keys "
+                "have no fixed-width device representation); drop -n")
+        if args.run_records is not None:
+            raise SystemExit(
+                "--run-records is the spill-merge memory bound; the mesh "
+                "sort holds the inflated input in host memory instead — "
+                "drop --run-records or drop --mesh")
+        from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
+        n = sort_bam_mesh(args.input, args.output)
+        print(f"wrote {args.output} ({n} records, coordinate, mesh)")
+        return 0
     from hadoop_bam_tpu.utils.sort import sort_bam
 
     n = sort_bam(args.input, args.output, by_name=args.by_name,
-                 run_records=args.run_records)
+                 run_records=args.run_records or 1_000_000)
     so = "queryname" if args.by_name else "coordinate"
     print(f"wrote {args.output} ({n} records, {so})")
     return 0
@@ -374,8 +388,13 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("input")
     so.add_argument("output")
     so.add_argument("-n", "--by-name", action="store_true")
-    so.add_argument("--run-records", type=int, default=1_000_000,
-                    help="records per in-memory sort run (memory bound)")
+    so.add_argument("--run-records", type=int, default=None,
+                    help="records per in-memory sort run (memory bound; "
+                         "default 1000000, spill-merge mode only)")
+    so.add_argument("--mesh", action="store_true",
+                    help="bucketed sort over the device mesh (device key "
+                         "extraction + all_to_all exchange; coordinate "
+                         "order only, input must fit host memory)")
     so.set_defaults(fn=cmd_sort)
 
     f = sub.add_parser("fixmate", help="fill mate fields on name-grouped BAM")
