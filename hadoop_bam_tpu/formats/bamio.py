@@ -68,6 +68,13 @@ class BamWriter:
     def write_sam_record(self, rec: SamRecord) -> int:
         return self.write_record_bytes(rec.to_bam_bytes(self.header))
 
+    def write_raw(self, data: bytes, n_records: int = 0) -> None:
+        """Append pre-encoded, already-concatenated record bytes (bulk
+        path for writers that assemble records off to the side; the BGZF
+        stream is identical to per-record write_record_bytes calls)."""
+        self._w.write(data)
+        self.records_written += n_records
+
     def record_voffsets(self) -> List[int]:
         return self._voffsets
 

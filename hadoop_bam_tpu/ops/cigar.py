@@ -1,0 +1,106 @@
+"""Device CIGAR geometry: ragged cigar unpack, reference spans, coverage.
+
+The reference computes alignment geometry per record on the CPU (htsjdk
+``SAMRecord.getAlignmentEnd`` walking the cigar; SURVEY.md section 7
+kernel (b) maps it to a device kernel).  Here the ragged cigar arrays
+become fixed-shape [N, max_cigar] u32 tiles (zero-padded — a zero word
+is a 0-length M op, which every reduction ignores), and geometry falls
+out of masked row reductions:
+
+- ``reference_span_from_tiles``: bases consumed on the reference
+  (M/D/N/=/X), parity with the host ``BamBatch.reference_span``;
+- ``window_coverage_from_tiles``: exact per-base aligned-base depth
+  (M/=/X ops only — deletions and ref-skips do not add depth) over a
+  genomic window, as a diff-array scatter + cumsum — the segment-ops
+  formulation of pileup that keeps the VPU busy instead of a per-read
+  host loop.
+
+Coordinates stay int32: BAM positions and windows are < 2^31 [SPEC].
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hadoop_bam_tpu.ops.unpack_bam import PREFIX
+
+# op codes [SPEC]: M I D N S H P = X
+_REF_CONSUMING = (0, 2, 3, 7, 8)     # M D N = X
+_ALIGNED = (0, 7, 8)                 # M = X  (bases that add depth)
+
+
+def _is_in(op: jnp.ndarray, codes: Tuple[int, ...]) -> jnp.ndarray:
+    m = op == codes[0]
+    for c in codes[1:]:
+        m = m | (op == c)
+    return m
+
+
+@functools.partial(jax.jit, static_argnames=("max_cigar",))
+def unpack_cigar_tiles(data: jnp.ndarray, offsets: jnp.ndarray,
+                       l_read_name: jnp.ndarray, n_cigar: jnp.ndarray,
+                       max_cigar: int) -> jnp.ndarray:
+    """Gather each record's cigar words into a [N, max_cigar] uint32 tile.
+
+    ``data`` is the inflated span bytes; per record the cigar begins at
+    ``offset + PREFIX + l_read_name`` [SPEC record layout].  Ops beyond
+    ``n_cigar`` (and rows whose cigar would read past the buffer) are 0.
+    """
+    start = offsets + PREFIX + l_read_name
+    j = jnp.arange(max_cigar, dtype=jnp.int32)
+    base = start[:, None] + 4 * j[None, :]
+    base = jnp.clip(base, 0, jnp.int32(data.shape[0] - 4))
+    w = (data[base].astype(jnp.uint32)
+         | (data[base + 1].astype(jnp.uint32) << 8)
+         | (data[base + 2].astype(jnp.uint32) << 16)
+         | (data[base + 3].astype(jnp.uint32) << 24))
+    valid = j[None, :] < n_cigar[:, None]
+    return jnp.where(valid, w, jnp.uint32(0))
+
+
+def reference_span_from_tiles(tiles: jnp.ndarray, n_cigar: jnp.ndarray,
+                              l_seq: jnp.ndarray) -> jnp.ndarray:
+    """Reference bases consumed per record; '*'-cigar records fall back to
+    l_seq (host parity: formats/bam.py::BamBatch.reference_span)."""
+    op = (tiles & 0xF).astype(jnp.int32)
+    ln = (tiles >> 4).astype(jnp.int32)
+    span = jnp.sum(jnp.where(_is_in(op, _REF_CONSUMING), ln, 0), axis=1)
+    return jnp.where(n_cigar > 0, span, jnp.maximum(l_seq, 0))
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def window_coverage_from_tiles(tiles: jnp.ndarray, n_cigar: jnp.ndarray,
+                               pos: jnp.ndarray, refid: jnp.ndarray,
+                               flag: jnp.ndarray, row_valid: jnp.ndarray,
+                               target_refid: jnp.ndarray,
+                               win_start: jnp.ndarray,
+                               window: int) -> jnp.ndarray:
+    """Exact per-base depth of aligned bases over [win_start, win_start +
+    window) of one reference sequence.
+
+    Depth counts M/=/X op bases of mapped records on the target
+    reference; D/N ops advance the reference cursor without adding
+    depth; unmapped records (FLAG 0x4) and padded rows contribute
+    nothing.  Returns int32 [window].
+    """
+    op = (tiles & 0xF).astype(jnp.int32)
+    ln = (tiles >> 4).astype(jnp.int32)
+    adv = jnp.where(_is_in(op, _REF_CONSUMING), ln, 0)
+    op_start = pos[:, None] + jnp.cumsum(adv, axis=1) - adv
+
+    keep = (_is_in(op, _ALIGNED)
+            & row_valid[:, None]
+            & ((flag[:, None] & 4) == 0)
+            & (refid[:, None] == target_refid))
+    s = jnp.clip(op_start - win_start, 0, window)
+    e = jnp.clip(op_start + ln - win_start, 0, window)
+    s = jnp.where(keep, s, 0)
+    e = jnp.where(keep, e, 0)                 # zero-length: no-op
+    one = keep.astype(jnp.int32)
+    diff = jnp.zeros(window + 1, jnp.int32)
+    diff = diff.at[s.ravel()].add(one.ravel())
+    diff = diff.at[e.ravel()].add(-one.ravel())
+    return jnp.cumsum(diff[:window])

@@ -216,7 +216,11 @@ def sort_bam_mesh(input_path: str, output_path: str, *,
     six = np.asarray(six)                     # [n_dev, n_dev * records_cap]
     del datas, offsets                        # padded copies; raw suffices
 
-    # apply the permutation: buckets in device order ARE the global order
+    # apply the permutation: buckets in device order ARE the global order.
+    # Vectorized per bucket — per-record Python slicing would dominate the
+    # whole sort at scale: gather each record's (source span, offset,
+    # length), then assemble one contiguous output buffer with the same
+    # repeat/arange scatter the decode paths use, and bulk-append it.
     span_of = np.searchsorted(
         np.cumsum(counts), np.arange(total), side="right")
     out_header = _sorted_header(header, by_name=False)
@@ -224,16 +228,32 @@ def sort_bam_mesh(input_path: str, output_path: str, *,
     with BamWriter(output_path, out_header) as w:
         for d in range(n_dev):
             idxs = six[d]
-            idxs = idxs[idxs != _I32_SENTINEL]
-            for g in idxs:
-                s = int(span_of[g])
-                data, offs = raw[s]
-                r = int(g) - int(base[s])
-                o = int(offs[r])
-                bs = int.from_bytes(data[o:o + 4].tobytes(), "little",
-                                    signed=True)
-                w.write_record_bytes(data[o:o + 4 + bs].tobytes())
-                written += 1
+            idxs = idxs[idxs != _I32_SENTINEL].astype(np.int64)
+            if not idxs.size:
+                continue
+            s_arr = span_of[idxs]
+            o_arr = np.empty(idxs.size, np.int64)
+            ln_arr = np.empty(idxs.size, np.int64)
+            for sp in np.unique(s_arr):
+                m = s_arr == sp
+                data, offs = raw[sp]
+                o = offs[idxs[m] - int(base[sp])].astype(np.int64)
+                bs = (data[o[:, None] + np.arange(4)]
+                      .view("<i4").ravel().astype(np.int64))
+                o_arr[m] = o
+                ln_arr[m] = bs + 4
+            dst0 = np.cumsum(ln_arr) - ln_arr
+            out = np.empty(int(ln_arr.sum()), np.uint8)
+            for sp in np.unique(s_arr):
+                m = s_arr == sp
+                data, _ = raw[sp]
+                nb = ln_arr[m]
+                f = (np.arange(int(nb.sum()), dtype=np.int64)
+                     - np.repeat(np.cumsum(nb) - nb, nb))
+                out[np.repeat(dst0[m], nb) + f] = \
+                    data[np.repeat(o_arr[m], nb) + f]
+            w.write_raw(out.tobytes(), n_records=idxs.size)
+            written += idxs.size
     if written != total:
         raise RuntimeError(
             f"mesh sort wrote {written} of {total} records — bucket "

@@ -1155,3 +1155,217 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     host = np.zeros(len(FLAGSTAT_FIELDS), dtype=np.int64) if totals_vec is None \
         else np.asarray(jax.device_get(totals_vec), dtype=np.int64)
     return {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}
+
+
+_CIGAR_ROW_HDR = 16                    # refid(4) pos(4) flag(2) n_cigar(2) pad(4)
+
+
+def _cigar_row_bytes(max_cigar: int) -> int:
+    return _CIGAR_ROW_HDR + 4 * max_cigar
+
+
+def decode_span_cigar_rows(source, span: FileVirtualSpan, max_cigar: int,
+                           check_crc: bool = False) -> np.ndarray:
+    """Host stage of the coverage path: inflate a span and pack one dense
+    row per record — raw LE fields (refid, pos, flag, n_cigar) + the cigar
+    words, zero-padded to ``max_cigar`` ops.  268 B/record over the link
+    instead of whole padded spans (the flagstat projected-tile idea
+    applied to the one variable-length series coverage needs).
+    """
+    d, o, _voffs, _ = _decode_span_core(source, span, check_crc, "auto",
+                                        want_voffs=False)
+    c = o.size
+    w = _cigar_row_bytes(max_cigar)
+    rows = np.zeros((c, w), dtype=np.uint8)
+    if c == 0:
+        return rows
+    o64 = o.astype(np.int64)
+    # raw-record field offsets (block_size-prefixed layout [SPEC]):
+    # refid 4:8, pos 8:12, l_read_name 12, bin 14:16, n_cigar 16:18,
+    # flag 18:20
+    rows[:, 0:4] = d[o64[:, None] + np.arange(4, 8)]      # refid LE bytes
+    rows[:, 4:8] = d[o64[:, None] + np.arange(8, 12)]     # pos LE bytes
+    rows[:, 8:10] = d[o64[:, None] + np.arange(18, 20)]   # flag LE bytes
+    rows[:, 10:12] = d[o64[:, None] + np.arange(16, 18)]  # n_cigar LE
+    n_cigar = (rows[:, 10].astype(np.int64)
+               | (rows[:, 11].astype(np.int64) << 8))
+    l_read_name = d[o64 + 12].astype(np.int64)
+    cigar_off = o64 + PREFIX + l_read_name
+    # rows keep the FULL n_cigar value; ops past max_cigar are dropped
+    # here and the DRIVER raises (outside the span-retry boundary, so a
+    # user-parameter error is neither retried nor skip_bad_spans-eaten)
+    byte_counts = 4 * np.minimum(n_cigar, max_cigar)
+    total_b = int(byte_counts.sum())
+    if total_b:
+        starts_b = np.cumsum(byte_counts) - byte_counts
+        flat_b = (np.arange(total_b, dtype=np.int64)
+                  - np.repeat(starts_b, byte_counts))
+        row_i = np.repeat(np.arange(c, dtype=np.int64), byte_counts)
+        rows[row_i, _CIGAR_ROW_HDR + flat_b] = \
+            d[np.repeat(cigar_off, byte_counts) + flat_b]
+    return rows
+
+
+def make_coverage_step(mesh: Mesh, window: int, max_cigar: int,
+                       axis: str = "data") -> Callable:
+    """Jitted sharded step: dense cigar-row tiles -> per-base window depth.
+
+    Returns PER-DEVICE depth [n_dev, window] (no collective): the driver
+    accumulates shard-locally across tile groups and reduces across
+    devices once at the end, instead of paying a window-sized psum per
+    dispatch."""
+    key = ("coverage", tuple(mesh.devices.flat), mesh.axis_names, axis,
+           window, max_cigar)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+
+    from hadoop_bam_tpu.ops.cigar import window_coverage_from_tiles
+
+    def per_device(tile, count, target_refid, win_start):
+        tile, count = tile[0], count[0]
+        u = tile.astype(jnp.uint32)
+
+        def le32(a):
+            return (u[:, a] | (u[:, a + 1] << 8) | (u[:, a + 2] << 16)
+                    | (u[:, a + 3] << 24)).astype(jnp.int32)
+
+        refid = le32(0)
+        pos = le32(4)
+        flag = (u[:, 8] | (u[:, 9] << 8)).astype(jnp.int32)
+        n_cigar = (u[:, 10] | (u[:, 11] << 8)).astype(jnp.int32)
+        ops4 = tile[:, _CIGAR_ROW_HDR:].reshape(
+            tile.shape[0], max_cigar, 4).astype(jnp.uint32)
+        ops = (ops4[..., 0] | (ops4[..., 1] << 8) | (ops4[..., 2] << 16)
+               | (ops4[..., 3] << 24))
+        valid = jnp.arange(tile.shape[0], dtype=jnp.int32) < count
+        depth = window_coverage_from_tiles(
+            ops, n_cigar, pos, refid, flag, valid, target_refid,
+            win_start, window)
+        return depth[None]
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(P(axis), P(axis), P(), P()),
+                   out_specs=P(axis))
+    step = jax.jit(fn)
+    _STEP_CACHE[key] = step
+    return step
+
+
+def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
+                  config: HBamConfig = DEFAULT_CONFIG,
+                  header: Optional[SAMHeader] = None,
+                  spans: Optional[Sequence[FileVirtualSpan]] = None,
+                  max_cigar: int = 64, tile_records: int = 1 << 15,
+                  prefetch: int = 2) -> np.ndarray:
+    """Distributed per-base aligned-base depth over a genomic window —
+    the first analysis op past flagstat (SURVEY.md section 7 kernel (b)):
+    plan -> shard -> inflate -> pack cigar rows -> device diff-scatter
+    pileup -> psum.
+
+    ``region`` is a samtools-style string ("chr20:1,000-2,000", 1-based
+    inclusive) or an Interval.  Returns int32 depth, one entry per base.
+    When a ``.bai`` sidecar exists the span plan is trimmed to the
+    region's chunks; otherwise the whole file streams through and rows
+    outside the region mask to zero on device.
+    """
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.split.intervals import Interval, parse_interval
+
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = int(np.prod(mesh.devices.shape))
+    if header is None:
+        header, _ = read_bam_header(path)
+    if not isinstance(region, Interval):
+        region = parse_interval(region)
+    if region.rname not in header.ref_names:
+        raise ValueError(f"region reference {region.rname!r} not in header")
+    target_refid = header.ref_names.index(region.rname)
+    ref_len = header.ref_lengths[target_refid]
+    end = min(region.end, ref_len)
+    window = end - region.start + 1
+    if window <= 0:
+        raise ValueError(f"empty region {region}")
+    if window > (1 << 26):
+        raise ValueError(f"region spans {window} bases; cap is 2^26 — "
+                         f"tile larger regions across calls")
+    win_start = region.start - 1          # 0-based half-open window
+
+    if spans is None:
+        cfg = dataclasses.replace(config, bam_intervals=str(region))
+        from hadoop_bam_tpu.split.planners import plan_spans_maybe_intervals
+        span_bytes = 4 << 20
+        src = as_byte_source(path)
+        n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
+        src.close()
+        spans = plan_spans_maybe_intervals(path, header, cfg,
+                                           num_spans=n_spans)
+
+    sharding = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    check_crc = bool(getattr(config, "check_crc", False))
+    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
+    row_w = _cigar_row_bytes(max_cigar)
+    window_depth = None                   # [n_dev, window], device-sharded
+    tref = jax.device_put(np.int32(target_refid), rep)
+    wstart = jax.device_put(np.int32(win_start), rep)
+
+    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
+        def decode(span):
+            def inner(s):
+                return decode_span_cigar_rows(path, s, max_cigar,
+                                              check_crc)
+            out = decode_with_retry(inner, span, config)
+            return out if out is not None else np.zeros((0, row_w),
+                                                        np.uint8)
+
+        stream = _iter_windowed(pool, list(spans), decode,
+                                max(1, prefetch) * n_workers)
+        tiles = _iter_tile_tuples(((r,) for r in stream), tile_records,
+                                  (row_w,))
+        group: List[np.ndarray] = []
+        counts: List[int] = []
+
+        def dispatch():
+            # most records carry far fewer ops than max_cigar; slice the
+            # tile to the group's real op width (pow2-bucketed so the jit
+            # cache stays small) before it crosses the link
+            mc = 1
+            for t, c in zip(group, counts):
+                if c:
+                    nc = (t[:c, 10].astype(np.int32)
+                          | (t[:c, 11].astype(np.int32) << 8))
+                    mc = max(mc, int(nc.max()))
+            if mc > max_cigar:
+                raise ValueError(
+                    f"record with {mc} cigar ops exceeds "
+                    f"max_cigar={max_cigar}; pass a larger max_cigar")
+            mc = min(max_cigar, max(8, 1 << (mc - 1).bit_length()))
+            w = _cigar_row_bytes(mc)
+            t = np.stack([g[:, :w] for g in group]
+                         + [np.zeros((tile_records, w), np.uint8)
+                            for _ in range(n_dev - len(group))])
+            cvec = np.zeros(n_dev, np.int32)
+            cvec[:len(counts)] = counts
+            step = make_coverage_step(mesh, window, mc)
+            out = step(jax.device_put(t, sharding),
+                       jax.device_put(cvec, sharding), tref, wstart)
+            nonlocal window_depth
+            window_depth = out if window_depth is None else \
+                window_depth + out        # shard-local add, no collective
+            group.clear()
+            counts.clear()
+
+        for (tile,), count in tiles:
+            group.append(tile)
+            counts.append(count)
+            if len(group) == n_dev:
+                dispatch()
+        if group:
+            dispatch()
+    if window_depth is None:
+        return np.zeros(window, np.int32)
+    # one cross-device reduce at the end instead of one psum per dispatch
+    total = jnp.sum(window_depth, axis=0)
+    return np.asarray(jax.device_get(total), dtype=np.int32)

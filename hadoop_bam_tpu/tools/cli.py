@@ -203,6 +203,36 @@ def cmd_summarize(args) -> int:
 # the closest is `summarize`, which these extend to payload columns)
 # ---------------------------------------------------------------------------
 
+def cmd_coverage(args) -> int:
+    import numpy as np
+
+    from hadoop_bam_tpu.parallel.pipeline import coverage_file
+    from hadoop_bam_tpu.split.intervals import parse_interval
+
+    region = parse_interval(args.region)
+    depth = coverage_file(args.input, region, max_cigar=args.max_cigar)
+    covered = int((depth > 0).sum())
+    print(f"region\t{region}")
+    print(f"bases\t{depth.size}")
+    print(f"covered\t{covered}")
+    print(f"mean_depth\t{float(depth.mean()):.4f}")
+    print(f"max_depth\t{int(depth.max()) if depth.size else 0}")
+    if args.bedgraph:
+        # run-length encode equal-depth runs, 0-based half-open [bedGraph]
+        edges = np.flatnonzero(np.diff(depth)) + 1
+        starts = np.concatenate([[0], edges])
+        ends = np.concatenate([edges, [depth.size]])
+        base = region.start - 1
+        with open(args.bedgraph, "w") as f:
+            for s, e in zip(starts, ends):
+                d = int(depth[s])
+                if d:
+                    f.write(f"{region.rname}\t{base + s}\t{base + e}"
+                            f"\t{d}\n")
+        print(f"wrote {args.bedgraph}")
+    return 0
+
+
 def cmd_seq_stats(args) -> int:
     from hadoop_bam_tpu.parallel.pipeline import (
         TEXT_READ_EXTS, PayloadGeometry, fastq_seq_stats_file,
@@ -396,6 +426,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "extraction + all_to_all exchange; coordinate "
                          "order only, input must fit host memory)")
     so.set_defaults(fn=cmd_sort)
+
+    cov = sub.add_parser("coverage",
+                         help="per-base aligned depth over a region "
+                              "(device cigar pileup)")
+    cov.add_argument("input")
+    cov.add_argument("region", help='samtools-style region, e.g. '
+                                    '"chr20:1,000-2,000"')
+    cov.add_argument("--max-cigar", type=int, default=64,
+                     help="cigar ops per record tile (loud error if "
+                          "exceeded)")
+    cov.add_argument("--bedgraph", metavar="PATH",
+                     help="write non-zero depth runs as bedGraph")
+    cov.set_defaults(fn=cmd_coverage)
 
     f = sub.add_parser("fixmate", help="fill mate fields on name-grouped BAM")
     f.add_argument("input")
