@@ -71,7 +71,16 @@ class BamWriter:
     def write_raw(self, data: bytes, n_records: int = 0) -> None:
         """Append pre-encoded, already-concatenated record bytes (bulk
         path for writers that assemble records off to the side; the BGZF
-        stream is identical to per-record write_record_bytes calls)."""
+        stream is identical to per-record write_record_bytes calls).
+
+        Incompatible with voffset tracking / index-on-write: per-record
+        boundaries are not visible here, so a sidecar built from this
+        stream would point at wrong offsets."""
+        if self._track:
+            raise ValueError(
+                "write_raw cannot be used with track_voffsets / "
+                "index_granularity — record boundaries are not visible; "
+                "use write_record_bytes")
         self._w.write(data)
         self.records_written += n_records
 

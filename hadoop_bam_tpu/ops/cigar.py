@@ -48,6 +48,11 @@ def unpack_cigar_tiles(data: jnp.ndarray, offsets: jnp.ndarray,
     ``data`` is the inflated span bytes; per record the cigar begins at
     ``offset + PREFIX + l_read_name`` [SPEC record layout].  Ops beyond
     ``n_cigar`` (and rows whose cigar would read past the buffer) are 0.
+
+    CONTRACT: records with ``n_cigar > max_cigar`` are silently truncated
+    here (no raising inside jit) and every downstream geometry value for
+    them is wrong — callers must validate ``n_cigar.max() <= max_cigar``
+    on the host first, as coverage_file does before dispatch.
     """
     start = offsets + PREFIX + l_read_name
     j = jnp.arange(max_cigar, dtype=jnp.int32)
@@ -72,7 +77,7 @@ def reference_span_from_tiles(tiles: jnp.ndarray, n_cigar: jnp.ndarray,
 
 
 @functools.partial(jax.jit, static_argnames=("window",))
-def window_coverage_from_tiles(tiles: jnp.ndarray, n_cigar: jnp.ndarray,
+def window_coverage_from_tiles(tiles: jnp.ndarray,
                                pos: jnp.ndarray, refid: jnp.ndarray,
                                flag: jnp.ndarray, row_valid: jnp.ndarray,
                                target_refid: jnp.ndarray,
@@ -84,7 +89,9 @@ def window_coverage_from_tiles(tiles: jnp.ndarray, n_cigar: jnp.ndarray,
     Depth counts M/=/X op bases of mapped records on the target
     reference; D/N ops advance the reference cursor without adding
     depth; unmapped records (FLAG 0x4) and padded rows contribute
-    nothing.  Returns int32 [window].
+    nothing.  Ops past each record's n_cigar need no mask: tile padding
+    is zero words = 0-length M ops, provably net-zero in the diff array.
+    Returns int32 [window].
     """
     op = (tiles & 0xF).astype(jnp.int32)
     ln = (tiles >> 4).astype(jnp.int32)

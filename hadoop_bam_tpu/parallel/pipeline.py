@@ -1157,7 +1157,12 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     return {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}
 
 
-_CIGAR_ROW_HDR = 16                    # refid(4) pos(4) flag(2) n_cigar(2) pad(4)
+# Coverage row layout: the fixed-field projection (offsets sourced from
+# ops/unpack_bam.py::FIXED_FIELDS — ONE place owns the BAM field map; the
+# high-position regression in test_cigar.py is what hand-copied offsets
+# cost), then the cigar words.
+_COVERAGE_PROJECTION = ("refid", "pos", "n_cigar", "flag")
+_CIGAR_ROW_HDR = projection_row_bytes(_COVERAGE_PROJECTION)   # 12
 
 
 def _cigar_row_bytes(max_cigar: int) -> int:
@@ -1167,10 +1172,15 @@ def _cigar_row_bytes(max_cigar: int) -> int:
 def decode_span_cigar_rows(source, span: FileVirtualSpan, max_cigar: int,
                            check_crc: bool = False) -> np.ndarray:
     """Host stage of the coverage path: inflate a span and pack one dense
-    row per record — raw LE fields (refid, pos, flag, n_cigar) + the cigar
-    words, zero-padded to ``max_cigar`` ops.  268 B/record over the link
-    instead of whole padded spans (the flagstat projected-tile idea
-    applied to the one variable-length series coverage needs).
+    row per record — the (refid, pos, n_cigar, flag) projection + the
+    cigar words, zero-padded to ``max_cigar`` ops.  ~268 B/record over
+    the link instead of whole padded spans (the flagstat projected-tile
+    idea applied to the one variable-length series coverage needs).
+
+    Ops past ``max_cigar`` are dropped from the row; the row's n_cigar
+    field keeps the FULL count so the driver can raise outside the
+    span-retry boundary (a user-parameter error must not be retried or
+    skip_bad_spans-eaten as corruption).
     """
     d, o, _voffs, _ = _decode_span_core(source, span, check_crc, "auto",
                                         want_voffs=False)
@@ -1180,15 +1190,14 @@ def decode_span_cigar_rows(source, span: FileVirtualSpan, max_cigar: int,
     if c == 0:
         return rows
     o64 = o.astype(np.int64)
-    # raw-record field offsets (block_size-prefixed layout [SPEC]):
-    # refid 4:8, pos 8:12, l_read_name 12, bin 14:16, n_cigar 16:18,
-    # flag 18:20
-    rows[:, 0:4] = d[o64[:, None] + np.arange(4, 8)]      # refid LE bytes
-    rows[:, 4:8] = d[o64[:, None] + np.arange(8, 12)]     # pos LE bytes
-    rows[:, 8:10] = d[o64[:, None] + np.arange(18, 20)]   # flag LE bytes
-    rows[:, 10:12] = d[o64[:, None] + np.arange(16, 18)]  # n_cigar LE
-    n_cigar = (rows[:, 10].astype(np.int64)
-               | (rows[:, 11].astype(np.int64) << 8))
+    dst = 0
+    for src_off, width in projection_ranges(_COVERAGE_PROJECTION):
+        rows[:, dst:dst + width] = \
+            d[o64[:, None] + np.arange(src_off, src_off + width)]
+        dst += width
+    nc_off = _CIGAR_ROW_HDR - 4          # n_cigar u16 within the row
+    n_cigar = (rows[:, nc_off].astype(np.int64)
+               | (rows[:, nc_off + 1].astype(np.int64) << 8))
     l_read_name = d[o64 + 12].astype(np.int64)
     cigar_off = o64 + PREFIX + l_read_name
     # rows keep the FULL n_cigar value; ops past max_cigar are dropped
@@ -1223,24 +1232,16 @@ def make_coverage_step(mesh: Mesh, window: int, max_cigar: int,
 
     def per_device(tile, count, target_refid, win_start):
         tile, count = tile[0], count[0]
-        u = tile.astype(jnp.uint32)
-
-        def le32(a):
-            return (u[:, a] | (u[:, a + 1] << 8) | (u[:, a + 2] << 16)
-                    | (u[:, a + 3] << 24)).astype(jnp.int32)
-
-        refid = le32(0)
-        pos = le32(4)
-        flag = (u[:, 8] | (u[:, 9] << 8)).astype(jnp.int32)
-        n_cigar = (u[:, 10] | (u[:, 11] << 8)).astype(jnp.int32)
+        cols = unpack_projected_tile(tile[:, :_CIGAR_ROW_HDR],
+                                     _COVERAGE_PROJECTION)
         ops4 = tile[:, _CIGAR_ROW_HDR:].reshape(
             tile.shape[0], max_cigar, 4).astype(jnp.uint32)
         ops = (ops4[..., 0] | (ops4[..., 1] << 8) | (ops4[..., 2] << 16)
                | (ops4[..., 3] << 24))
         valid = jnp.arange(tile.shape[0], dtype=jnp.int32) < count
         depth = window_coverage_from_tiles(
-            ops, n_cigar, pos, refid, flag, valid, target_refid,
-            win_start, window)
+            ops, cols["pos"], cols["refid"], cols["flag"], valid,
+            target_refid, win_start, window)
         return depth[None]
 
     fn = shard_map(per_device, mesh=mesh,
@@ -1332,10 +1333,11 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
             # tile to the group's real op width (pow2-bucketed so the jit
             # cache stays small) before it crosses the link
             mc = 1
+            nc_off = _CIGAR_ROW_HDR - 4
             for t, c in zip(group, counts):
                 if c:
-                    nc = (t[:c, 10].astype(np.int32)
-                          | (t[:c, 11].astype(np.int32) << 8))
+                    nc = (t[:c, nc_off].astype(np.int32)
+                          | (t[:c, nc_off + 1].astype(np.int32) << 8))
                     mc = max(mc, int(nc.max()))
             if mc > max_cigar:
                 raise ValueError(

@@ -1,0 +1,95 @@
+"""Generate the frozen wire-format fixtures under tests/golden/.
+
+Run from the repo root: ``python tests/make_goldens.py``.  DO NOT
+regenerate casually: the whole point of the goldens (SURVEY.md section 4
+round-trip philosophy; reference mount empty, so these are the only
+cross-session oracle) is that decoders are asserted against bytes
+written by a PAST encoder, not the same session's.  If an intentional
+format fix changes bytes, regenerate, update the pinned hashes in
+test_golden.py, and record the break in PARITY.md — files written
+before the change may become unreadable.
+"""
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fixtures import make_header, make_records  # noqa: E402
+
+GOLD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+def main() -> None:
+    os.makedirs(GOLD, exist_ok=True)
+    # enough records that the 3.1 entropy codecs (Nx16, tok3) genuinely
+    # beat RAW and engage — tiny payloads fall back to stored blocks
+    header = make_header()
+    recs = make_records(header, 96, seed=20260729)
+
+    # --- BAM + sidecar indexes + expected SAM text + voffsets
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    bam = os.path.join(GOLD, "golden.bam")
+    with BamWriter(bam, header, track_voffsets=True) as w:
+        for r in recs:
+            w.write_sam_record(r)
+        voffs = list(w.record_voffsets())
+    with open(os.path.join(GOLD, "golden.bam.voffsets"), "w") as f:
+        f.write("\n".join(str(v) for v in voffs) + "\n")
+    with open(os.path.join(GOLD, "golden.sam"), "w") as f:
+        for r in recs:
+            f.write(r.to_line() + "\n")
+    from hadoop_bam_tpu.split.splitting_index import write_splitting_index
+    write_splitting_index(bam, granularity=8, flavor="splitting-bai")
+    write_splitting_index(bam, granularity=8, flavor="sbi")
+
+    # --- CRAM 3.0 and 3.1 (same records)
+    from hadoop_bam_tpu.formats.cramio import CramWriter
+    # containers big enough that the 3.1 entropy codecs beat RAW and the
+    # blocks genuinely carry methods 5 (Nx16) and 8 (tok3)
+    for version in ((3, 0), (3, 1)):
+        path = os.path.join(GOLD, f"golden_{version[0]}{version[1]}.cram")
+        with CramWriter(path, header, records_per_container=48,
+                        version=version) as w:
+            w.write_records(recs)
+
+    # --- VCF.gz (BGZF) + BCF + expected VCF text
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+    vh = VCFHeader.from_text(
+        "##fileformat=VCFv4.2\n"
+        "##contig=<ID=chr1,length=248956422>\n"
+        "##contig=<ID=chr2,length=242193529>\n"
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="Depth">\n'
+        '##INFO=<ID=AF,Number=A,Type=Float,Description="Freq">\n'
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="GT">\n'
+        '##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="GQ">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\ts0\ts1\n")
+    rng = random.Random(20260729)
+    vlines = []
+    pos = 0
+    for i in range(20):
+        pos += rng.randint(1, 500)
+        ref = rng.choice("ACGT")
+        alt = rng.choice([c for c in "ACGT" if c != ref])
+        gts = "\t".join(
+            f"{rng.choice(['0/0', '0/1', '1/1', './.'])}:{rng.randint(1, 99)}"
+            for _ in range(2))
+        vlines.append(f"chr{1 + i % 2}\t{pos}\t.\t{ref}\t{alt}\t"
+                      f"{20 + i}\tPASS\tDP={i};AF=0.5\tGT:GQ\t{gts}")
+    with open(os.path.join(GOLD, "golden.vcf"), "w") as f:
+        f.write("\n".join(vlines) + "\n")
+    for ext in ("vcf.gz", "bcf"):
+        path = os.path.join(GOLD, f"golden.{ext}")
+        with open_vcf_writer(path, vh) as w:
+            for line in vlines:
+                w.write_record(VcfRecord.from_line(line))
+
+    import hashlib
+    for name in sorted(os.listdir(GOLD)):
+        p = os.path.join(GOLD, name)
+        print(f'    "{name}": "{hashlib.sha256(open(p, "rb").read()).hexdigest()}",')
+
+
+if __name__ == "__main__":
+    main()

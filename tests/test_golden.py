@@ -1,0 +1,135 @@
+"""Golden wire-format fixtures: decoders vs COMMITTED bytes.
+
+Every other round-trip test in this suite validates decoders against the
+same session's encoders — circular if both drift together (round-2
+VERDICT weak #5).  These tests decode bytes frozen in tests/golden/
+(generated once by tests/make_goldens.py and committed), so any
+behavioral drift in a decoder — or an encoder change that silently
+breaks old files — fails here first.  The sha256 pins detect accidental
+regeneration of the fixtures themselves.
+
+If an INTENTIONAL format fix changes expectations: regenerate via
+make_goldens.py, update the pins, and record the compatibility break in
+PARITY.md.
+"""
+import hashlib
+import os
+
+import pytest
+
+GOLD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+SHA256 = {
+    "golden.bam": "936fca774ce8e33e2957fe064d7d532e73eff2a8ccb599542a74e565a522f6ac",
+    "golden.bam.sbi": "cbb0e4ec6abc1da2c2e666deb1804558f0be916b74b997bcc76a4e99f6797e44",
+    "golden.bam.splitting-bai": "b7e02bd086cb07a279e8321e14b6fe8ed6ac807930795b909a8e8a5d03ff3df3",
+    "golden.bam.voffsets": "b4bf7fa01d7ae345a671e8507db6a4294d90de1379514acb2e2b3ca14b0bfb62",
+    "golden.bcf": "b22da7e37126c0bad0186a033a31171ee660f1891e82dbab60ebee0faeb75f9b",
+    "golden.sam": "80228ec8432243775dc112fea108568eba7f29b43687e5a5598bca0b2913fcfa",
+    "golden.vcf": "9fcdb168859cb6809799a6bc70fcb5bdb7f2681ba74d4e2bfd5e35f835e3cf91",
+    "golden.vcf.gz": "651bf53ecf9d494baa30d97b6fc94a0154daed972c5f331ca05fe94f31d8db7b",
+    "golden_30.cram": "646fe7cfaefe2de6e1fc7d51faff9c7b10971ba0bc4f9ed0bde55db48725b8dc",
+    "golden_31.cram": "5a7ecc85d5a9507419bf447e695a3849fe19eb4449dd0ab330117ab1c50aea5e",
+}
+
+# The fixed 28-byte BGZF EOF terminator [SPEC SAMv1 4.1.2]
+BGZF_EOF = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000")
+
+
+def _path(name):
+    return os.path.join(GOLD, name)
+
+
+def test_fixtures_unchanged():
+    found = sorted(os.listdir(GOLD))
+    assert found == sorted(SHA256), "fixture set drifted"
+    for name, want in SHA256.items():
+        got = hashlib.sha256(open(_path(name), "rb").read()).hexdigest()
+        assert got == want, (
+            f"{name} bytes changed — if intentional, re-pin via "
+            f"make_goldens.py and record the break in PARITY.md")
+
+
+def _want_sam_lines():
+    return open(_path("golden.sam")).read().splitlines()
+
+
+def test_golden_bam_decodes():
+    from hadoop_bam_tpu.api.dataset import open_bam
+    ds = open_bam(_path("golden.bam"))
+    got = [r.to_line() for r in ds.records()]
+    assert got == _want_sam_lines()
+
+
+def test_golden_bam_voffsets_and_eof():
+    from hadoop_bam_tpu.api.dataset import open_bam
+    raw = open(_path("golden.bam"), "rb").read()
+    assert raw[-28:] == BGZF_EOF
+    want = [int(x) for x in
+            open(_path("golden.bam.voffsets")).read().split()]
+    ds = open_bam(_path("golden.bam"))
+    got = []
+    for batch in ds.batches():
+        got.extend(int(v) for v in batch.voffsets)
+    assert got == want
+
+
+def test_golden_sidecar_indexes():
+    from hadoop_bam_tpu.split.splitting_index import SplittingIndex
+    want = [int(x) for x in
+            open(_path("golden.bam.voffsets")).read().split()]
+    size = os.path.getsize(_path("golden.bam"))
+    for suffix in (".splitting-bai", ".sbi"):
+        idx = SplittingIndex.from_bytes(
+            open(_path("golden.bam" + suffix), "rb").read())
+        assert idx.voffsets[:-1] == want[::8]       # granularity 8
+        assert idx.voffsets[-1] == size << 16
+        if suffix == ".sbi":
+            assert idx.granularity == 8
+            assert idx.total_records == len(want)
+
+
+@pytest.mark.parametrize("name", ["golden_30.cram", "golden_31.cram"])
+def test_golden_cram_decodes(name):
+    from hadoop_bam_tpu.formats.cramio import read_cram
+    _, recs = read_cram(_path(name))
+    assert [r.to_line() for r in recs] == _want_sam_lines()
+
+
+def test_golden_cram31_uses_31_methods():
+    """The 3.1 fixture must really exercise the 3.1 codecs (Nx16 + tok3),
+    so decoding it is evidence those decode paths read old bytes."""
+    from hadoop_bam_tpu.formats.cram import (
+        ContainerHeader, FileDefinition, NAME_TOK, RANSNx16,
+        parse_raw_block,
+    )
+    buf = open(_path("golden_31.cram"), "rb").read()
+    pos = FileDefinition.SIZE
+    methods = set()
+    while pos < len(buf):
+        hdr, pos = ContainerHeader.from_buffer(buf, pos)
+        end = pos + hdr.length
+        while pos < end:
+            raw, pos = parse_raw_block(buf, pos)
+            methods.add(raw.method)
+    assert NAME_TOK in methods
+    assert RANSNx16 in methods
+
+
+def _want_vcf_lines():
+    return open(_path("golden.vcf")).read().splitlines()
+
+
+@pytest.mark.parametrize("name", ["golden.vcf.gz", "golden.bcf"])
+def test_golden_variants_decode(name):
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    ds = open_vcf(_path(name))
+    got = [r.to_line() for r in ds.records()]
+    assert got == _want_vcf_lines()
+
+
+def test_golden_vcf_gz_is_bgzf_with_eof():
+    raw = open(_path("golden.vcf.gz"), "rb").read()
+    assert raw[:4] == b"\x1f\x8b\x08\x04"      # BGZF magic + FEXTRA
+    assert raw[-28:] == BGZF_EOF
