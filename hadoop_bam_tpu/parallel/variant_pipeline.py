@@ -82,11 +82,32 @@ _SNP_ALTS = frozenset(b"ACGTN")
 def pack_variant_tiles_from_text(text: bytes, header: VCFHeader,
                                  geometry: VariantGeometry
                                  ) -> Dict[str, np.ndarray]:
-    """Fast text-VCF tokenizer for the stats/tensor path: splits fields
-    directly from bytes, never building VcfRecord objects — the host-side
-    'VCF line tokenizer' kernel of SURVEY.md section 7.3(e).  ~5x the
-    generic parse on typical multi-sample lines; semantics match
+    """Text-VCF tokenizer for the stats/tensor path — the host-side 'VCF
+    line tokenizer' kernel of SURVEY.md section 7.3(e).
+
+    Dispatches to the NumPy grid tokenizer (newline/tab scans -> field
+    boundary matrix -> one clamped gather per column; no per-line Python)
+    and falls back to this scalar parse ONLY for rows the vectorized path
+    flags as irregular (ALT wider than its gather, multi-digit or
+    polyploid genotypes, non-digit POS).  Semantics match
     pack_variant_tiles (asserted by tests)."""
+    cols, odd = _pack_variant_text_vectorized(text, header, geometry)
+    if odd:
+        # odd: (kept-row index, line start, line end) for irregular rows
+        rows = np.asarray([r for r, _, _ in odd])
+        patch = _pack_variant_tiles_from_text_scalar(
+            b"\n".join(text[s:e] for _, s, e in odd) + b"\n",
+            header, geometry)
+        for k in cols:
+            cols[k][rows] = patch[k]
+    return cols
+
+
+def _pack_variant_tiles_from_text_scalar(text: bytes, header: VCFHeader,
+                                         geometry: VariantGeometry
+                                         ) -> Dict[str, np.ndarray]:
+    """Per-line reference tokenizer (the vectorized path's oracle and its
+    irregular-row fallback)."""
     S = geometry.n_samples
     cap = text.count(b"\n") + 1
     chrom = np.empty(cap, np.int32)
@@ -130,6 +151,172 @@ def pack_variant_tiles_from_text(text: bytes, header: VCFHeader,
         n += 1
     return {"chrom": chrom[:n], "pos": pos[:n], "flags": flags[:n],
             "dosage": dosage[:n]}
+
+
+_ALT_W = 16            # widest ALT the vectorized SNP test gathers
+_GT_W = 4              # widest genotype prefix gathered (covers "0/1:")
+_POS_W = 10            # max decimal digits in a 31-bit position
+
+
+def _pack_variant_text_vectorized(text: bytes, header: VCFHeader,
+                                  geometry: VariantGeometry):
+    """NumPy grid tokenizer: newline/tab scans -> per-line field-boundary
+    matrix -> one clamped gather per column.  Returns (cols, odd) where
+    ``odd`` lists (row, line_start, line_end) for rows needing the scalar
+    fallback (wide ALT, unusual GT shapes, non-digit POS)."""
+    S = geometry.n_samples
+    buf = np.frombuffer(text, dtype=np.uint8)
+    if buf.size == 0:
+        return {"chrom": np.empty(0, np.int32),
+                "pos": np.empty(0, np.int32),
+                "flags": np.empty(0, np.uint8),
+                "dosage": np.full((0, geometry.samples_pad), -1, np.int8),
+                }, []
+    nl = np.flatnonzero(buf == 0x0A)
+    if nl.size == 0 or nl[-1] != buf.size - 1:
+        nl = np.append(nl, buf.size)
+    starts = np.empty(nl.size, dtype=np.int64)
+    starts[0] = 0
+    starts[1:] = nl[:-1] + 1
+    ends = nl
+    first = buf[np.minimum(starts, buf.size - 1)]
+    keep = (ends > starts) & (first != ord("#"))
+
+    tabs = np.flatnonzero(buf == 0x09)
+    t0 = np.searchsorted(tabs, starts)
+    t1 = np.searchsorted(tabs, ends)
+    ntab = t1 - t0
+    keep &= ntab >= 7                       # >= 8 fields, scalar parity
+    starts, ends, t0, ntab = (a[keep] for a in (starts, ends, t0, ntab))
+    n = starts.size
+    cols = {"chrom": np.full(n, -1, np.int32),
+            "pos": np.zeros(n, np.int32),
+            "flags": np.zeros(n, np.uint8),
+            "dosage": np.full((n, geometry.samples_pad), -1, np.int8)}
+    if n == 0:
+        return cols, []
+    nf = 10 + S                             # fields we may need bounds for
+    k = np.arange(nf - 1, dtype=np.int64)[None, :]
+    tabm = tabs[np.minimum(t0[:, None] + k, tabs.size - 1)]
+    tabm = np.where(k < ntab[:, None], tabm, ends[:, None])
+    # field f occupies [fs[f], fe[f])
+    fs = np.concatenate([starts[:, None], tabm + 1], axis=1)
+    fe = np.concatenate([tabm, ends[:, None]], axis=1)
+    fe = np.maximum(fe, fs)                 # past-the-last fields: empty
+    odd = np.zeros(n, bool)
+
+    def gather(f, width):
+        """[n, width] bytes of field f, zero past its length, + lengths."""
+        ln = fe[:, f] - fs[:, f]
+        j = np.arange(width, dtype=np.int64)[None, :]
+        g = buf[np.minimum(fs[:, f, None] + j, buf.size - 1)]
+        return np.where(j < ln[:, None], g, 0), ln
+
+    # CHROM: a span holds 1-2 distinct names, but a real header can carry
+    # thousands of contigs — dedupe the gathered rows and dict-look-up
+    # only the unique values (O(lines) + O(unique * lookup), not
+    # O(lines * contigs))
+    cmap = {c.encode(): i for i, c in enumerate(header.contigs)}
+    cw = max((len(c) for c in header.contigs), default=1)
+    cbytes, clen = gather(0, cw)
+    # clen joins the key so a truncated long name can't alias a contig
+    keyed = np.concatenate(
+        [cbytes, np.minimum(clen, cw + 1)[:, None].astype(np.uint8)],
+        axis=1)
+    # hash-group the rows (a span holds ~1-2 distinct names; a real
+    # header can carry thousands of contigs, so neither a per-contig
+    # scan nor a lexicographic row-unique is acceptable): u64 scalar
+    # unique + one vectorized verify against each group's representative
+    weights = ((2 * np.arange(cw + 1, dtype=np.uint64) + 1)
+               * np.uint64(0x9E3779B97F4A7C15))
+    with np.errstate(over="ignore"):
+        h = (keyed.astype(np.uint64) * weights[None, :]).sum(
+            axis=1, dtype=np.uint64)
+    _, first_idx, inv = np.unique(h, return_index=True,
+                                  return_inverse=True)
+    lut = np.full(first_idx.size, -1, np.int32)
+    for ui, ri in enumerate(first_idx):
+        ul = int(clen[ri])
+        if ul <= cw:
+            lut[ui] = cmap.get(cbytes[ri, :ul].tobytes(), -1)
+    cols["chrom"] = lut[inv]
+    # hash-collision rows (different bytes, same hash): re-look-up exactly
+    mismatch = np.flatnonzero(
+        ~(keyed == keyed[first_idx[inv]]).all(axis=1))
+    for ri in mismatch:
+        ul = int(clen[ri])
+        cols["chrom"][ri] = cmap.get(cbytes[ri, :ul].tobytes(), -1) \
+            if ul <= cw else -1
+
+    # POS: fixed-width decimal parse (int64 accumulate; values past
+    # int32 fall back so the scalar path raises the same OverflowError
+    # the pre-vectorized tokenizer did on out-of-spec input)
+    pb, plen = gather(1, _POS_W)
+    digit = (pb >= 0x30) & (pb <= 0x39)
+    j = np.arange(_POS_W, dtype=np.int64)[None, :]
+    in_field = j < plen[:, None]
+    odd |= (plen > _POS_W) | (plen == 0) | (digit != in_field).any(axis=1)
+    scale = np.where(in_field, 10 ** np.maximum(
+        plen[:, None] - 1 - j, 0), 0)
+    pos64 = ((pb.astype(np.int64) - 0x30) * in_field * scale).sum(axis=1)
+    odd |= pos64 > np.iinfo(np.int32).max
+    cols["pos"] = np.minimum(pos64, np.iinfo(np.int32).max) \
+        .astype(np.int32)
+
+    # FILTER == PASS
+    fb, flen = gather(6, 4)
+    is_pass = (flen == 4) & (fb == np.frombuffer(b"PASS", np.uint8)) \
+        .all(axis=1)
+
+    # SNP: REF is 1 base; ALT is single bases joined by commas
+    _rb, rlen = gather(3, 1)
+    ab, alen = gather(4, _ALT_W)
+    odd |= alen > _ALT_W
+    ja = np.arange(_ALT_W, dtype=np.int64)[None, :]
+    in_alt = ja < alen[:, None]
+    snp_char = np.isin(ab, np.frombuffer(b"ACGTN", np.uint8))
+    ok_even = (~in_alt | (ja % 2 == 1) | snp_char).all(axis=1)
+    ok_odd = (~in_alt | (ja % 2 == 0) | (ab == ord(","))).all(axis=1)
+    is_snp = (rlen == 1) & (alen % 2 == 1) & ok_even & ok_odd
+    cols["flags"] = (is_pass.astype(np.uint8) * FLAG_PASS
+                     | is_snp.astype(np.uint8) * FLAG_SNP)
+
+    # genotypes: FORMAT (field 8) must start "GT"; per sample, dosage
+    # from the first 1 or 3 characters of the GT subfield
+    if S:
+        gb8, glen8 = gather(8, 2)
+        has_gt = (glen8 >= 2) & (gb8[:, 0] == ord("G")) \
+            & (gb8[:, 1] == ord("T")) & (ntab >= 9)
+        for s in range(S):
+            f = 9 + s
+            present = has_gt & (ntab >= f)   # field exists on the line
+            sb, sln = gather(f, _GT_W)
+            colon = np.where((sb == ord(":")) & (np.arange(_GT_W) <
+                                                 sln[:, None]),
+                             np.arange(_GT_W), _GT_W).min(axis=1)
+            gtlen = np.minimum(sln, colon)
+            c0, c1, c2 = sb[:, 0], sb[:, 1], sb[:, 2]
+            d0 = (c0 >= 0x30) & (c0 <= 0x39)
+            d2 = (c2 >= 0x30) & (c2 <= 0x39)
+            sep = (c1 == ord("/")) | (c1 == ord("|"))
+            one = gtlen == 1
+            tri = (gtlen == 3) & sep
+            dot0, dot2 = c0 == ord("."), c2 == ord(".")
+            val1 = np.where(d0, (c0 > 0x30).astype(np.int8), np.int8(-1))
+            val3 = np.where(d0 & d2,
+                            ((c0 > 0x30).astype(np.int8)
+                             + (c2 > 0x30).astype(np.int8)),
+                            np.int8(-1))
+            # '.' anywhere -> missing (scalar: first non-digit allele
+            # aborts to -1); handled by d0/d2 being False for '.'
+            val = np.where(one, val1, np.where(tri, val3, np.int8(0)))
+            regular = one | tri
+            odd |= present & ~regular & (gtlen > 0)
+            row_ok = present & regular
+            cols["dosage"][row_ok, s] = val[row_ok]
+    odd_rows = np.flatnonzero(odd)
+    return cols, [(int(r), int(starts[r]), int(ends[r]))
+                  for r in odd_rows]
 
 
 def _iter_variant_tiles(cols_stream, cap: int, geometry: VariantGeometry
