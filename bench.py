@@ -270,12 +270,10 @@ def bench_cram(path: str):
     return {"metric": "cram_tensor_records_per_sec",
             "value": round(meas, 1), "unit": "records/s",
             "vs_baseline": round(meas / base, 3),
-            # tensor_batches currently WRAPS the record iterator (decode ->
-            # objects -> tiles), so this ratio is structurally <= 1: it
-            # tracks tensor-path overhead, not a speedup.  It becomes a
-            # real speedup metric when a columnar CRAM tile path lands.
-            "note": "ratio = tensor path / record iterator (overhead "
-                    "metric; tensor path is a superset of the baseline)"}
+            # both paths share the per-record entropy decode; the tensor
+            # path skips SamRecord/mate materialization but adds tile
+            # packing + device transfer, so the ratio tracks that trade
+            "note": "columnar tile path vs SamRecord iterator"}
 
 
 # ---------------------------------------------------------------------------

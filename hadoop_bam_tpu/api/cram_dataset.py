@@ -59,20 +59,45 @@ class CramDataset:
     def tensor_batches(self, mesh=None, geometry=None,
                        num_spans: Optional[int] = None) -> Iterator[Dict]:
         """Device-resident read batches (same layout as
-        FastqDataset.tensor_batches) decoded from CRAM containers."""
-        from hadoop_bam_tpu.formats.fastq import SequencedFragment
+        FastqDataset.tensor_batches) decoded from CRAM containers.
+
+        Columnar fast path: spans decode to pre-SAM CramRecords
+        (read_cram_span_raw) whose seq/qual pack straight into tiles —
+        no SamRecord materialization, no mate resolution, no per-record
+        Python packing."""
+        import numpy as np
+
+        from hadoop_bam_tpu.api.read_datasets import (
+            ragged_to_payload_tiles,
+        )
         from hadoop_bam_tpu.parallel.pipeline import (
             stream_read_tensor_batches,
         )
+        from hadoop_bam_tpu.split.cram_planner import read_cram_span_raw
 
-        def read_frags(span):
-            return [SequencedFragment(
-                sequence="" if r.seq == "*" else r.seq,
-                quality="" if r.qual == "*" else r.qual)
-                for r in self.read_span(span)]
+        from hadoop_bam_tpu.formats.cram_decode import CF_QUAL_STORED
+
+        def tiles(span, geom):
+            recs = read_cram_span_raw(self.path, span, header=self.header,
+                                      ref_source=self._ref_source)
+            seqs = [r.seq if r.seq != "*" else "" for r in recs]
+            seq_cat = "".join(seqs).encode("latin-1")
+            seq_lens = np.fromiter((len(s) for s in seqs), np.int64,
+                                   len(seqs))
+            # same gate as _to_sam: without CF_QUAL_STORED, r.qual is the
+            # decoder's 0xff filler, not data — those reads have qual '*'
+            quals = [r.qual if r.cf & CF_QUAL_STORED else b""
+                     for r in recs]
+            qual_cat = b"".join(quals)
+            qual_lens = np.fromiter((len(q) for q in quals), np.int64,
+                                    len(quals))
+            return ragged_to_payload_tiles(
+                seq_cat, seq_lens, qual_cat, qual_lens, geom.seq_stride,
+                geom.qual_stride, geom.max_len, qual_offset=0)
 
         yield from stream_read_tensor_batches(
-            self.spans(num_spans), read_frags, self.config, mesh, geometry)
+            self.spans(num_spans), None, self.config, mesh, geometry,
+            tiles_fn=tiles)
 
     def flagstat(self, mesh=None) -> Dict[str, int]:
         """Host-side flagstat over decoded CRAM records (same counters as

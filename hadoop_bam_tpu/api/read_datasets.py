@@ -332,6 +332,53 @@ def fastq_text_to_payload_tiles(text: bytes, seq_stride: int,
     return seq, qual, lengths
 
 
+def ragged_to_payload_tiles(seq_cat: bytes, seq_lens: np.ndarray,
+                            qual_cat: bytes, qual_lens: np.ndarray,
+                            seq_stride: int, qual_stride: int,
+                            max_len: int, qual_offset: int = 0
+                            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Concatenated ragged sequences/qualities -> payload tiles, fully
+    vectorized (the packing half of fastq_text_to_payload_tiles, for
+    producers that already hold decoded bytes — e.g. CRAM records).
+
+    ``qual_cat`` holds per-record quality runs of ``qual_lens`` bytes;
+    ``qual_offset`` is subtracted (0 when the bytes are already raw
+    Phred, 33 for printable ASCII).  Records with no quality simply have
+    qual_lens 0 — their tile rows stay zero."""
+    n = seq_lens.size
+    seq = np.zeros((n, seq_stride), dtype=np.uint8)
+    qual = np.zeros((n, qual_stride), dtype=np.uint8)
+    lengths = np.minimum(seq_lens, max_len).astype(np.int32)
+    if n == 0:
+        return seq, qual, lengths
+    sbuf = np.frombuffer(seq_cat, dtype=np.uint8)
+    qbuf = np.frombuffer(qual_cat, dtype=np.uint8)
+    s0 = np.cumsum(seq_lens, dtype=np.int64) - seq_lens
+    q0 = np.cumsum(qual_lens, dtype=np.int64) - qual_lens
+
+    L = int(lengths.max())
+    if L:
+        L_even = L + (L & 1)
+        col = np.arange(L_even, dtype=np.int64)[None, :]
+        mask = col < lengths[:, None]
+        g = np.minimum(s0[:, None] + col, max(sbuf.size - 1, 0))
+        codes = np.where(mask, _NIBBLE_CODE[sbuf[g]], 0).astype(np.uint8)
+        packed = (codes[:, 0::2] << 4) | codes[:, 1::2]
+        ks = min(packed.shape[1], seq_stride)
+        seq[:, :ks] = packed[:, :ks]
+
+    qlen = np.minimum(qual_lens, max_len).astype(np.int64)
+    Lq = int(qlen.max(initial=0))
+    if Lq and qbuf.size:
+        colq = np.arange(Lq, dtype=np.int64)[None, :]
+        maskq = colq < qlen[:, None]
+        gq = np.minimum(q0[:, None] + colq, qbuf.size - 1)
+        vals = np.where(maskq, qbuf[gq].astype(np.int16) - qual_offset, 0)
+        kq = min(Lq, qual_stride)
+        qual[:, :kq] = np.clip(vals, 0, 255).astype(np.uint8)[:, :kq]
+    return seq, qual, lengths
+
+
 def fragments_to_payload_tiles(frags: List[SequencedFragment],
                                seq_stride: int, qual_stride: int,
                                max_len: int

@@ -147,16 +147,21 @@ def read_cram_header(source) -> Tuple[SAMHeader, int]:
     raise CRAMError("first container carries no FILE_HEADER block")
 
 
-def decode_container(cont: Container, header: SAMHeader,
-                     ref_source: Optional[ReferenceSource] = None
-                     ) -> List[SamRecord]:
-    """Decode every slice of one data container into SAM records."""
+def decode_container_slices(cont: Container, header: SAMHeader,
+                            ref_source: Optional[ReferenceSource] = None
+                            ) -> List[Tuple[int, List["CramRecord"]]]:
+    """Decode one data container into per-slice pre-SAM CramRecord lists
+    (features resolved, mates NOT linked), each paired with its slice's
+    record-counter base.  The columnar stats path consumes these directly
+    — seq/qual/length are final here — skipping mate resolution and
+    SamRecord materialization; decode_container builds on this for the
+    full SAM view."""
     if cont.header.is_eof or not cont.blocks:
         return []
     if cont.blocks[0].content_type != COMPRESSION_HEADER:
         raise CRAMError("container does not start with a compression header")
     comp = CompressionHeader.from_bytes(cont.blocks[0].data)
-    out: List[SamRecord] = []
+    out: List[Tuple[int, List["CramRecord"]]] = []
     i = 1
     while i < len(cont.blocks):
         blk = cont.blocks[i]
@@ -176,11 +181,20 @@ def decode_container(cont: Container, header: SAMHeader,
                 external[b.content_id] = b.data
         records = decode_slice_records(comp, slice_hdr, core, external,
                                        header.ref_names, ref_source)
-        _resolve_mates(records)
-        base = slice_hdr.record_counter
+        out.append((slice_hdr.record_counter, records))
+        i += 1 + slice_hdr.n_blocks
+    return out
+
+
+def decode_container(cont: Container, header: SAMHeader,
+                     ref_source: Optional[ReferenceSource] = None
+                     ) -> List[SamRecord]:
+    """Decode every slice of one data container into SAM records."""
+    out: List[SamRecord] = []
+    for base, records in decode_container_slices(cont, header, ref_source):
+        _resolve_mates(records)      # NF chains never cross slices [SPEC]
         out.extend(_to_sam(r, header, base + j)
                    for j, r in enumerate(records))
-        i += 1 + slice_hdr.n_blocks
     return out
 
 

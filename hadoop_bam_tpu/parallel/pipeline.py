@@ -828,12 +828,17 @@ def make_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
 
 def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
                                mesh: Optional[Mesh],
-                               geometry: "Optional[PayloadGeometry]"
-                               ) -> Iterator[Dict]:
+                               geometry: "Optional[PayloadGeometry]",
+                               tiles_fn=None) -> Iterator[Dict]:
     """Shared tensor-batch generator for text/record read formats
     (FASTQ/QSEQ/CRAM): ``read_span_fn(span)`` returns a list of objects
     with ``.sequence``/``.quality`` attributes; yields sharded device
-    batches {seq_packed, qual, lengths, n_records}."""
+    batches {seq_packed, qual, lengths, n_records}.
+
+    ``tiles_fn(span, geometry)``, when given, replaces the whole
+    span->objects->tiles stage with a direct (seq, qual, lengths) tile
+    producer — the columnar fast path (CRAM uses it to skip SAM record
+    materialization entirely)."""
     from hadoop_bam_tpu.api.read_datasets import fragments_to_payload_tiles
     from hadoop_bam_tpu.parallel.mesh import make_mesh
 
@@ -849,6 +854,8 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         def decode(span):
             def inner(s):
+                if tiles_fn is not None:
+                    return tiles_fn(s, geometry)
                 return fragments_to_payload_tiles(
                     read_span_fn(s), geometry.seq_stride,
                     geometry.qual_stride, geometry.max_len)

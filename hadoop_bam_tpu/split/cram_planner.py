@@ -75,21 +75,41 @@ def plan_cram_spans(path: str, *, num_spans: Optional[int] = None,
     return spans
 
 
-def read_cram_span(source, span: FileByteSpan, *, header: SAMHeader,
-                   ref_source=None):
-    """Decode every container whose start lies in [span.start, span.end) —
-    the per-span idempotent unit of work (hb/CRAMRecordReader.java)."""
-    from hadoop_bam_tpu.formats.sam import SamRecord  # noqa: F401
+def _iter_span_containers(source, span: FileByteSpan):
+    """Containers whose start lies in [span.start, span.end) — the shared
+    walk behind both the SAM and the pre-SAM span readers."""
     if isinstance(source, (bytes, bytearray)):
         buf = bytes(source)
     else:
         with open(source, "rb") as f:
             buf = f.read()
-    out = []
     pos = span.start
     while pos < min(span.end, len(buf)):
         cont, pos = read_container(buf, pos)
         if cont.header.is_eof:
             break
+        yield cont
+
+
+def read_cram_span(source, span: FileByteSpan, *, header: SAMHeader,
+                   ref_source=None):
+    """Decode every container whose start lies in [span.start, span.end) —
+    the per-span idempotent unit of work (hb/CRAMRecordReader.java)."""
+    out = []
+    for cont in _iter_span_containers(source, span):
         out.extend(decode_container(cont, header, ref_source))
+    return out
+
+
+def read_cram_span_raw(source, span: FileByteSpan, *, header: SAMHeader,
+                       ref_source=None):
+    """Pre-SAM CramRecords of the span's containers (features resolved,
+    mates unlinked) — the stats tensor path's input; seq/qual/length are
+    final at this stage, so SamRecord materialization is skipped."""
+    from hadoop_bam_tpu.formats.cramio import decode_container_slices
+    out = []
+    for cont in _iter_span_containers(source, span):
+        for _base, records in decode_container_slices(cont, header,
+                                                      ref_source):
+            out.extend(records)
     return out

@@ -335,3 +335,75 @@ def test_host_decode_rejects_lying_out_size():
     p[5:9] = (len(data) + 64).to_bytes(4, "little")
     with pytest.raises(RansError):
         rans4x8_decode(bytes(p))
+
+
+def test_cram_tensor_tiles_match_record_iterator(tmp_path):
+    """The columnar fast path (pre-SAM CramRecords -> ragged pack) must
+    produce exactly the tiles the object path produced: same 4-bit base
+    codes, same Phred values, same lengths, same record order."""
+    import numpy as np
+
+    from hadoop_bam_tpu.api.cram_dataset import open_cram
+    from hadoop_bam_tpu.api.read_datasets import (
+        fragments_to_payload_tiles,
+    )
+    from hadoop_bam_tpu.formats.fastq import SequencedFragment
+    from hadoop_bam_tpu.parallel.pipeline import PayloadGeometry
+
+    header = make_header()
+    recs = make_records(header, 300, seed=23)
+    path = str(tmp_path / "p.cram")
+    write_cram(path, header, recs)
+    g = PayloadGeometry(max_len=160, tile_records=128, block_n=128)
+    ds = open_cram(path)
+    got_seq, got_qual, got_len = [], [], []
+    for batch in ds.tensor_batches(geometry=g):
+        counts = np.asarray(batch["n_records"])
+        for d in range(counts.size):
+            c = int(counts[d])
+            got_seq.append(np.asarray(batch["seq_packed"])[d, :c])
+            got_qual.append(np.asarray(batch["qual"])[d, :c])
+            got_len.append(np.asarray(batch["lengths"])[d, :c])
+    got_seq = np.concatenate(got_seq)
+    got_qual = np.concatenate(got_qual)
+    got_len = np.concatenate(got_len)
+
+    frags = [SequencedFragment(sequence="" if r.seq == "*" else r.seq,
+                               quality="" if r.qual == "*" else r.qual)
+             for r in open_cram(path).records()]
+    want_seq, want_qual, want_len = fragments_to_payload_tiles(
+        frags, g.seq_stride, g.qual_stride, g.max_len)
+    assert (got_len == want_len).all()
+    assert (got_seq == want_seq).all()
+    assert (got_qual == want_qual).all()
+
+
+def test_cram_tensor_tiles_quality_less_reads(tmp_path):
+    """Regression: reads stored without quality (CF_QUAL_STORED clear)
+    carry the decoder's 0xff filler in CramRecord.qual; the columnar
+    tiles path must emit zero quality rows like the object path, not
+    Phred-255 garbage."""
+    import numpy as np
+
+    from hadoop_bam_tpu.api.cram_dataset import open_cram
+    from hadoop_bam_tpu.formats.sam import SamRecord
+    from hadoop_bam_tpu.parallel.pipeline import PayloadGeometry
+
+    header = make_header()
+    recs = [SamRecord(qname=f"q{i}", flag=0, rname=header.ref_names[0],
+                      pos=100 + i, mapq=30, cigar="8M", rnext="*",
+                      pnext=0, tlen=0, seq="ACGTACGT",
+                      qual="*" if i % 2 == 0 else "IIIIIIII")
+            for i in range(20)]
+    path = str(tmp_path / "noq.cram")
+    write_cram(path, header, recs)
+    g = PayloadGeometry(max_len=32, tile_records=32, block_n=32)
+    ds = open_cram(path)
+    for batch in ds.tensor_batches(geometry=g):
+        counts = np.asarray(batch["n_records"])
+        qual = np.asarray(batch["qual"])
+        lens = np.asarray(batch["lengths"])
+        for d in range(counts.size):
+            for r in range(int(counts[d])):
+                row = qual[d, r, :int(lens[d, r])]
+                assert row.max(initial=0) <= 41, row  # never 0xff filler
