@@ -47,10 +47,33 @@ class _SpannedDataset:
             self._next_span += 1
             yield from recs
 
+    def _is_compressed(self) -> bool:
+        """gzip/BGZF input?  Compressed text reads as ONE span over the
+        inflated stream — the reference's behavior for non-splittable
+        Hadoop codecs."""
+        cached = getattr(self, "_compressed", None)
+        if cached is None:
+            with scoped_byte_source(self.path) as src:
+                cached = src.pread(0, 2) == b"\x1f\x8b"
+            self._compressed = cached
+        return cached
+
     def _plan_spans(self, num_spans: Optional[int]) -> List[FileByteSpan]:
+        if self._is_compressed():
+            with scoped_byte_source(self.path) as src:
+                return [FileByteSpan(self.path, 0, src.size)]
         return plan_text_spans(self.path, num_spans=num_spans,
                                span_bytes=None if num_spans
                                else self.config.split_size)
+
+    def _span_text(self, span: FileByteSpan, reader) -> bytes:
+        """Span text via ``reader(path, span)``, decompressing the whole
+        file for the single compressed-input span."""
+        if span.start == 0 and self._is_compressed():
+            import gzip
+            with open(self.path, "rb") as f:
+                return gzip.decompress(f.read())
+        return reader(self.path, span)
 
     def spans(self, num_spans: Optional[int] = None) -> List[FileByteSpan]:
         if self._plan is not None and num_spans is not None \
@@ -75,33 +98,13 @@ class _SpannedDataset:
 
 
 class FastqDataset(_SpannedDataset):
-    """Splittable FASTQ: record-quadruple alignment at every span boundary.
-
-    Compressed inputs (.gz / BGZF) are read as ONE span over the inflated
-    stream — the reference's behavior for non-splittable Hadoop codecs."""
-
-    def _is_compressed(self) -> bool:
-        cached = getattr(self, "_compressed", None)
-        if cached is None:
-            with scoped_byte_source(self.path) as src:
-                cached = src.pread(0, 2) == b"\x1f\x8b"
-            self._compressed = cached
-        return cached
-
-    def _plan_spans(self, num_spans: Optional[int]) -> List[FileByteSpan]:
-        if self._is_compressed():
-            with scoped_byte_source(self.path) as src:
-                return [FileByteSpan(self.path, 0, src.size)]
-        return super()._plan_spans(num_spans)
+    """Splittable FASTQ: record-quadruple alignment at every span
+    boundary; compressed inputs read as one span (base class)."""
 
     def read_span_text(self, span: FileByteSpan) -> bytes:
         """Raw record-aligned text of a span (whole file when gzipped) —
         the input to both the object parse and the vectorized tile path."""
-        if span.start == 0 and self._is_compressed():
-            import gzip
-            with open(self.path, "rb") as f:
-                return gzip.decompress(f.read())
-        return read_fastq_span(self.path, span)
+        return self._span_text(span, read_fastq_span)
 
     def read_span(self, span: FileByteSpan) -> List[SequencedFragment]:
         return parse_fastq(self.read_span_text(span),
@@ -129,9 +132,11 @@ class FastqDataset(_SpannedDataset):
 class QseqDataset(_SpannedDataset):
     """Illumina qseq: one record per line."""
 
+    def read_span_text(self, span: FileByteSpan) -> bytes:
+        return self._span_text(span, read_text_span)
+
     def read_span(self, span: FileByteSpan) -> List[SequencedFragment]:
-        text = read_text_span(self.path, span)
-        return parse_qseq(text,
+        return parse_qseq(self.read_span_text(span),
                           encoding=self.config.qseq_base_quality_encoding,
                           filter_failed_qc=self.config.qseq_filter_failed_qc)
 
@@ -247,6 +252,65 @@ for _c, _code in (("=", 0), ("A", 1), ("C", 2), ("M", 3), ("G", 4),
     _NIBBLE_CODE[ord(_c.lower())] = _code
 
 
+def _scan_lines(buf: np.ndarray) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """Newline scan -> CRLF-safe (starts, ends, synthesized_last) line
+    table.  A final line without a terminating newline still counts as a
+    line; ``synthesized_last`` marks it so callers can drop only THAT
+    line when it is empty (a real empty line must be kept or rejected by
+    format-specific rules)."""
+    nl = np.flatnonzero(buf == 0x0A)
+    synthesized_last = nl.size == 0 or nl[-1] != buf.size - 1
+    if synthesized_last:
+        nl = np.append(nl, buf.size)
+    starts = np.empty(nl.size, dtype=np.int64)
+    starts[0] = 0
+    starts[1:] = nl[:-1] + 1
+    ends = nl.copy()
+    has_cr = (ends > starts) & (buf[np.minimum(ends - 1, buf.size - 1)]
+                                == 0x0D)
+    ends = ends - has_cr
+    return starts, ends, synthesized_last
+
+
+def _pack_seq_qual_tiles(buf: np.ndarray, seq_starts: np.ndarray,
+                         qual_starts: np.ndarray, lengths: np.ndarray,
+                         seq_stride: int, qual_stride: int,
+                         qual_offset: int
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Gather per-record SEQ/QUAL runs into payload tiles: nibble-code +
+    pair-pack the bases, re-base the qualities with the wrong-encoding
+    guard (shared by the FASTQ and QSEQ grid tokenizers — their behavior
+    must stay byte-identical, so this is one function)."""
+    from hadoop_bam_tpu.formats.fastq import FastqError
+
+    n = lengths.size
+    seq = np.zeros((n, seq_stride), dtype=np.uint8)
+    qual = np.zeros((n, qual_stride), dtype=np.uint8)
+    L = int(lengths.max()) if n else 0
+    if not L:
+        return seq, qual
+    L_even = L + (L & 1)
+    col = np.arange(L_even, dtype=np.int64)[None, :]
+    mask = col < lengths[:, None]
+    g = np.minimum(seq_starts[:, None] + col, buf.size - 1)
+    codes = np.where(mask, _NIBBLE_CODE[buf[g]], 0).astype(np.uint8)
+    packed = (codes[:, 0::2] << 4) | codes[:, 1::2]
+    ks = min(packed.shape[1], seq_stride)
+    seq[:, :ks] = packed[:, :ks]
+
+    gq = np.minimum(qual_starts[:, None] + col[:, :L], buf.size - 1)
+    q = np.where(mask[:, :L], buf[gq].astype(np.int16) - qual_offset, 0)
+    if qual_offset != 33 and q.size:
+        # mirror convert_quality's wrong-encoding guard: re-based ASCII
+        # must stay printable, i.e. Phred in [0, 93]
+        if int(q.min()) < 0 or int(q.max()) > 93:
+            raise FastqError("quality out of range after re-encoding — "
+                             "wrong base-quality-encoding config?")
+    kq = min(L, qual_stride)
+    qual[:, :kq] = np.clip(q, 0, 255).astype(np.uint8)[:, :kq]
+    return seq, qual
+
+
 def fastq_text_to_payload_tiles(text: bytes, seq_stride: int,
                                 qual_stride: int, max_len: int,
                                 qual_offset: int = 33
@@ -270,22 +334,9 @@ def fastq_text_to_payload_tiles(text: bytes, seq_stride: int,
         return (np.zeros((0, seq_stride), np.uint8),
                 np.zeros((0, qual_stride), np.uint8),
                 np.zeros((0,), np.int32))
-    nl = np.flatnonzero(buf == 0x0A)
-    # A final line without a terminating newline still counts as a line
-    # (parse_fastq's split-then-pop yields the same set); track whether we
-    # synthesized it so only THAT line is dropped when empty — a real
-    # zero-length final line (legal zero-length read) must be kept.
-    synthesized_last = nl.size == 0 or nl[-1] != buf.size - 1
-    if synthesized_last:
-        nl = np.append(nl, buf.size)
-    starts = np.empty(nl.size, dtype=np.int64)
-    starts[0] = 0
-    starts[1:] = nl[:-1] + 1
-    ends = nl.copy()
-    # CRLF-safe: shrink lines whose last byte is \r
-    has_cr = (ends > starts) & (buf[np.minimum(ends - 1, buf.size - 1)]
-                                == 0x0D)
-    ends = ends - has_cr
+    starts, ends, synthesized_last = _scan_lines(buf)
+    # drop only the synthesized final line when empty — a real
+    # zero-length final line (legal zero-length read) must be kept
     if synthesized_last and starts[-1] >= ends[-1]:
         starts, ends = starts[:-1], ends[:-1]
     if starts.size % 4:
@@ -306,29 +357,56 @@ def fastq_text_to_payload_tiles(text: bytes, seq_stride: int,
     if not (seq_len == e4[:, 3] - s4[:, 3]).all():
         raise FastqError("SEQ/QUAL length mismatch")
     lengths = np.minimum(seq_len, max_len).astype(np.int32)
+    seq, qual = _pack_seq_qual_tiles(buf, s4[:, 1], s4[:, 3], lengths,
+                                     seq_stride, qual_stride, qual_offset)
+    return seq, qual, lengths
 
-    L = int(lengths.max()) if n else 0
-    L_even = L + (L & 1)
-    col = np.arange(L_even, dtype=np.int64)[None, :]
-    mask = col < lengths[:, None]
-    gather = np.minimum(s4[:, 1:2] + col, buf.size - 1)
-    codes = np.where(mask, _NIBBLE_CODE[buf[gather]], 0).astype(np.uint8)
-    packed = (codes[:, 0::2] << 4) | codes[:, 1::2]
-    seq = np.zeros((n, seq_stride), dtype=np.uint8)
-    ks = min(packed.shape[1], seq_stride)
-    seq[:, :ks] = packed[:, :ks]
 
-    gq = np.minimum(s4[:, 3:4] + col[:, :L], buf.size - 1)
-    q = np.where(mask[:, :L], buf[gq].astype(np.int16) - qual_offset, 0)
-    if qual_offset != 33 and q.size:
-        # mirror convert_quality's wrong-encoding guard: re-based ASCII
-        # must stay printable, i.e. Phred in [0, 93]
-        if int(q.min()) < 0 or int(q.max()) > 93:
-            raise FastqError("quality out of range after re-encoding — "
-                             "wrong base-quality-encoding config?")
-    qual = np.zeros((n, qual_stride), dtype=np.uint8)
-    kq = min(L, qual_stride)
-    qual[:, :kq] = np.clip(q, 0, 255).astype(np.uint8)[:, :kq]
+def qseq_text_to_payload_tiles(text: bytes, seq_stride: int,
+                               qual_stride: int, max_len: int,
+                               qual_offset: int = 64
+                               ) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]:
+    """Vectorized QSEQ span -> payload tiles (the 11-tab-field twin of
+    fastq_text_to_payload_tiles): newline/tab grid -> one gather each for
+    the SEQ (field 8; '.' reads as N via the nibble table) and QUAL
+    (field 9, Illumina +64 by default) columns.  Validation matches
+    parse_qseq: exactly 11 fields, SEQ/QUAL equal length, loud
+    wrong-encoding guard."""
+    from hadoop_bam_tpu.formats.fastq import FastqError
+
+    buf = np.frombuffer(text, dtype=np.uint8)
+    empty = (np.zeros((0, seq_stride), np.uint8),
+             np.zeros((0, qual_stride), np.uint8),
+             np.zeros((0,), np.int32))
+    if buf.size == 0:
+        return empty
+    starts, ends, _synth = _scan_lines(buf)
+    keep = ends > starts                    # parse_qseq skips empty lines
+    starts, ends = starts[keep], ends[keep]
+    n = starts.size
+    if n == 0:
+        return empty
+
+    tabs = np.flatnonzero(buf == 0x09)
+    t0 = np.searchsorted(tabs, starts)
+    t1 = np.searchsorted(tabs, ends)
+    ntab = t1 - t0
+    if not (ntab == 10).all():
+        bad = int(np.flatnonzero(ntab != 10)[0])
+        raise FastqError(f"qseq line has {int(ntab[bad]) + 1} fields, "
+                         f"need 11")
+    k = np.arange(10, dtype=np.int64)[None, :]
+    tabm = tabs[t0[:, None] + k]
+    fs = np.concatenate([starts[:, None], tabm + 1], axis=1)
+    fe = np.concatenate([tabm, ends[:, None]], axis=1)
+    seq_len = fe[:, 8] - fs[:, 8]
+    qual_len = fe[:, 9] - fs[:, 9]
+    if not (seq_len == qual_len).all():
+        raise FastqError("qseq SEQ/QUAL length mismatch")
+    lengths = np.minimum(seq_len, max_len).astype(np.int32)
+    seq, qual = _pack_seq_qual_tiles(buf, fs[:, 8], fs[:, 9], lengths,
+                                     seq_stride, qual_stride, qual_offset)
     return seq, qual, lengths
 
 

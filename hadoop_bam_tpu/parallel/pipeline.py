@@ -944,7 +944,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     payload kernel."""
     from hadoop_bam_tpu.api.read_datasets import (
         fastq_text_to_payload_tiles, fragments_to_payload_tiles,
-        open_fastq, open_qseq,
+        open_fastq, open_qseq, qseq_text_to_payload_tiles,
     )
     from hadoop_bam_tpu.parallel.mesh import make_mesh
 
@@ -958,9 +958,16 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     is_qseq = lower.endswith(QSEQ_EXTS)
     ds = open_qseq(path, config) if is_qseq else open_fastq(path, config)
     # Vectorized tokenize (no per-read Python objects) whenever the config
-    # doesn't force the object path: failed-QC filtering needs parsed names.
-    fast_tiles = not is_qseq and not config.fastq_filter_failed_qc
-    qual_offset = config.fastq_base_quality_encoding.value
+    # doesn't force the object path: failed-QC filtering needs parsed
+    # fields (qseq's filter column / fastq's name metadata).
+    if is_qseq:
+        fast_tiles = not config.qseq_filter_failed_qc
+        qual_offset = config.qseq_base_quality_encoding.value
+        text_to_tiles = qseq_text_to_payload_tiles
+    else:
+        fast_tiles = not config.fastq_filter_failed_qc
+        qual_offset = config.fastq_base_quality_encoding.value
+        text_to_tiles = fastq_text_to_payload_tiles
     spans = ds.spans()
     step = make_read_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
@@ -971,7 +978,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         def decode(span):
             def inner(s):
                 if fast_tiles:
-                    return fastq_text_to_payload_tiles(
+                    return text_to_tiles(
                         ds.read_span_text(s), geometry.seq_stride,
                         geometry.qual_stride, geometry.max_len, qual_offset)
                 frags = ds.read_span(s)

@@ -22,7 +22,9 @@ from hadoop_bam_tpu.formats.fasta import parse_fasta
 from hadoop_bam_tpu.formats.fastq import (
     SequencedFragment, convert_quality, find_fastq_record_start, parse_fastq,
 )
-from hadoop_bam_tpu.formats.qseq import format_qseq_line, parse_qseq_line
+from hadoop_bam_tpu.formats.qseq import (
+    format_qseq_line, parse_qseq, parse_qseq_line,
+)
 from hadoop_bam_tpu.split.read_planners import read_fastq_span
 from hadoop_bam_tpu.split.spans import FileByteSpan
 
@@ -354,3 +356,64 @@ def test_fastq_vectorized_tiles_wrong_encoding_guard():
     text = b"@a\nACGT\n+\n!!!!\n"   # '!' = 33, below the +64 offset
     with pytest.raises(FastqError):
         fastq_text_to_payload_tiles(text, 8, 8, 8, qual_offset=64)
+
+
+@pytest.mark.parametrize("crlf", [False, True])
+def test_qseq_vectorized_tiles_parity(crlf):
+    """qseq_text_to_payload_tiles must match the object path exactly,
+    including '.'-as-N and the Illumina +64 re-base."""
+    from hadoop_bam_tpu.api.read_datasets import (
+        fragments_to_payload_tiles, qseq_text_to_payload_tiles,
+    )
+    from hadoop_bam_tpu.formats.qseq import format_qseq_line
+    frags = make_fragments(120, seed=8)
+    lines = [format_qseq_line(f) for f in frags]
+    sep = "\r\n" if crlf else "\n"
+    text = (sep.join(lines) + sep).encode()
+    want = fragments_to_payload_tiles(
+        parse_qseq(text), 80, 160, 160)
+    got = qseq_text_to_payload_tiles(text, 80, 160, 160)
+    for w, g in zip(want, got):
+        assert w.shape == g.shape and (w == g).all()
+
+
+def test_qseq_vectorized_tiles_malformed():
+    from hadoop_bam_tpu.api.read_datasets import qseq_text_to_payload_tiles
+    from hadoop_bam_tpu.formats.fastq import FastqError
+    with pytest.raises(FastqError, match="fields"):
+        qseq_text_to_payload_tiles(b"a\tb\tc\n", 8, 8, 8)
+    with pytest.raises(FastqError, match="mismatch"):
+        qseq_text_to_payload_tiles(
+            b"M\t1\t1\t1\t1\t1\t0\t1\tACGT\tab\t1\n", 8, 8, 8)
+    with pytest.raises(FastqError, match="re-encoding"):
+        # Sanger-range qualities under the +64 default
+        qseq_text_to_payload_tiles(
+            b"M\t1\t1\t1\t1\t1\t0\t1\tACGT\t!!!!\t1\n", 8, 8, 8)
+    assert all(a.size == 0 for a in
+               qseq_text_to_payload_tiles(b"", 8, 8, 8))
+
+
+def test_qseq_gz_single_span_and_stats(tmp_path):
+    """Compressed qseq input must read as ONE span over the inflated
+    stream (splitting a gzip byte stream yields garbage) — both the
+    record iterator and the vectorized stats driver."""
+    import gzip
+
+    import numpy as _np
+
+    frags = make_fragments(150, seed=14)
+    plain = str(tmp_path / "r.qseq")
+    with QseqShardWriter(plain) as w:
+        for f in frags:
+            w.write_record(f)
+    gz = str(tmp_path / "r.qseq.gz")
+    with open(plain, "rb") as fi, gzip.open(gz, "wb") as fo:
+        fo.write(fi.read())
+    ds = open_qseq(gz)
+    assert len(ds.spans()) == 1
+    got = [f.sequence for f in ds.records()]
+    assert got == [f.sequence for f in frags]
+
+    from hadoop_bam_tpu.parallel.pipeline import fastq_seq_stats_file
+    stats = fastq_seq_stats_file(gz)
+    assert stats["n_reads"] == len(frags)

@@ -213,3 +213,35 @@ def test_fasta_window_tensor_batches(tmp_path):
     # 700 -> 1 short window; 1500 -> ceil((1500-1024)/1024)+... starts
     # {0, 476}; 2300 -> starts {0, 1024, 1276}
     assert windows == 1 + 2 + 3
+
+
+def test_qseq_stats_driver(tmp_path):
+    """QSEQ through the payload stats driver (vectorized fast path) must
+    match a host oracle computed from the parsed fragments."""
+    import random
+
+    from hadoop_bam_tpu.api.writers import QseqShardWriter
+    from hadoop_bam_tpu.formats.fastq import SequencedFragment
+    from hadoop_bam_tpu.parallel.pipeline import fastq_seq_stats_file
+
+    rng = random.Random(13)
+    frags = []
+    for i in range(800):
+        n = rng.randint(30, 150)
+        seq = "".join(rng.choice("ACGTN") for _ in range(n))
+        qual = "".join(chr(33 + rng.randint(0, 41)) for _ in range(n))
+        f = SequencedFragment.from_name(
+            f"M:1:F:1:{i}:{i}:{i} 1:N:0:AAA", seq, qual)
+        frags.append(f)
+    path = str(tmp_path / "r.qseq")
+    with QseqShardWriter(path) as w:
+        for f in frags:
+            w.write_record(f)
+    stats = fastq_seq_stats_file(path, geometry=GEOM)
+    assert stats["n_reads"] == len(frags)
+    gcs = [sum(1 for c in f.sequence[:GEOM.max_len] if c in "GC")
+           / len(f.sequence[:GEOM.max_len]) for f in frags]
+    mqs = [sum(ord(c) - 33 for c in f.quality[:GEOM.max_len])
+           / len(f.quality[:GEOM.max_len]) for f in frags]
+    assert abs(stats["mean_gc"] - float(np.mean(gcs))) < 1e-6
+    assert abs(stats["mean_qual"] - float(np.mean(mqs))) < 1e-4
