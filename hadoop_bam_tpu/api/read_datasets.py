@@ -275,17 +275,36 @@ def _scan_lines(buf: np.ndarray) -> Tuple[np.ndarray, np.ndarray, bool]:
 def _pack_seq_qual_tiles(buf: np.ndarray, seq_starts: np.ndarray,
                          qual_starts: np.ndarray, lengths: np.ndarray,
                          seq_stride: int, qual_stride: int,
-                         qual_offset: int
+                         qual_offset: int,
+                         guard_lens: Optional[np.ndarray] = None
                          ) -> Tuple[np.ndarray, np.ndarray]:
     """Gather per-record SEQ/QUAL runs into payload tiles: nibble-code +
     pair-pack the bases, re-base the qualities with the wrong-encoding
     guard (shared by the FASTQ and QSEQ grid tokenizers — their behavior
-    must stay byte-identical, so this is one function)."""
+    must stay byte-identical, so this is one function).
+
+    ``guard_lens`` is the UNTRUNCATED quality-field length per record:
+    the object parsers (convert_quality) validate the whole string, not
+    just the max_len prefix the tiles keep, so the guard must too."""
     from hadoop_bam_tpu.formats.fastq import FastqError
 
     n = lengths.size
     seq = np.zeros((n, seq_stride), dtype=np.uint8)
     qual = np.zeros((n, qual_stride), dtype=np.uint8)
+    if qual_offset != 33 and n and guard_lens is not None             and guard_lens.size:
+        Lg = int(guard_lens.max())
+        if Lg:
+            colg = np.arange(Lg, dtype=np.int64)[None, :]
+            maskg = colg < guard_lens[:, None]
+            gg = np.minimum(qual_starts[:, None] + colg, buf.size - 1)
+            vals = buf[gg].astype(np.int16) - qual_offset
+            # mirror convert_quality: re-based ASCII must stay printable,
+            # i.e. Phred in [0, 93], over the FULL field
+            bad = maskg & ((vals < 0) | (vals > 93))
+            if bad.any():
+                raise FastqError(
+                    "quality out of range after re-encoding — wrong "
+                    "base-quality-encoding config?")
     L = int(lengths.max()) if n else 0
     if not L:
         return seq, qual
@@ -300,12 +319,6 @@ def _pack_seq_qual_tiles(buf: np.ndarray, seq_starts: np.ndarray,
 
     gq = np.minimum(qual_starts[:, None] + col[:, :L], buf.size - 1)
     q = np.where(mask[:, :L], buf[gq].astype(np.int16) - qual_offset, 0)
-    if qual_offset != 33 and q.size:
-        # mirror convert_quality's wrong-encoding guard: re-based ASCII
-        # must stay printable, i.e. Phred in [0, 93]
-        if int(q.min()) < 0 or int(q.max()) > 93:
-            raise FastqError("quality out of range after re-encoding — "
-                             "wrong base-quality-encoding config?")
     kq = min(L, qual_stride)
     qual[:, :kq] = np.clip(q, 0, 255).astype(np.uint8)[:, :kq]
     return seq, qual
@@ -358,7 +371,8 @@ def fastq_text_to_payload_tiles(text: bytes, seq_stride: int,
         raise FastqError("SEQ/QUAL length mismatch")
     lengths = np.minimum(seq_len, max_len).astype(np.int32)
     seq, qual = _pack_seq_qual_tiles(buf, s4[:, 1], s4[:, 3], lengths,
-                                     seq_stride, qual_stride, qual_offset)
+                                     seq_stride, qual_stride, qual_offset,
+                                     guard_lens=seq_len)
     return seq, qual, lengths
 
 
@@ -406,7 +420,8 @@ def qseq_text_to_payload_tiles(text: bytes, seq_stride: int,
         raise FastqError("qseq SEQ/QUAL length mismatch")
     lengths = np.minimum(seq_len, max_len).astype(np.int32)
     seq, qual = _pack_seq_qual_tiles(buf, fs[:, 8], fs[:, 9], lengths,
-                                     seq_stride, qual_stride, qual_offset)
+                                     seq_stride, qual_stride, qual_offset,
+                                     guard_lens=seq_len)
     return seq, qual, lengths
 
 

@@ -270,3 +270,20 @@ def test_vcf_sort_undeclared_contigs(tmp_path):
     assert sort_vcf(path, out, run_records=100) == 700  # forces 7 runs
     got = [r.pos for r in open_vcf(out).records()]
     assert got == sorted(got) and len(got) == 700
+
+
+def test_coverage_verb(bam_file, tmp_path, capsys):
+    path, header, recs = bam_file
+    rname = header.ref_names[0]
+    bg = str(tmp_path / "d.bedgraph")
+    assert main(["coverage", path, f"{rname}:1-50,000",
+                 "--bedgraph", bg]) == 0
+    out = capsys.readouterr().out
+    assert "mean_depth\t" in out and f"region\t{rname}:1-50000" in out
+    # bedgraph runs agree with the printed covered-base count
+    covered = int(next(l.split("\t")[1] for l in out.splitlines()
+                       if l.startswith("covered")))
+    runs = [l.split("\t") for l in open(bg).read().splitlines()]
+    assert sum(int(e) - int(s) for _, s, e, _ in runs) == covered
+    # bad region is a loud error (main maps ValueError to exit 1)
+    assert main(["coverage", path, "chrNOPE:1-100"]) == 1

@@ -399,8 +399,6 @@ def test_qseq_gz_single_span_and_stats(tmp_path):
     record iterator and the vectorized stats driver."""
     import gzip
 
-    import numpy as _np
-
     frags = make_fragments(150, seed=14)
     plain = str(tmp_path / "r.qseq")
     with QseqShardWriter(plain) as w:
@@ -417,3 +415,15 @@ def test_qseq_gz_single_span_and_stats(tmp_path):
     from hadoop_bam_tpu.parallel.pipeline import fastq_seq_stats_file
     stats = fastq_seq_stats_file(gz)
     assert stats["n_reads"] == len(frags)
+
+
+def test_qseq_vectorized_guard_covers_full_field():
+    """The wrong-encoding guard must inspect the WHOLE quality field, not
+    just the max_len prefix — parity with parse_qseq/convert_quality."""
+    from hadoop_bam_tpu.api.read_datasets import qseq_text_to_payload_tiles
+    from hadoop_bam_tpu.formats.fastq import FastqError
+    line = b"M\t1\t1\t1\t1\t1\t0\t1\tACGTAC\tabcd!!\t1\n"
+    with pytest.raises(FastqError, match="re-encoding"):
+        qseq_text_to_payload_tiles(line, 8, 8, 4)   # bad bytes past max_len
+    with pytest.raises(FastqError):
+        parse_qseq(line)                            # object path agrees
