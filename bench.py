@@ -379,6 +379,21 @@ def bench_split_guess(path: str):
     return out
 
 
+def _collect_record_bytes(path: str, n: int):
+    """First n raw record byte strings from a BAM (shared by the sort and
+    write benches)."""
+    from hadoop_bam_tpu.api.dataset import open_bam
+
+    ds = open_bam(path)
+    recs = []
+    for batch in ds.batches():
+        for i in range(len(batch)):
+            recs.append(batch.record_bytes(i))
+            if len(recs) >= n:
+                return ds, recs
+    return ds, recs
+
+
 def bench_sort(path: str):
     """Mesh bucketed sort (device keys + all_to_all) vs the single-process
     spill-merge sort on a shuffled slice of the main fixture."""
@@ -395,17 +410,8 @@ def bench_sort(path: str):
     if not os.path.exists(src):
         import random as _random
 
-        from hadoop_bam_tpu.api.dataset import open_bam
         from hadoop_bam_tpu.formats.bamio import BamWriter
-        ds = open_bam(path)
-        recs = []
-        for batch in ds.batches():
-            for i in range(len(batch)):
-                recs.append(batch.record_bytes(i))
-                if len(recs) >= n_slice:
-                    break
-            if len(recs) >= n_slice:
-                break
+        ds, recs = _collect_record_bytes(path, n_slice)
         _random.Random(9).shuffle(recs)
         with BamWriter(src + ".tmp", ds.header) as w:
             for r in recs:
@@ -435,6 +441,45 @@ def bench_sort(path: str):
             # 8-device CPU mesh the same code is byte-identical to and
             # competitive with the single-process sort (test_mesh_sort).
             "note": "end-to-end incl. tunneled H2D of span bytes"}
+
+
+def bench_bam_write(path: str):
+    """Write path: re-encode a decoded slice through BamWriter (native
+    libdeflate BGZF) vs the same pipeline forced onto Python zlib —
+    the reference's BlockCompressedOutputStream analog."""
+    import io
+
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.utils import native as nat
+
+    if not nat.available():
+        return {"metric": "bam_write_records_per_sec", "value": 0.0,
+                "unit": "records/s",
+                "note": "native deflate unavailable; zlib-vs-zlib would "
+                        "be a vacuous baseline"}
+    n_slice = min(BENCH_RECORDS, 100_000)
+    ds, recs = _collect_record_bytes(path, n_slice)
+
+    def write_with(use_native: bool):
+        saved = nat._lib, nat._tried
+        if not use_native:
+            nat._lib, nat._tried = None, True    # force zlib fallback
+        try:
+            sink = io.BytesIO()
+            with BamWriter(sink, ds.header) as w:
+                for r in recs:
+                    w.write_record_bytes(r)
+            return sink.tell()
+        finally:
+            nat._lib, nat._tried = saved
+
+    _, dt = _median_time(lambda: write_with(True), reps=3)
+    _, bdt = _median_time(lambda: write_with(False), reps=3)
+    meas = len(recs) / dt
+    base = len(recs) / bdt
+    return {"metric": "bam_write_records_per_sec",
+            "value": round(meas, 1), "unit": "records/s",
+            "vs_baseline": round(meas / base, 3)}
 
 
 def bench_coverage(path: str):
@@ -553,6 +598,7 @@ def main() -> None:
         bench_split_guess(path),
         bench_sort(path),
         bench_coverage(path),
+        bench_bam_write(path),
     ]
     print(json.dumps({
         "metric": "bam_decode_records_per_sec_per_chip",
