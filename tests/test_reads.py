@@ -427,3 +427,29 @@ def test_qseq_vectorized_guard_covers_full_field():
         qseq_text_to_payload_tiles(line, 8, 8, 4)   # bad bytes past max_len
     with pytest.raises(FastqError):
         parse_qseq(line)                            # object path agrees
+
+
+def test_ragged_to_payload_tiles_edges():
+    """Direct unit tests for the shared ragged packer: empty input,
+    missing qualities, truncation, and parity with the fragment path."""
+    from hadoop_bam_tpu.api.read_datasets import (
+        fragments_to_payload_tiles, ragged_to_payload_tiles,
+    )
+    s, q, l = ragged_to_payload_tiles(b"", np.zeros(0, np.int64), b"",
+                                      np.zeros(0, np.int64), 8, 8, 8)
+    assert s.shape == (0, 8) and q.shape == (0, 8) and l.size == 0
+
+    seqs = ["ACGT", "", "GGNNTT", "A" * 50]
+    quals = [bytes([30, 31, 32, 33]), b"", b"", bytes(range(50))]
+    seq_cat = "".join(seqs).encode()
+    got = ragged_to_payload_tiles(
+        seq_cat, np.asarray([len(x) for x in seqs], np.int64),
+        b"".join(quals), np.asarray([len(x) for x in quals], np.int64),
+        16, 32, 32, qual_offset=0)
+    frags = [SequencedFragment(
+        sequence=s_, quality="".join(chr(33 + b) for b in q_))
+        for s_, q_ in zip(seqs, quals)]
+    want = fragments_to_payload_tiles(frags, 16, 32, 32)
+    for w, g in zip(want, got):
+        assert w.shape == g.shape and (w == g).all()
+    assert got[2].tolist() == [4, 0, 6, 32]   # truncation at max_len
