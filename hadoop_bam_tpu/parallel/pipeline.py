@@ -1285,7 +1285,7 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
     """
     from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.parallel.mesh import make_mesh
-    from hadoop_bam_tpu.split.intervals import Interval, parse_interval
+    from hadoop_bam_tpu.split.intervals import Interval, resolve_interval
 
     if mesh is None:
         mesh = make_mesh()
@@ -1293,7 +1293,7 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
     if header is None:
         header, _ = read_bam_header(path)
     if not isinstance(region, Interval):
-        region = parse_interval(region)
+        region = resolve_interval(region, header.ref_names)
     if region.rname not in header.ref_names:
         raise ValueError(f"region reference {region.rname!r} not in header")
     target_refid = header.ref_names.index(region.rname)
@@ -1308,14 +1308,18 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
     win_start = region.start - 1          # 0-based half-open window
 
     if spans is None:
-        cfg = dataclasses.replace(config, bam_intervals=str(region))
-        from hadoop_bam_tpu.split.planners import plan_spans_maybe_intervals
-        span_bytes = 4 << 20
-        src = as_byte_source(path)
-        n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
-        src.close()
-        spans = plan_spans_maybe_intervals(path, header, cfg,
-                                           num_spans=n_spans)
+        # pass the Interval OBJECT to the planner — round-tripping it
+        # through the config string form would misparse contig names
+        # that themselves contain ':' (GRCh38 HLA alts)
+        from hadoop_bam_tpu.split.bai import plan_interval_spans
+        spans = plan_interval_spans(path, [region], header)
+        if spans is None:                   # no .bai sidecar: whole file
+            span_bytes = 4 << 20
+            src = as_byte_source(path)
+            n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
+            src.close()
+            spans = plan_bam_spans(path, num_spans=n_spans, config=config,
+                                   header=header)
 
     sharding = NamedSharding(mesh, P("data"))
     rep = NamedSharding(mesh, P())

@@ -59,6 +59,30 @@ def parse_interval(text: str) -> Interval:
     return Interval(m.group("chr"), start, end)
 
 
+def resolve_interval(text: str,
+                     ref_names: Optional[Sequence[str]] = None
+                     ) -> Interval:
+    """One region with samtools-style resolution against a reference
+    dictionary: a verbatim contig name is a whole-contig interval even
+    when it contains ':' (GRCh38 ALT/HLA names); otherwise the LONGEST
+    known contig name followed by ':range' wins; otherwise the plain
+    chr:start-end grammar applies."""
+    t = text.strip()
+    known = set(ref_names or ())
+    if t in known:
+        return Interval(t)
+    if known and ":" in t:
+        best = None
+        for n in known:
+            if t.startswith(n + ":") and (best is None
+                                          or len(n) > len(best)):
+                best = n
+        if best is not None:
+            rng = parse_interval("x:" + t[len(best) + 1:])
+            return Interval(best, rng.start, rng.end)
+    return parse_interval(t)
+
+
 def parse_intervals(text: str,
                     ref_names: Optional[Sequence[str]] = None
                     ) -> List[Interval]:

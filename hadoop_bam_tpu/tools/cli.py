@@ -15,6 +15,7 @@ Each verb works on local paths and prints to stdout; exit code != 0 on error.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional, Tuple
 
@@ -58,7 +59,11 @@ def _view_sam(args, fmt) -> int:
     if args.header_only:
         sys.stdout.write(header.to_sam_text())
         return 0
-    region = _parse_region(args.region) if args.region else None
+    region = None
+    if args.region:
+        from hadoop_bam_tpu.split.intervals import resolve_interval
+        iv = resolve_interval(args.region, header.ref_names)
+        region = (iv.rname, iv.start, iv.end)
     rid = header.ref_id(region[0]) if region else -2
     if region and rid < 0:
         print(f"unknown reference {region[0]!r}", file=sys.stderr)
@@ -117,7 +122,11 @@ def _view_vcf(args) -> int:
     if args.header_only:
         sys.stdout.write(ds.header.to_text())
         return 0
-    region = _parse_region(args.region) if args.region else None
+    region = None
+    if args.region:
+        from hadoop_bam_tpu.split.intervals import resolve_interval
+        iv = resolve_interval(args.region, ds.header.contigs)
+        region = (iv.rname, iv.start, iv.end)
     n = 0
     if not args.count and not args.no_header:
         sys.stdout.write(ds.header.to_text())
@@ -203,32 +212,94 @@ def cmd_summarize(args) -> int:
 # the closest is `summarize`, which these extend to payload columns)
 # ---------------------------------------------------------------------------
 
+_COVERAGE_TILE = 1 << 24        # bases per coverage_file call
+
+
 def cmd_coverage(args) -> int:
+    import contextlib
+
     import numpy as np
 
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.parallel.pipeline import coverage_file
-    from hadoop_bam_tpu.split.intervals import parse_interval
+    from hadoop_bam_tpu.split.intervals import Interval, resolve_interval
 
-    region = parse_interval(args.region)
-    depth = coverage_file(args.input, region, max_cigar=args.max_cigar)
-    covered = int((depth > 0).sum())
-    print(f"region\t{region}")
-    print(f"bases\t{depth.size}")
+    header, _ = read_bam_header(args.input)
+    region = resolve_interval(args.region, header.ref_names)
+    if region.rname not in header.ref_names:
+        raise ValueError(f"region reference {region.rname!r} not in header")
+    ref_len = header.ref_lengths[header.ref_names.index(region.rname)]
+    start, end = region.start, min(region.end, ref_len)
+    if end < start:
+        raise ValueError(f"empty region {region}")
+
+    # a bare contig name means the whole reference — tile it through
+    # fixed-size windows so device memory stays bounded and the jit
+    # caches one window shape.  The mesh is built once; without a .bai
+    # sidecar every tile must stream the whole file, so say so.
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.split.bai import load_bai_for
+    mesh = make_mesh()
+    n_tiles = (end - start) // _COVERAGE_TILE + 1
+    if n_tiles > 1 and load_bai_for(args.input) is None:
+        print(f"note: {n_tiles} tiles with no genomic index sidecar — "
+              f"every tile streams the whole file; run "
+              f"'hbam index --flavor bai' first for region-pruned reads",
+              file=sys.stderr)
+    total = covered = max_depth = 0
+    depth_sum = 0
+    bg_tmp = args.bedgraph + ".tmp" if args.bedgraph else None
+    try:
+        with (open(bg_tmp, "w") if bg_tmp
+              else contextlib.nullcontext()) as bg:
+            pending = None               # (start0, end0, depth) run buffer
+            for lo in range(start, end + 1, _COVERAGE_TILE):
+                hi = min(lo + _COVERAGE_TILE - 1, end)
+                depth = coverage_file(args.input,
+                                      Interval(region.rname, lo, hi),
+                                      mesh=mesh, header=header,
+                                      max_cigar=args.max_cigar)
+                total += depth.size
+                covered += int((depth > 0).sum())
+                depth_sum += int(depth.sum(dtype=np.int64))
+                if depth.size:
+                    max_depth = max(max_depth, int(depth.max()))
+                if bg is not None:
+                    # run-length encode, merging runs across tile
+                    # boundaries (0-based half-open [bedGraph])
+                    edges = np.flatnonzero(np.diff(depth)) + 1
+                    starts = np.concatenate([[0], edges])
+                    ends = np.concatenate([edges, [depth.size]])
+                    base = lo - 1
+                    for s, e in zip(starts, ends):
+                        d = int(depth[s])
+                        if not d:
+                            continue
+                        if pending and pending[1] == base + s \
+                                and pending[2] == d:
+                            pending = (pending[0], base + e, d)
+                        else:
+                            if pending:
+                                bg.write(f"{region.rname}\t{pending[0]}"
+                                         f"\t{pending[1]}\t{pending[2]}\n")
+                            pending = (base + s, base + e, d)
+            if bg is not None and pending:
+                bg.write(f"{region.rname}\t{pending[0]}\t{pending[1]}"
+                         f"\t{pending[2]}\n")
+    except BaseException:
+        # never leave a truncated-but-plausible bedGraph behind
+        if bg_tmp and os.path.exists(bg_tmp):
+            os.unlink(bg_tmp)
+        raise
+    if bg_tmp:
+        os.replace(bg_tmp, args.bedgraph)
+
+    print(f"region\t{region.rname}:{start}-{end}")
+    print(f"bases\t{total}")
     print(f"covered\t{covered}")
-    print(f"mean_depth\t{float(depth.mean()):.4f}")
-    print(f"max_depth\t{int(depth.max()) if depth.size else 0}")
+    print(f"mean_depth\t{depth_sum / total if total else 0.0:.4f}")
+    print(f"max_depth\t{max_depth}")
     if args.bedgraph:
-        # run-length encode equal-depth runs, 0-based half-open [bedGraph]
-        edges = np.flatnonzero(np.diff(depth)) + 1
-        starts = np.concatenate([[0], edges])
-        ends = np.concatenate([edges, [depth.size]])
-        base = region.start - 1
-        with open(args.bedgraph, "w") as f:
-            for s, e in zip(starts, ends):
-                d = int(depth[s])
-                if d:
-                    f.write(f"{region.rname}\t{base + s}\t{base + e}"
-                            f"\t{d}\n")
         print(f"wrote {args.bedgraph}")
     return 0
 

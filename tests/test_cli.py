@@ -287,3 +287,63 @@ def test_coverage_verb(bam_file, tmp_path, capsys):
     assert sum(int(e) - int(s) for _, s, e, _ in runs) == covered
     # bad region is a loud error (main maps ValueError to exit 1)
     assert main(["coverage", path, "chrNOPE:1-100"]) == 1
+
+
+def test_coverage_whole_contig_and_tiling(bam_file, tmp_path, capsys,
+                                          monkeypatch):
+    """A bare contig name covers the whole reference by tiling windows;
+    runs merge seamlessly across tile boundaries."""
+    import hadoop_bam_tpu.tools.cli as cli
+    path, header, recs = bam_file
+    rname = header.ref_names[0]
+    # per-region ground truth from the untiled driver path
+    bg1 = str(tmp_path / "one.bedgraph")
+    assert main(["coverage", path, f"{rname}:1-60,000",
+                 "--bedgraph", bg1]) == 0
+    out1 = capsys.readouterr().out
+    # force tiny tiles so the merge logic really runs
+    monkeypatch.setattr(cli, "_COVERAGE_TILE", 7_000)
+    bg2 = str(tmp_path / "tiled.bedgraph")
+    assert main(["coverage", path, f"{rname}:1-60,000",
+                 "--bedgraph", bg2]) == 0
+    out2 = capsys.readouterr().out
+    assert out1 == out2.replace("wrote " + bg2, "wrote " + bg1)
+    assert open(bg1).read() == open(bg2).read()
+
+
+def test_coverage_whole_contig_bare_name(bam_file, capsys):
+    path, header, recs = bam_file
+    assert main(["coverage", path, header.ref_names[0]]) == 0
+    out = capsys.readouterr().out
+    assert f"region\t{header.ref_names[0]}:1-{header.ref_lengths[0]}" in out
+
+
+def test_coverage_colon_contig_resolves_verbatim(tmp_path, capsys):
+    """A contig whose NAME contains ':' (GRCh38 HLA alts) must resolve as
+    a whole-contig region, not misparse at the colon."""
+    from hadoop_bam_tpu.formats.bam import SAMHeader
+    hla = "HLA-A*01:01"
+    header = SAMHeader(
+        text=f"@HD\tVN:1.6\n@SQ\tSN:{hla}\tLN:4000\n",
+        ref_names=[hla], ref_lengths=[4000])
+    path = str(tmp_path / "hla.bam")
+    with BamWriter(path, header) as w:
+        w.write_sam_record(SamRecord(
+            qname="r", flag=0, rname=hla, pos=100, mapq=30, cigar="10M",
+            rnext="*", pnext=0, tlen=0, seq="ACGTACGTAC",
+            qual="IIIIIIIIII"))
+    assert main(["coverage", path, hla]) == 0
+    out = capsys.readouterr().out
+    assert f"region\t{hla}:1-4000" in out and "covered\t10" in out
+
+
+def test_coverage_failure_leaves_no_bedgraph(bam_file, tmp_path):
+    """A mid-run error must not leave a plausible-looking partial
+    bedGraph behind."""
+    path, header, recs = bam_file
+    bg = str(tmp_path / "part.bedgraph")
+    rc = main(["coverage", path, f"{header.ref_names[0]}:1-10,000",
+               "--max-cigar", "0", "--bedgraph", bg])
+    assert rc == 1                      # max_cigar=0 always overflows
+    assert not os.path.exists(bg)
+    assert not os.path.exists(bg + ".tmp")

@@ -320,3 +320,20 @@ def test_csi_round_trip_and_query_matches_bai(tmp_path):
     spans = ds.spans()
     assert sum(s.compressed_size for s in spans) < os.path.getsize(path)
     assert ds.flagstat() == full
+
+
+def test_resolve_interval_colon_contigs():
+    """samtools-style resolution: verbatim contig wins; else longest
+    known contig prefix + range; else plain grammar."""
+    from hadoop_bam_tpu.split.intervals import Interval, resolve_interval
+    refs = ["chr1", "HLA-A*01:01", "HLA-A*01:01:02"]
+    assert resolve_interval("HLA-A*01:01", refs) == Interval("HLA-A*01:01")
+    got = resolve_interval("HLA-A*01:01:5-10", refs)
+    assert got == Interval("HLA-A*01:01", 5, 10)
+    # longest known prefix wins over a shorter one
+    got = resolve_interval("HLA-A*01:01:02:7", refs)
+    assert got.rname == "HLA-A*01:01:02" and got.start == got.end == 7
+    assert resolve_interval("chr1:1,000-2,000", refs) == \
+        Interval("chr1", 1000, 2000)
+    # unknown names fall back to the plain grammar
+    assert resolve_interval("chr9:5-6", refs) == Interval("chr9", 5, 6)
