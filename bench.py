@@ -22,6 +22,9 @@ from __future__ import annotations
 import json
 import os
 import random
+import subprocess
+import sys
+import threading
 import time
 
 import numpy as np
@@ -36,6 +39,156 @@ BENCH_BAM = os.path.join(BENCH_DIR, f"bench_{BENCH_RECORDS}.bam")
 
 _HDR_TEXT = ("@HD\tVN:1.6\tSO:coordinate\n"
              "@SQ\tSN:chr20\tLN:64444167\n@SQ\tSN:chr21\tLN:46709983\n")
+
+# ---------------------------------------------------------------------------
+# resilience: the driver contract is ONE JSON line on stdout, rc=0 — always.
+# The TPU backend behind the tunnel can fail to init or hang outright
+# (BENCH_r03 was lost to exactly that), so:
+#   * the backend is probed in a SUBPROCESS with a timeout and retries;
+#     on terminal failure the run falls back to CPU and records it;
+#   * every component is error-isolated (a broken row becomes an
+#     {"error": ...} entry, never a crash);
+#   * a watchdog thread emits whatever has been measured so far and
+#     exits 0 if the whole run would blow its deadline.
+# ---------------------------------------------------------------------------
+
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "2400"))
+SCALING_DEVICES = (1, 2, 4, 8)
+
+_T0 = time.monotonic()
+_EMITTED = threading.Event()
+_EMIT_LOCK = threading.Lock()
+_STATE = {"platform": None, "notes": [], "components": [],
+          "headline": None, "scaling": None}
+
+
+def _remaining() -> float:
+    return DEADLINE_S - (time.monotonic() - _T0)
+
+
+def _emit(status: str) -> None:
+    # watchdog + main thread can race here; exactly one may print
+    with _EMIT_LOCK:
+        if _EMITTED.is_set():
+            return
+        _EMITTED.set()
+    head = _STATE["headline"]
+    if status == "ok" and head is None:
+        # never report a failed headline as a measured 0.0-ok
+        status = "partial"
+        _STATE["notes"].append("headline measurement failed; see components")
+    out = {
+        "metric": "bam_decode_records_per_sec_per_chip",
+        "value": head["value"] if head else 0.0,
+        "unit": "records/s",
+        "platform": _STATE["platform"] or "unknown",
+        "status": status,
+        "components": _STATE["components"],
+    }
+    if head and "vs_baseline" in head:
+        out["vs_baseline"] = head["vs_baseline"]
+    if _STATE["scaling"] is not None:
+        out["scaling"] = _STATE["scaling"]
+    if _STATE["notes"]:
+        out["notes"] = _STATE["notes"]
+    print(json.dumps(out), flush=True)
+
+
+_CHILD = {"proc": None}   # in-flight scaling subprocess, for watchdog kill
+
+
+def _watchdog() -> None:
+    while not _EMITTED.is_set():
+        if _remaining() <= 0:
+            _STATE["notes"].append(
+                f"deadline {DEADLINE_S:.0f}s reached; partial results")
+            _emit("timeout")
+            proc = _CHILD["proc"]
+            if proc is not None:   # don't orphan a running scaling child
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+            os._exit(0)
+        time.sleep(min(5.0, max(0.5, _remaining())))
+
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp\n"
+    "d = jax.devices()\n"
+    "x = float(jnp.ones((256, 256)).sum())\n"
+    "assert x == 65536.0, x\n"
+    "print('HBAM_PROBE_OK', d[0].platform, len(d))\n"
+)
+
+
+def acquire_platform() -> str:
+    """Pick the JAX platform for this run, never raising.
+
+    The default backend (the tunneled TPU, when present) is exercised in a
+    throwaway subprocess first: a hung or UNAVAILABLE plugin then costs a
+    bounded timeout instead of the whole benchmark.  ``BENCH_PLATFORM=cpu``
+    forces the fallback (note: the JAX_PLATFORMS env var is overridden by
+    the axon plugin, so the forcing is done via jax.config in-process).
+    """
+    import jax
+
+    forced = os.environ.get("BENCH_PLATFORM", "").strip().lower()
+    if forced and forced != "cpu":
+        _STATE["notes"].append(
+            f"BENCH_PLATFORM={forced!r} not supported (only 'cpu' forces "
+            "a backend); probing the default backend instead")
+        forced = ""
+    if forced == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        _STATE["notes"].append("platform forced to cpu via BENCH_PLATFORM")
+    elif not forced:
+        ok = False
+        for attempt in range(PROBE_ATTEMPTS):
+            budget = min(PROBE_TIMEOUT_S, max(30.0, _remaining() - 120))
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", _PROBE_SRC],
+                    capture_output=True, text=True, timeout=budget)
+                if r.returncode == 0 and "HBAM_PROBE_OK" in r.stdout:
+                    ok = True
+                    break
+                err = r.stderr.strip().splitlines()
+                _STATE["notes"].append(
+                    f"backend probe {attempt + 1}/{PROBE_ATTEMPTS} failed "
+                    f"rc={r.returncode}: {err[-1][:200] if err else ''}")
+            except subprocess.TimeoutExpired:
+                _STATE["notes"].append(
+                    f"backend probe {attempt + 1}/{PROBE_ATTEMPTS} timed "
+                    f"out after {budget:.0f}s")
+            time.sleep(2.0)
+        if not ok:
+            jax.config.update("jax_platforms", "cpu")
+            _STATE["notes"].append(
+                "default backend unusable after probes; cpu fallback")
+    try:
+        devs = jax.devices()
+    except Exception as e:  # probe passed but in-process init still died
+        jax.config.update("jax_platforms", "cpu")
+        _STATE["notes"].append(
+            f"in-process backend init failed ({type(e).__name__}); "
+            "cpu fallback")
+        devs = jax.devices()
+    return devs[0].platform
+
+
+def _run_component(fn, label: str) -> None:
+    """Append fn()'s component dict; convert failures into error rows."""
+    if _remaining() < 90:
+        _STATE["components"].append({"metric": label, "skipped": "deadline"})
+        return
+    try:
+        _STATE["components"].append(fn())
+    except Exception as e:
+        _STATE["components"].append(
+            {"metric": label, "error": f"{type(e).__name__}: {e}"})
 
 
 def _median_time(fn, reps: int = 3):
@@ -581,33 +734,190 @@ def bench_deflate_tokenize(path: str):
             "vs_baseline": round(bdt / dt, 3)}
 
 
-def main() -> None:
-    path = build_fixture()
-    base = baseline_single_thread(path)
-    meas = measured_pipeline(path)
+# ---------------------------------------------------------------------------
+# device-scaling curve (VERDICT r3 #2): flagstat/seq-stats/coverage at
+# 1/2/4/8 virtual CPU devices, each measured in a subprocess so the forced
+# device count can't leak into (or hang) the main run.  On this 1-core host
+# the virtual devices share one core, so the curve measures how the WORK
+# partitions (per-stage timers: host inflate/walk vs sharded device step),
+# not wall-clock speedup — that caveat is recorded in the JSON itself.
+# ---------------------------------------------------------------------------
 
-    components = [
-        {"metric": "bam_decode_records_per_sec_per_chip",
-         "value": round(meas, 1), "unit": "records/s",
-         "vs_baseline": round(meas / base, 3)},
-        bench_bgzf_inflate(path),
-        bench_deflate_tokenize(path),
-        bench_cram(build_cram_fixture()),
-        bench_vcf(build_vcf_fixture()),
-        bench_fastq(build_fastq_fixture()),
-        bench_split_guess(path),
-        bench_sort(path),
-        bench_coverage(path),
-        bench_bam_write(path),
-    ]
-    print(json.dumps({
-        "metric": "bam_decode_records_per_sec_per_chip",
-        "value": round(meas, 1),
-        "unit": "records/s",
-        "vs_baseline": round(meas / base, 3),
-        "components": components,
-    }))
+def _scaling_child(n_dev: int) -> None:
+    """Runs in a subprocess with xla_force_host_platform_device_count set."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.parallel.pipeline import (
+        coverage_file, flagstat_file, seq_stats_file,
+    )
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
+    path = BENCH_BAM
+    header, _ = read_bam_header(path)
+    mesh = make_mesh()
+    out = {"n_devices": n_dev, "jax_devices": len(jax.devices())}
+
+    def timed(fn, reps=3):
+        fn()                       # warmup: jit compile + page cache
+        METRICS.reset()
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            res = fn()
+            times.append(time.perf_counter() - t0)
+        timers = {k: round(v / reps, 4) for k, v in METRICS.timers.items()}
+        return res, sorted(times)[len(times) // 2], timers
+
+    stats, dt, timers = timed(
+        lambda: flagstat_file(path, mesh=mesh, header=header))
+    n_file_records = stats["total"]
+    out["file_records"] = n_file_records
+    out["flagstat_records_per_sec"] = round(n_file_records / dt, 1)
+    # host_decode/inflate/walk run in a thread pool: their values are
+    # WORK seconds summed across threads (can exceed wall time); the
+    # single-threaded device_put/device_drain values are wall seconds.
+    out["flagstat_stage_seconds_per_run"] = timers
+    out["stage_timer_note"] = ("host_decode/inflate/walk are thread-summed "
+                               "work seconds; device_* are wall seconds")
+
+    sstats, dt, _ = timed(lambda: seq_stats_file(path, mesh=mesh))
+    out["seq_stats_records_per_sec"] = round(
+        int(sstats.get("n_reads", n_file_records)) / dt, 1)
+
+    # no .bai sidecar on the bench fixture: coverage streams every record
+    _, dt, _ = timed(lambda: coverage_file(path, "chr20:1-4194304",
+                                           mesh=mesh))
+    out["coverage_records_per_sec"] = round(n_file_records / dt, 1)
+
+    print(json.dumps(out), flush=True)
+
+
+def bench_scaling() -> dict:
+    rows = []
+    for n in SCALING_DEVICES:
+        if _remaining() < 240:
+            rows.append({"n_devices": n, "skipped": "deadline"})
+            continue
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + f" --xla_force_host_platform_device_count={n}"
+                            ).strip()
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--scaling-child", str(n)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            _CHILD["proc"] = proc
+            try:
+                stdout, stderr = proc.communicate(
+                    timeout=min(900.0, max(60.0, _remaining() - 120)))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+                rows.append({"n_devices": n, "error": "timeout"})
+                continue
+            finally:
+                _CHILD["proc"] = None
+            line = next((ln for ln in reversed(stdout.splitlines())
+                         if ln.startswith("{")), None)
+            if proc.returncode == 0 and line:
+                rows.append(json.loads(line))
+            else:
+                err = stderr.strip().splitlines()
+                rows.append({"n_devices": n, "error":
+                             f"rc={proc.returncode}: "
+                             f"{err[-1][:200] if err else 'no output'}"})
+        except Exception as e:
+            rows.append({"n_devices": n,
+                         "error": f"{type(e).__name__}: {e}"})
+    return {
+        "host_cores": os.cpu_count(),
+        "note": ("virtual CPU devices share this host's "
+                 f"{os.cpu_count()} core(s): the curve shows work "
+                 "partitioning and per-stage cost, not wall speedup; "
+                 "stage timers separate host decode from the sharded "
+                 "device step"),
+        "devices": rows,
+    }
+
+
+def main() -> None:
+    threading.Thread(target=_watchdog, daemon=True).start()
+    try:
+        _STATE["platform"] = acquire_platform()
+    except Exception as e:   # acquire_platform shouldn't raise; belt+braces
+        _STATE["platform"] = "unknown"
+        _STATE["notes"].append(
+            f"platform acquisition failed: {type(e).__name__}: {e}")
+
+    try:
+        path = build_fixture()
+    except Exception as e:
+        _STATE["notes"].append(
+            f"fixture build failed: {type(e).__name__}: {e}")
+        _emit("error")
+        return
+
+    # headline: measured pipeline vs single-thread host decode
+    base = None
+    try:
+        base = baseline_single_thread(path)
+    except Exception as e:
+        _STATE["notes"].append(
+            f"baseline measurement failed: {type(e).__name__}: {e}")
+    try:
+        meas = measured_pipeline(path)
+        head = {"metric": "bam_decode_records_per_sec_per_chip",
+                "value": round(meas, 1), "unit": "records/s"}
+        if base:
+            head["vs_baseline"] = round(meas / base, 3)
+        _STATE["headline"] = head
+        _STATE["components"].append(head)
+    except Exception as e:
+        _STATE["components"].append(
+            {"metric": "bam_decode_records_per_sec_per_chip",
+             "error": f"{type(e).__name__}: {e}"})
+
+    _run_component(lambda: bench_bgzf_inflate(path), "bgzf_inflate_gbps")
+    _run_component(lambda: bench_deflate_tokenize(path),
+                   "deflate_tokenize_gbps")
+    _run_component(lambda: bench_cram(build_cram_fixture()),
+                   "cram_tensor_records_per_sec")
+    _run_component(lambda: bench_vcf(build_vcf_fixture()),
+                   "vcf_variants_per_sec")
+    _run_component(lambda: bench_fastq(build_fastq_fixture()),
+                   "fastq_reads_per_sec")
+    _run_component(lambda: bench_split_guess(path),
+                   "split_guess_p50_ms_per_boundary")
+    _run_component(lambda: bench_sort(path), "sort_records_per_sec_mesh")
+    _run_component(lambda: bench_coverage(path),
+                   "coverage_records_per_sec")
+    _run_component(lambda: bench_bam_write(path),
+                   "bam_write_records_per_sec")
+
+    try:
+        _STATE["scaling"] = bench_scaling()
+    except Exception as e:
+        _STATE["scaling"] = {"error": f"{type(e).__name__}: {e}"}
+
+    _emit("ok")
 
 
 if __name__ == "__main__":
-    main()
+    if "--scaling-child" in sys.argv:
+        _scaling_child(int(sys.argv[sys.argv.index("--scaling-child") + 1]))
+        sys.exit(0)
+    try:
+        main()
+    except BaseException as e:   # the contract: JSON out, rc 0, always
+        if not isinstance(e, (KeyboardInterrupt, SystemExit)):
+            _STATE["notes"].append(
+                f"unhandled: {type(e).__name__}: {e}")
+            _emit("error")
+        else:
+            raise

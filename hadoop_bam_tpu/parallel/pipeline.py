@@ -39,6 +39,7 @@ from hadoop_bam_tpu.ops.unpack_bam import (
 )
 from hadoop_bam_tpu.split.planners import plan_bam_spans
 from hadoop_bam_tpu.split.spans import FileVirtualSpan
+from hadoop_bam_tpu.utils.metrics import METRICS
 from hadoop_bam_tpu.utils.seekable import as_byte_source
 
 
@@ -103,8 +104,6 @@ def _decode_span_core(source, span: FileVirtualSpan,
     are fetched as needed.
     """
     from hadoop_bam_tpu.formats import bgzf
-
-    from hadoop_bam_tpu.utils.metrics import METRICS
 
     src = as_byte_source(source)
     start_c, start_u = span.start
@@ -205,10 +204,11 @@ def _decode_span_core(source, span: FileVirtualSpan,
     #    split's end voffset).
     rows = None
     while True:
-        if packed_walker is not None:
-            rows, offs, tail = packed_walker(data, start_u, end_inflated)
-        else:
-            offs, tail = inflate_ops.walk_records(data, start=start_u)
+        with METRICS.timer("pipeline.walk"):
+            if packed_walker is not None:
+                rows, offs, tail = packed_walker(data, start_u, end_inflated)
+            else:
+                offs, tail = inflate_ops.walk_records(data, start=start_u)
         if tail < end_inflated and next_c < src.size:
             prev_size = data.size
             extend_past(tail)
@@ -547,7 +547,6 @@ def decode_with_retry(fn: Callable, span: FileVirtualSpan,
     simply re-decoding it, exactly as MapReduce re-runs a map task.  After
     ``config.span_retries`` re-attempts, ``skip_bad_spans`` decides between
     raising and warn+skip (returns None; ticks pipeline.bad_spans)."""
-    from hadoop_bam_tpu.utils.metrics import METRICS
 
     retries = max(0, int(getattr(config, "span_retries", 0)))
     last: Optional[BaseException] = None
@@ -1129,7 +1128,8 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                     path, s, check_crc, "auto", projection,
                     want_voffs=False, intervals=intervals, header=header)
                 return rows
-            out = decode_with_retry(inner, span, config)
+            with METRICS.timer("pipeline.host_decode"):
+                out = decode_with_retry(inner, span, config)
             return out if out is not None \
                 else np.empty((0, row_bytes), dtype=np.uint8)
 
@@ -1152,8 +1152,9 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                 pad = np.zeros((n_dev - tiles.shape[0], cap, row_bytes),
                                dtype=np.uint8)
                 tiles = np.concatenate([tiles, pad])
-            t = jax.device_put(tiles, sharding)
-            c = jax.device_put(counts, sharding)
+            with METRICS.timer("pipeline.device_put"):
+                t = jax.device_put(tiles, sharding)
+                c = jax.device_put(counts, sharding)
             vec = step(t, c)
             totals_vec = vec if totals_vec is None else _ADD(totals_vec, vec)
             group_tiles.clear()
@@ -1166,8 +1167,11 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                 dispatch()
         if group_tiles:
             dispatch()
-    host = np.zeros(len(FLAGSTAT_FIELDS), dtype=np.int64) if totals_vec is None \
-        else np.asarray(jax.device_get(totals_vec), dtype=np.int64)
+    if totals_vec is None:
+        host = np.zeros(len(FLAGSTAT_FIELDS), dtype=np.int64)
+    else:
+        with METRICS.timer("pipeline.device_drain"):
+            host = np.asarray(jax.device_get(totals_vec), dtype=np.int64)
     return {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}
 
 
