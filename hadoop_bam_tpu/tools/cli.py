@@ -372,38 +372,10 @@ def cmd_sort(args) -> int:
 # ---------------------------------------------------------------------------
 
 def cmd_fixmate(args) -> int:
-    from hadoop_bam_tpu.api.dataset import open_bam
-    from hadoop_bam_tpu.formats.bamio import BamWriter
-    from hadoop_bam_tpu.formats.sam import SamRecord
+    from hadoop_bam_tpu.utils.fixmate import fixmate_bam
 
-    ds = open_bam(args.input)
-    recs = [SamRecord.from_line(b.to_sam_line(i))
-            for b in ds.batches() for i in range(len(b))]
-    i = 0
-    while i < len(recs):
-        a = recs[i]
-        if i + 1 < len(recs) and recs[i + 1].qname == a.qname \
-                and (a.flag & 0x1):
-            b = recs[i + 1]
-            a.rnext = "=" if b.rname == a.rname else b.rname
-            b.rnext = "=" if a.rname == b.rname else a.rname
-            a.pnext, b.pnext = b.pos, a.pos
-            if a.rname == b.rname and a.pos and b.pos:
-                span = max(a.pos + _alen(a), b.pos + _alen(b)) \
-                    - min(a.pos, b.pos)
-                sign = 1 if a.pos <= b.pos else -1
-                a.tlen, b.tlen = sign * span, -sign * span
-            # mate-unmapped/reverse flags [SPEC 0x8, 0x20]
-            for x, y in ((a, b), (b, a)):
-                x.flag = (x.flag & ~0x28) | (0x8 if y.flag & 0x4 else 0) \
-                    | (0x20 if y.flag & 0x10 else 0)
-            i += 2
-        else:
-            i += 1
-    with BamWriter(args.output, ds.header) as w:
-        for r in recs:
-            w.write_sam_record(r)
-    print(f"wrote {args.output} ({len(recs)} records)")
+    n = fixmate_bam(args.input, args.output)
+    print(f"wrote {args.output} ({n} records)")
     return 0
 
 

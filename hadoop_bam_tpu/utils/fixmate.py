@@ -1,0 +1,127 @@
+"""Streaming mate-info fixup (the CLI ``fixmate`` verb's engine).
+
+The reference's fixmate was an MR job driver (SURVEY.md section 2.7,
+``hb/cli`` fixmate plugin) pairing name-adjacent records.  This is the
+same contract — input must be queryname-grouped, as for samtools
+fixmate — executed as a single streaming pass over raw record bytes:
+mate fields live at fixed offsets in the BAM wire layout [SPEC alignment
+section], so each pair is patched in place with no record-object or
+SAM-text materialization, and memory is bounded by one decode span plus
+one pending record regardless of file size.
+
+Raw-record offsets (block_size-prefixed, as ``BamBatch.record_bytes``
+returns them — see ops/unpack_bam.py::FIXED_FIELDS):
+
+    0:4 block_size | 4:8 refID | 8:12 pos | 12 l_read_name | 13 mapq
+    | 14:16 bin | 16:18 n_cigar_op | 18:20 flag | 20:24 l_seq
+    | 24:28 next_refID | 28:32 next_pos | 32:36 tlen
+    | 36:36+l_read_name read_name (NUL-terminated) | cigar u32[n_cigar]
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+
+_REF_CONSUME = frozenset((0, 2, 3, 7, 8))   # M D N = X [SPEC cigar ops]
+
+
+def _i32(rec, off: int) -> int:
+    return int.from_bytes(rec[off:off + 4], "little", signed=True)
+
+
+def _put_i32(rec: bytearray, off: int, v: int) -> None:
+    rec[off:off + 4] = v.to_bytes(4, "little", signed=True)
+
+
+def _u16(rec, off: int) -> int:
+    return int.from_bytes(rec[off:off + 2], "little")
+
+
+def _qname(rec) -> bytes:
+    return bytes(rec[36:36 + rec[12] - 1])
+
+
+def _alen(rec) -> int:
+    """Alignment span on the reference from the packed CIGAR; falls back
+    to l_seq for CIGAR-less records (the '*' CIGAR convention)."""
+    n_cigar = _u16(rec, 16)
+    if n_cigar == 0:
+        return _i32(rec, 20)          # l_seq; 0 when seq is '*'
+    off = 36 + rec[12]
+    total = 0
+    for k in range(n_cigar):
+        v = int.from_bytes(rec[off + 4 * k:off + 4 * k + 4], "little")
+        if (v & 0xF) in _REF_CONSUME:
+            total += v >> 4
+    return total
+
+
+def fix_pair(a: bytearray, b: bytearray) -> None:
+    """Patch mate refid/pos, template length, and mate flags of a
+    name-matched pair, in place."""
+    refid_a, refid_b = _i32(a, 4), _i32(b, 4)
+    pos_a, pos_b = _i32(a, 8), _i32(b, 8)
+    _put_i32(a, 24, refid_b)
+    _put_i32(a, 28, pos_b)
+    _put_i32(b, 24, refid_a)
+    _put_i32(b, 28, pos_a)
+    if refid_a == refid_b and pos_a >= 0 and pos_b >= 0:
+        span = (max(pos_a + _alen(a), pos_b + _alen(b))
+                - min(pos_a, pos_b))
+        sign = 1 if pos_a <= pos_b else -1
+        _put_i32(a, 32, sign * span)
+        _put_i32(b, 32, -sign * span)
+    else:
+        # not computable (cross-reference or unmapped member): zero any
+        # stale input tlen, as samtools fixmate does
+        _put_i32(a, 32, 0)
+        _put_i32(b, 32, 0)
+    flag_a, flag_b = _u16(a, 18), _u16(b, 18)
+    for x, xf, yf in ((a, flag_a, flag_b), (b, flag_b, flag_a)):
+        nf = ((xf & ~0x28)
+              | (0x8 if yf & 0x4 else 0)      # mate unmapped [SPEC 0x8]
+              | (0x20 if yf & 0x10 else 0))   # mate reverse [SPEC 0x20]
+        x[18:20] = nf.to_bytes(2, "little")
+
+
+def fixmate_bam(input_path: str, output_path: str, *,
+                config: HBamConfig = DEFAULT_CONFIG) -> int:
+    """Fix mate information across a queryname-grouped BAM, streaming.
+
+    Pairs are adjacent primary records sharing a read name whose first
+    member has the paired flag (0x1) set; secondary (0x100) and
+    supplementary (0x800) alignments never pair (a primary's mate is the
+    other primary, not its own split alignment — samtools fixmate
+    contract) and pass through untouched, as does everything unpaired.
+    Returns the record count.
+    """
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+
+    ds = open_bam(input_path, config)
+    n = 0
+    pending: Optional[bytearray] = None
+    pending_name = b""
+    with BamWriter(output_path, ds.header) as w:
+        for batch in ds.batches():
+            for i in range(len(batch)):
+                rec = bytearray(batch.record_bytes(i))
+                n += 1
+                if _u16(rec, 18) & 0x900:    # secondary/supplementary
+                    w.write_record_bytes(bytes(rec))
+                    continue
+                name = _qname(rec)
+                if (pending is not None and name == pending_name
+                        and _u16(pending, 18) & 0x1):
+                    fix_pair(pending, rec)
+                    w.write_record_bytes(bytes(pending))
+                    w.write_record_bytes(bytes(rec))
+                    pending = None
+                else:
+                    if pending is not None:
+                        w.write_record_bytes(bytes(pending))
+                    pending, pending_name = rec, name
+        if pending is not None:
+            w.write_record_bytes(bytes(pending))
+    return n
