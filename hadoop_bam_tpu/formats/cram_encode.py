@@ -292,12 +292,25 @@ def _tag_cids(key: int) -> Tuple[int, int]:
 
 
 def _external_payloads(s: _Streams, version: Tuple[int, int] = (3, 0)):
+    import os
+
     from hadoop_bam_tpu.formats.cram import NAME_TOK, RANSNx16
     # qualities through rANS like htslib's default; rest gzip.  3.1
     # upgrades the rANS series to Nx16 (+PACK/RLE) and tokenizes read
-    # names (tok3), matching htslib's 3.1 defaults [SPEC CRAM 3.1]
+    # names (tok3), matching htslib's 3.1 defaults [SPEC CRAM 3.1].
+    # The tok3 frame layout is [SPEC-recalled] and has never been
+    # cross-validated against htscodecs output (reference mount empty —
+    # SURVEY.md section 0), so HBAM_CRAM31_NAMES=gzip keeps 3.1 names on
+    # the well-understood GZIP method for interop-critical output.
     rans = RANSNx16 if version >= (3, 1) else RANS4x8
-    names_method = NAME_TOK if version >= (3, 1) else GZIP
+    names_method = GZIP
+    if version >= (3, 1):
+        knob = os.environ.get("HBAM_CRAM31_NAMES", "tok3").strip().lower()
+        if knob not in ("tok3", "gzip"):   # fail closed, not open to tok3
+            raise ValueError(
+                f"HBAM_CRAM31_NAMES={knob!r}: expected 'tok3' or 'gzip'")
+        if knob == "tok3":
+            names_method = NAME_TOK
     for k, data in s.ints.items():
         yield _CID_INT[k], data, GZIP
     for k, data in s.bytes_.items():

@@ -54,6 +54,11 @@ def unpack_cigar_tiles(data: jnp.ndarray, offsets: jnp.ndarray,
     them is wrong — callers must validate ``n_cigar.max() <= max_cigar``
     on the host first, as coverage_file does before dispatch.
     """
+    if data.shape[0] < 4:   # shapes are static under jit: plain Python
+        # a buffer shorter than one cigar word can hold no ops, and the
+        # clip below would get a negative upper bound (min > max is
+        # implementation-defined); no record is valid either way
+        return jnp.zeros((offsets.shape[0], max_cigar), jnp.uint32)
     start = offsets + PREFIX + l_read_name
     j = jnp.arange(max_cigar, dtype=jnp.int32)
     base = start[:, None] + 4 * j[None, :]

@@ -78,7 +78,16 @@ def resolve_interval(text: str,
                                           or len(n) > len(best)):
                 best = n
         if best is not None:
-            rng = parse_interval("x:" + t[len(best) + 1:])
+            try:
+                rng = parse_interval("x:" + t[len(best) + 1:])
+            except IntervalError as e:
+                # re-raise naming the user's region, not the synthetic
+                # "x:"-prefixed range used for parsing; keep the specific
+                # cause (bad syntax vs bad bounds)
+                raise IntervalError(
+                    f"bad range in interval {t!r} (contig {best!r}): "
+                    + str(e).replace(repr("x:" + t[len(best) + 1:]),
+                                     "range")) from None
             return Interval(best, rng.start, rng.end)
     return parse_interval(t)
 

@@ -360,8 +360,11 @@ def cmd_sort(args) -> int:
         return 0
     from hadoop_bam_tpu.utils.sort import sort_bam
 
+    if args.run_records is not None and args.run_records <= 0:
+        raise SystemExit("--run-records must be positive")
     n = sort_bam(args.input, args.output, by_name=args.by_name,
-                 run_records=args.run_records or 1_000_000)
+                 run_records=args.run_records
+                 if args.run_records is not None else 1_000_000)
     so = "queryname" if args.by_name else "coordinate"
     print(f"wrote {args.output} ({n} records, {so})")
     return 0
@@ -395,6 +398,8 @@ def _alen(r) -> int:
 def cmd_vcf_sort(args) -> int:
     from hadoop_bam_tpu.utils.sort import sort_vcf
 
+    if args.run_records <= 0:
+        raise SystemExit("--run-records must be positive")
     n = sort_vcf(args.input, args.output,
                  run_records=args.run_records)
     print(f"wrote {args.output} ({n} records)")

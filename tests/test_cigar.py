@@ -183,3 +183,19 @@ def test_coverage_high_positions(tmp_path):
     want = _oracle_depth(recs, header, header.ref_names[0], 49_999, 31_001)
     assert depth.tolist() == want.tolist()
     assert want.sum() > 0
+
+
+def test_unpack_cigar_tiles_tiny_buffer():
+    """A data buffer shorter than one cigar word must not produce
+    out-of-range gathers (clip upper bound used to go negative)."""
+    import jax.numpy as jnp
+
+    from hadoop_bam_tpu.ops.cigar import unpack_cigar_tiles
+
+    for n_bytes in (0, 1, 3):
+        data = jnp.zeros((n_bytes,), jnp.uint8)
+        tiles = unpack_cigar_tiles(
+            data, jnp.zeros((2,), jnp.int32), jnp.full((2,), 5, jnp.int32),
+            jnp.zeros((2,), jnp.int32), max_cigar=4)
+        assert tiles.shape == (2, 4)
+        assert int(np.asarray(tiles).sum()) == 0

@@ -17,6 +17,12 @@ from hadoop_bam_tpu.formats.cram_name_tok3 import (
 from fixtures import make_header, make_records
 
 
+@pytest.fixture(autouse=True)
+def _pin_names_method(monkeypatch):
+    """Ambient HBAM_CRAM31_NAMES must not flip the tok3-default tests."""
+    monkeypatch.delenv("HBAM_CRAM31_NAMES", raising=False)
+
+
 def _roundtrip(names, sep=b"\0"):
     payload = sep.join(names) + sep
     enc = tok3_encode(payload)
@@ -202,3 +208,33 @@ def test_cram30_has_no_tok3_blocks(tmp_path):
     path = str(tmp_path / "v30.cram")
     write_cram(path, header, recs)
     assert NAME_TOK not in _block_methods(path)
+
+
+def test_cram31_names_gzip_switch(tmp_path, monkeypatch):
+    """HBAM_CRAM31_NAMES=gzip keeps 3.1 read names on GZIP (the interop
+    escape hatch while the tok3 frame layout is only self-validated)."""
+    from hadoop_bam_tpu.formats.cram import NAME_TOK
+    from hadoop_bam_tpu.formats.cramio import CramWriter, read_cram
+
+    monkeypatch.setenv("HBAM_CRAM31_NAMES", "gzip")
+    header = make_header()
+    recs = make_records(header, 200, seed=19)
+    path = str(tmp_path / "tok3_off.cram")
+    with CramWriter(path, header, records_per_container=50,
+                    version=(3, 1)) as w:
+        w.write_records(recs)
+    assert NAME_TOK not in _block_methods(path)
+    _, out = read_cram(path)
+    assert [r.to_line() for r in out] == [r.to_line() for r in recs]
+
+
+def test_cram31_names_bad_knob_fails_closed(tmp_path, monkeypatch):
+    from hadoop_bam_tpu.formats.cramio import CramWriter
+
+    monkeypatch.setenv("HBAM_CRAM31_NAMES", "gz")
+    header = make_header()
+    recs = make_records(header, 10, seed=20)
+    with pytest.raises(ValueError, match="HBAM_CRAM31_NAMES"):
+        with CramWriter(str(tmp_path / "bad.cram"), header,
+                        version=(3, 1)) as w:
+            w.write_records(recs)

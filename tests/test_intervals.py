@@ -337,3 +337,12 @@ def test_resolve_interval_colon_contigs():
         Interval("chr1", 1000, 2000)
     # unknown names fall back to the plain grammar
     assert resolve_interval("chr9:5-6", refs) == Interval("chr9", 5, 6)
+
+
+def test_resolve_interval_error_names_user_region():
+    from hadoop_bam_tpu.split.intervals import IntervalError, resolve_interval
+
+    with pytest.raises(IntervalError) as ei:
+        resolve_interval("chr1:bogus-range", ref_names=["chr1"])
+    msg = str(ei.value)
+    assert "chr1:bogus-range" in msg and "'x:" not in msg
