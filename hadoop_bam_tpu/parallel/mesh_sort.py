@@ -18,14 +18,30 @@ shuffle row).  This module is that shuffle as XLA collectives:
    bucket 0..n-1 sequentially — byte-identical output to the
    single-process spill-merge sort (utils/sort.py::sort_bam).
 
-Device memory bound: one span tile + two [n_dev, records_cap] u32 bucket
-matrices per device.  Host memory bound: the inflated input (spans stay
-resident so the permutation can gather record bytes); for inputs larger
-than host RAM use utils/sort.py, whose spill-merge bound is independent
-of file size.  Single-host only for now: every span is decoded on the
-calling host, so a multi-host mesh is rejected — sharding the decode per
-host the way the stats drivers do (parallel/distributed.py) is the
-extension point.
+Two exchange modes:
+
+``exchange="index"`` (default single-host): only keys + global indices
+ride the all_to_all; hosts keep every decoded span resident and apply
+the permutation by gathering record bytes locally.  Cheapest on one
+host, impossible on many (a bucket's bytes may live on another host).
+
+``exchange="bytes"`` (default multi-host): the record BYTES themselves
+ride the all_to_all as fixed-stride rows — the literal MR shuffle.
+Each process decodes only the spans owned by its local devices
+(broadcast_plan/assign-by-device, parallel/distributed.py), devices
+exchange (key, index, row) tuples, sort their bucket, and each host
+writes only its devices' buckets as headerless shards which host 0
+concatenates via utils/mergers.py — byte-identical to sort_bam.
+Requires the input path to be readable from every host (the HDFS
+analog) and the shard/output directory to be shared.
+
+Device memory bound, index mode: one span tile + two [n_dev,
+records_cap] u32 bucket matrices per device.  Bytes mode: two
+[n_dev, records_cap, stride] u8 row matrices per device (send + recv)
+— the shuffle's traffic, resident on device instead of host.  Host
+memory bound, index mode: the inflated input; bytes mode: only the
+process's own spans.  For inputs larger than either bound use
+utils/sort.py, whose spill-merge bound is independent of file size.
 """
 from __future__ import annotations
 
@@ -81,6 +97,40 @@ def _sample_bounds(his: List[np.ndarray], los: List[np.ndarray],
     return bhi.astype(np.uint32), blo.astype(np.uint32)
 
 
+def _device_keys(refid, pos, valid, base, R):
+    """(hi, lo, gidx) device sort keys — the single definition of the
+    coordinate-key convention (unmapped refid<0 sorts last; pos+1 in
+    uint32 wraparound, matching utils/sort.py::coordinate_key), shared
+    by both exchange modes so they cannot drift apart."""
+    import jax.numpy as jnp
+
+    hi = jnp.where(refid < 0, jnp.uint32(0xFFFFFFFF),
+                   refid.astype(jnp.uint32))
+    lo = pos.astype(jnp.uint32) + jnp.uint32(1)
+    hi = jnp.where(valid, hi, jnp.uint32(0xFFFFFFFF))
+    lo = jnp.where(valid, lo, jnp.uint32(0xFFFFFFFF))
+    gidx = jnp.where(valid, base + jnp.arange(R, dtype=jnp.int32),
+                     _I32_SENTINEL)
+    return hi, lo, gidx
+
+
+def _bucket_pack(hi, lo, bhi, blo, R):
+    """Range-partition bucket ids (how many boundaries <= key) plus the
+    stable within-bucket scatter coordinates (perm, dest bucket, rank)
+    for the per-destination send matrices."""
+    import jax.numpy as jnp
+
+    ge = ((hi[:, None] > bhi[None, :])
+          | ((hi[:, None] == bhi[None, :])
+             & (lo[:, None] >= blo[None, :])))
+    bucket = jnp.sum(ge.astype(jnp.int32), axis=1)          # [R] 0..n_dev-1
+    perm = jnp.argsort(bucket, stable=True)
+    sb = bucket[perm]
+    rank = jnp.arange(R, dtype=jnp.int32) - jnp.searchsorted(
+        sb, sb, side="left").astype(jnp.int32)
+    return perm, sb, rank
+
+
 def _make_sort_step(mesh, records_cap: int):
     """shard_map step: tiles -> device keys -> all_to_all bucket exchange
     -> per-device multi-key sort.  Returns per-device sorted global
@@ -99,26 +149,9 @@ def _make_sort_step(mesh, records_cap: int):
         count, base = count[0], base[0]
         cols = unpack_fixed_fields(data, offsets)
         valid = jnp.arange(R, dtype=jnp.int32) < count
-        refid, pos = cols["refid"], cols["pos"]
-        hi = jnp.where(refid < 0, jnp.uint32(0xFFFFFFFF),
-                       refid.astype(jnp.uint32))
-        lo = pos.astype(jnp.uint32) + jnp.uint32(1)
-        hi = jnp.where(valid, hi, jnp.uint32(0xFFFFFFFF))
-        lo = jnp.where(valid, lo, jnp.uint32(0xFFFFFFFF))
-        gidx = jnp.where(valid, base + jnp.arange(R, dtype=jnp.int32),
-                         _I32_SENTINEL)
-
-        # lexicographic bucket id: how many boundaries are <= key
-        ge = ((hi[:, None] > bhi[None, :])
-              | ((hi[:, None] == bhi[None, :])
-                 & (lo[:, None] >= blo[None, :])))
-        bucket = jnp.sum(ge.astype(jnp.int32), axis=1)      # [R] 0..n_dev-1
-
-        # pack per-destination rows: stable order within each bucket
-        perm = jnp.argsort(bucket, stable=True)
-        sb = bucket[perm]
-        rank = jnp.arange(R, dtype=jnp.int32) - jnp.searchsorted(
-            sb, sb, side="left").astype(jnp.int32)
+        hi, lo, gidx = _device_keys(cols["refid"], cols["pos"], valid,
+                                    base, R)
+        perm, sb, rank = _bucket_pack(hi, lo, bhi, blo, R)
         send_hi = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
                            ).at[sb, rank].set(hi[perm])
         send_lo = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
@@ -143,11 +176,311 @@ def _make_sort_step(mesh, records_cap: int):
         out_specs=P("data"), check_vma=False))
 
 
+def _record_lens(data: np.ndarray, offs: np.ndarray) -> np.ndarray:
+    """Per-record total byte lengths (block_size field + its own 4)."""
+    base = offs.astype(np.int64)
+    return (data[base[:, None] + np.arange(4)].view("<i4").ravel()
+            .astype(np.int64) + 4)
+
+
+def _pack_record_rows(data: np.ndarray, offs: np.ndarray, bs: np.ndarray,
+                      records_cap: int, stride: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Zero-padded [records_cap, stride] u8 row tile + per-row lengths
+    from walked record offsets + precomputed lengths — the fixed-shape
+    unit the byte exchange ships through all_to_all."""
+    rows = np.zeros((records_cap, stride), np.uint8)
+    lens = np.zeros(records_cap, np.int32)
+    n = offs.size
+    if not n:
+        return rows, lens
+    if int(bs.max()) > stride:
+        raise ValueError(f"record of {int(bs.max())} bytes exceeds the "
+                         f"agreed row stride {stride}")
+    lens[:n] = bs
+    base = offs.astype(np.int64)
+    f = (np.arange(int(bs.sum()), dtype=np.int64)
+         - np.repeat(np.cumsum(bs) - bs, bs))
+    rows[np.repeat(np.arange(n), bs), f] = data[np.repeat(base, bs) + f]
+    return rows, lens
+
+
+def _make_bytes_sort_step(mesh, records_cap: int, stride: int):
+    """shard_map step for the byte exchange: rows -> device keys ->
+    all_to_all of (key, index, length, row bytes) -> per-device bucket
+    sort -> bucket-sorted rows.  Unlike the index step, the permutation
+    is applied ON DEVICE (take along the row axis), so hosts never need
+    remote spans."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    R = records_cap
+    N = n_dev * R
+
+    def le_i32(rows, col):
+        b = rows[:, col:col + 4].astype(jnp.uint32)
+        v = (b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24))
+        return jax.lax.bitcast_convert_type(v, jnp.int32)
+
+    def per_device(rows, lens, count, base, bhi, blo):
+        rows, lens = rows[0], lens[0]
+        count, base = count[0], base[0]
+        refid = le_i32(rows, 4)          # BAM fixed fields live at the
+        pos = le_i32(rows, 8)            # row head: refID @4, pos @8
+        valid = jnp.arange(R, dtype=jnp.int32) < count
+        hi, lo, gidx = _device_keys(refid, pos, valid, base, R)
+        # capacity is structural (a source holds at most R records, so
+        # no (src, dst) send cell can overflow)
+        perm, sb, rank = _bucket_pack(hi, lo, bhi, blo, R)
+        send_hi = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
+                           ).at[sb, rank].set(hi[perm])
+        send_lo = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
+                           ).at[sb, rank].set(lo[perm])
+        send_ix = jnp.full((n_dev, R), _I32_SENTINEL, jnp.int32
+                           ).at[sb, rank].set(gidx[perm])
+        send_ln = jnp.zeros((n_dev, R), jnp.int32
+                            ).at[sb, rank].set(lens[perm])
+        send_rows = jnp.zeros((n_dev, R, stride), jnp.uint8
+                              ).at[sb, rank].set(rows[perm])
+
+        recv_hi = jax.lax.all_to_all(send_hi, "data", 0, 0,
+                                     tiled=True).ravel()
+        recv_lo = jax.lax.all_to_all(send_lo, "data", 0, 0,
+                                     tiled=True).ravel()
+        recv_ix = jax.lax.all_to_all(send_ix, "data", 0, 0,
+                                     tiled=True).ravel()
+        recv_ln = jax.lax.all_to_all(send_ln, "data", 0, 0,
+                                     tiled=True).ravel()
+        recv_rows = jax.lax.all_to_all(send_rows, "data", 0, 0,
+                                       tiled=True).reshape(N, stride)
+
+        iota = jnp.arange(N, dtype=jnp.int32)
+        _, _, six, order = jax.lax.sort(
+            (recv_hi, recv_lo, recv_ix, iota), num_keys=3)
+        sorted_rows = jnp.take(recv_rows, order, axis=0)
+        sorted_ln = jnp.take(recv_ln, order)
+        return sorted_rows[None], sorted_ln[None], six[None]
+
+    return jax.jit(jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P("data"), P(), P()),
+        out_specs=(P("data"), P("data"), P("data")), check_vma=False))
+
+
+def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
+                         config: HBamConfig,
+                         header: Optional[SAMHeader]) -> int:
+    """Byte-exchange mesh sort: works multi-host.  Each process decodes
+    only its devices' spans; record bytes ride the all_to_all; each host
+    writes its buckets as headerless shards; host 0 merges."""
+    import os
+    import shutil
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hadoop_bam_tpu.formats.bamio import BamWriter, read_bam_header
+    from hadoop_bam_tpu.parallel.distributed import broadcast_plan
+    from hadoop_bam_tpu.parallel.pipeline import _decode_span_core
+    from hadoop_bam_tpu.split.planners import plan_bam_spans_balanced
+    from hadoop_bam_tpu.utils.sort import _sorted_header
+
+    mesh_devs = list(mesh.devices.ravel())
+    n_dev = len(mesh_devs)
+    pid = jax.process_index()
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
+    if header is None:
+        header, _ = read_bam_header(input_path)
+
+    # host 0 plans once (split guessing does real I/O); everyone receives
+    spans = broadcast_plan(
+        plan_bam_spans_balanced(input_path, n_dev, header=header)
+        if pid == 0 else None)
+
+    # decode ONLY the spans owned by this process's mesh devices
+    local_pos = [d for d, dev in enumerate(mesh_devs)
+                 if dev.process_index == pid]
+    local = {}
+    his: List[np.ndarray] = []
+    los: List[np.ndarray] = []
+    counts_vec = np.zeros(n_dev, np.int64)
+    max_len = 0
+    for d in local_pos:
+        if d >= len(spans):
+            continue
+        data, offs, _voffs, _ = _decode_span_core(
+            input_path, spans[d], False, "auto", want_voffs=False)
+        lens_ = _record_lens(data, offs)
+        local[d] = (data, offs, lens_)
+        counts_vec[d] = offs.size
+        if offs.size:
+            max_len = max(max_len, int(lens_.max()))
+        h, l = _keys_of(data, offs)
+        his.append(h)
+        los.append(l)
+
+    # agree on global geometry: counts/base, row stride, bucket bounds.
+    # Boundary choice only affects balance, never order (buckets are a
+    # range partition and every bucket is fully sorted), so a modest
+    # fixed-size per-process sample is enough.
+    SAMPLE = 4096
+    hi_s = np.concatenate(his) if his else np.zeros(0, np.uint32)
+    lo_s = np.concatenate(los) if los else np.zeros(0, np.uint32)
+    if hi_s.size > SAMPLE:
+        step_ = -(-hi_s.size // SAMPLE)
+        hi_s, lo_s = hi_s[::step_], lo_s[::step_]
+    if n_proc > 1:
+        meta = np.zeros(n_dev + 2, np.int64)
+        meta[:n_dev] = counts_vec
+        meta[n_dev] = max_len
+        meta[n_dev + 1] = hi_s.size
+        sample = np.full((SAMPLE, 2), 0xFFFFFFFF, np.uint32)
+        sample[:hi_s.size, 0] = hi_s
+        sample[:hi_s.size, 1] = lo_s
+        g_meta = np.asarray(multihost_utils.process_allgather(meta))
+        g_sample = np.asarray(multihost_utils.process_allgather(sample))
+        counts_vec = g_meta[:, :n_dev].sum(axis=0)
+        max_len = int(g_meta[:, n_dev].max())
+        shis = [g_sample[p, :int(g_meta[p, n_dev + 1]), 0].astype(np.uint32)
+                for p in range(n_proc)]
+        slos = [g_sample[p, :int(g_meta[p, n_dev + 1]), 1].astype(np.uint32)
+                for p in range(n_proc)]
+    else:
+        shis, slos = [hi_s], [lo_s]
+    total = int(counts_vec.sum())
+    if total > 2**31 - 2:
+        raise ValueError(f"{total} records exceed the int32 global-index "
+                         f"layout; use utils.sort.sort_bam")
+    bhi, blo = _sample_bounds(shis, slos, n_dev)
+
+    records_cap = _round_up(int(counts_vec.max()) if total else 1, 8)
+    stride = _round_up(max(max_len, 36), 64)
+    base_vec = np.zeros(n_dev, np.int64)
+    base_vec[1:] = np.cumsum(counts_vec[:-1])
+
+    sharding = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    _empty = (np.zeros(0, np.uint8), np.zeros(0, np.int64),
+              np.zeros(0, np.int64))
+    packed = {}
+    for d in local_pos:
+        data, offs, lens_ = local.pop(d, _empty)
+        packed[d] = _pack_record_rows(data, offs, lens_, records_cap,
+                                      stride)
+
+    def sharded(shape, dtype, of_d):
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding,
+            [jax.device_put(of_d(d), mesh_devs[d]) for d in local_pos],
+            dtype=dtype)
+
+    def replicated(arr, dtype):
+        return jax.make_array_from_single_device_arrays(
+            arr.shape, rep,
+            [jax.device_put(arr, mesh_devs[d]) for d in local_pos],
+            dtype=dtype)
+
+    rows_g = sharded((n_dev, records_cap, stride), jnp.uint8,
+                     lambda d: packed[d][0][None])
+    lens_g = sharded((n_dev, records_cap), jnp.int32,
+                     lambda d: packed[d][1][None])
+    count_g = sharded((n_dev,), jnp.int32,
+                      lambda d: np.asarray([counts_vec[d]], np.int32))
+    base_g = sharded((n_dev,), jnp.int32,
+                     lambda d: np.asarray([base_vec[d]], np.int32))
+    bhi_g = replicated(bhi, jnp.uint32)
+    blo_g = replicated(blo, jnp.uint32)
+
+    step = _make_bytes_sort_step(mesh, records_cap, stride)
+    rows_s, lens_s, six_s = step(rows_g, lens_g, count_g, base_g,
+                                 bhi_g, blo_g)
+
+    # every host holds ONLY its devices' buckets; bucket order IS the
+    # global order
+    out_header = _sorted_header(header, by_name=False)
+
+    def buckets(garr):
+        return {sh.index[0].start: np.asarray(sh.data)[0]
+                for sh in garr.addressable_shards}
+
+    b_rows, b_lens, b_six = buckets(rows_s), buckets(lens_s), buckets(six_s)
+
+    def bucket_payload(b):
+        keep = b_six[b] != _I32_SENTINEL
+        n = int(keep.sum())
+        if not n:
+            return b"", 0
+        rows = b_rows[b][keep]
+        lens = b_lens[b][keep].astype(np.int64)
+        colmask = np.arange(stride)[None, :] < lens[:, None]
+        return rows[colmask].tobytes(), n
+
+    written = 0
+    if n_proc == 1:
+        # one continuous BGZF stream — byte-identical to sort_bam
+        with BamWriter(output_path, out_header) as w:
+            for b in sorted(b_rows):
+                payload, n = bucket_payload(b)
+                w.write_raw(payload, n_records=n)
+                written += n
+    else:
+        # parallel headerless shard writes (each host deflates its own
+        # buckets), then host 0 re-blocks them into the continuous
+        # stream so the merged file still matches sort_bam exactly
+        shard_dir = output_path + ".mesh-shards"
+        os.makedirs(shard_dir, exist_ok=True)
+        for b in sorted(b_rows):
+            payload, n = bucket_payload(b)
+            part = os.path.join(shard_dir, f"part-{b:05d}")
+            with BamWriter(part, out_header, write_header=False,
+                           write_eof=False) as w:
+                w.write_raw(payload, n_records=n)
+            written += n
+
+    if n_proc > 1:
+        g_written = np.asarray(multihost_utils.process_allgather(
+            np.asarray([written], np.int64)))
+        written = int(g_written.sum())
+    if written != total:
+        raise RuntimeError(
+            f"mesh sort wrote {written} of {total} records — bucket "
+            f"exchange lost data; output is invalid")
+    if n_proc > 1:
+        from hadoop_bam_tpu.utils.mergers import merge_bam_shards_reblocked
+        if pid == 0:
+            # every device position writes exactly one part (empty buckets
+            # included), so a missing part means shared-FS lag or data
+            # loss — refuse to merge a truncated file
+            parts = [os.path.join(shard_dir, f"part-{b:05d}")
+                     for b in range(n_dev)]
+            missing = [p for p in parts if not os.path.exists(p)]
+            if missing:
+                raise RuntimeError(
+                    f"mesh sort shard(s) missing at merge time: "
+                    f"{missing[:3]}{'...' if len(missing) > 3 else ''} — "
+                    f"is {shard_dir} on a filesystem shared by all hosts?")
+            merge_bam_shards_reblocked(parts, output_path, out_header)
+            shutil.rmtree(shard_dir, ignore_errors=True)
+        # don't return before host 0's merge lands on the shared FS
+        multihost_utils.sync_global_devices("hbam_mesh_sort_done")
+    return total
+
+
 def sort_bam_mesh(input_path: str, output_path: str, *,
                   mesh=None, config: HBamConfig = DEFAULT_CONFIG,
-                  header: Optional[SAMHeader] = None) -> int:
+                  header: Optional[SAMHeader] = None,
+                  exchange: Optional[str] = None) -> int:
     """Coordinate-sort a BAM over the mesh; byte-identical to
     utils/sort.py::sort_bam(by_name=False).  Returns the record count.
+
+    ``exchange`` picks the shuffle flavor (module docstring): "index"
+    (default single-host) or "bytes" (default — and required — when
+    ``jax.process_count() > 1``).
 
     Queryname sort keys are variable-length byte strings with no fixed-
     width device representation; use sort_bam for those.
@@ -161,13 +494,20 @@ def sort_bam_mesh(input_path: str, output_path: str, *,
     from hadoop_bam_tpu.split.planners import plan_bam_spans_balanced
     from hadoop_bam_tpu.utils.sort import _sorted_header
 
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "sort_bam_mesh decodes every span on the calling host; "
-            "multi-host meshes are not supported yet — run per host or "
-            "use utils.sort.sort_bam")
+    if exchange is None:
+        exchange = "bytes" if jax.process_count() > 1 else "index"
+    if exchange not in ("index", "bytes"):
+        raise ValueError(f"unknown exchange mode {exchange!r}; "
+                         f"expected 'index' or 'bytes'")
     if mesh is None:
         mesh = make_mesh()
+    if exchange == "bytes":
+        return _sort_bam_mesh_bytes(input_path, output_path, mesh=mesh,
+                                    config=config, header=header)
+    if jax.process_count() > 1:
+        raise ValueError(
+            "exchange='index' keeps every decoded span on the calling "
+            "host and cannot run multi-host; use exchange='bytes'")
     n_dev = int(np.prod(mesh.devices.shape))
     if header is None:
         header, _ = read_bam_header(input_path)

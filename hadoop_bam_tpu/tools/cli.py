@@ -355,9 +355,11 @@ def cmd_sort(args) -> int:
                 "sort holds the inflated input in host memory instead — "
                 "drop --run-records or drop --mesh")
         from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
-        n = sort_bam_mesh(args.input, args.output)
+        n = sort_bam_mesh(args.input, args.output, exchange=args.exchange)
         print(f"wrote {args.output} ({n} records, coordinate, mesh)")
         return 0
+    if args.exchange is not None:
+        raise SystemExit("--exchange only applies to --mesh")
     from hadoop_bam_tpu.utils.sort import sort_bam
 
     if args.run_records is not None and args.run_records <= 0:
@@ -473,6 +475,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bucketed sort over the device mesh (device key "
                          "extraction + all_to_all exchange; coordinate "
                          "order only, input must fit host memory)")
+    so.add_argument("--exchange", choices=("index", "bytes"), default=None,
+                    help="mesh shuffle flavor: 'index' (keys only ride the "
+                         "all_to_all; single-host) or 'bytes' (record bytes "
+                         "ride it; required and default under "
+                         "jax.distributed multi-host runs)")
     so.set_defaults(fn=cmd_sort)
 
     cov = sub.add_parser("coverage",

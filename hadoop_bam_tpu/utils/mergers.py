@@ -48,6 +48,30 @@ def merge_bam_shards(shard_paths: Sequence[str], out_path: str,
         out.write(bgzf.EOF_BLOCK)
 
 
+def merge_bam_shards_reblocked(shard_paths: Sequence[str], out_path: str,
+                               header: SAMHeader, level: int = 6) -> None:
+    """Like merge_bam_shards, but re-compresses the shards into ONE
+    continuous BGZF stream (header and records share the 64 KiB block
+    framing) instead of concatenating shard members.  The output is
+    byte-identical to writing the same records through a single
+    streaming BamWriter — the property the mesh sort's multi-host path
+    needs to match sort_bam exactly.  Costs one inflate+deflate pass on
+    the merging host; use merge_bam_shards when member-concat framing
+    is acceptable."""
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.ops import inflate as inflate_ops
+
+    with open(out_path, "wb") as out:
+        with BamWriter(out, header, level=level) as w:
+            for p in shard_paths:
+                raw = open(p, "rb").read()
+                if not raw:
+                    continue
+                table = inflate_ops.block_table(raw)
+                data, _ = inflate_ops.inflate_span(raw, table)
+                w.write_raw(data.tobytes())
+
+
 def merge_sam_shards(shard_paths: Sequence[str], out_path: str,
                      header: SAMHeader) -> None:
     with open(out_path, "w") as out:

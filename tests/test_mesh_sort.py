@@ -29,11 +29,11 @@ def _write_shuffled(tmp_path, recs, header, seed=1):
     return path
 
 
-def _assert_identical(tmp_path, path):
+def _assert_identical(tmp_path, path, exchange=None):
     a = str(tmp_path / "single.bam")
     b = str(tmp_path / "mesh.bam")
     n1 = sort_bam(path, a)
-    n2 = sort_bam_mesh(path, b)
+    n2 = sort_bam_mesh(path, b, exchange=exchange)
     assert n1 == n2
     assert open(a, "rb").read() == open(b, "rb").read()
     return n1
@@ -84,6 +84,85 @@ def test_mesh_sort_fewer_records_than_devices(tmp_path):
     recs = make_records(header, 3, seed=9)
     path = _write_shuffled(tmp_path, recs, header, seed=9)
     _assert_identical(tmp_path, path)
+
+
+@pytest.mark.parametrize("case", ["mixed", "skewed", "tiny"])
+def test_mesh_sort_bytes_exchange_identical(tmp_path, case):
+    """The byte-exchange shuffle (records ride the all_to_all) must be
+    byte-identical to both the index-exchange mesh sort and sort_bam."""
+    from hadoop_bam_tpu.formats.sam import SamRecord
+    header = make_header()
+    if case == "mixed":
+        recs = make_records(header, 1500, seed=21)
+    elif case == "skewed":
+        recs = [SamRecord(qname=f"r{i}", flag=0, rname=header.ref_names[0],
+                          pos=500, mapq=9, cigar="10M", rnext="*", pnext=0,
+                          tlen=0, seq="ACGTACGTAC", qual="IIIIIIIIII")
+                for i in range(700)]
+    else:
+        recs = make_records(header, 5, seed=23)
+    path = _write_shuffled(tmp_path, recs, header, seed=22)
+    _assert_identical(tmp_path, path, exchange="bytes")
+
+
+def test_mesh_sort_exchange_validation(tmp_path):
+    header = make_header()
+    recs = make_records(header, 10, seed=30)
+    path = _write_shuffled(tmp_path, recs, header, seed=30)
+    with pytest.raises(ValueError, match="exchange"):
+        sort_bam_mesh(path, str(tmp_path / "o.bam"), exchange="nope")
+
+
+_MULTIHOST_CHILD = """\
+import os, sys
+idx, port, src, out = int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4]
+os.environ["XLA_FLAGS"] = ""   # no inherited forced device count
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=idx)
+assert jax.process_count() == 2 and len(jax.devices()) == 4
+from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
+n = sort_bam_mesh(src, out)      # multi-host default: exchange="bytes"
+print("SORTED", n, flush=True)
+"""
+
+
+def test_mesh_sort_two_process_distributed(tmp_path):
+    """The VERDICT r3 acceptance bar: a REAL 2-process jax.distributed
+    run (gloo CPU collectives, 2 devices per process) where each process
+    decodes only its spans, byte-identical to sort_bam."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    header = make_header()
+    recs = make_records(header, 1200, seed=33)
+    path = _write_shuffled(tmp_path, recs, header, seed=33)
+    out = str(tmp_path / "dist.bam")
+    child = str(tmp_path / "child.py")
+    with open(child, "w") as f:
+        f.write(_MULTIHOST_CHILD)
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [_sys.executable, child, str(i), str(port), path, out],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=repo) for i in range(2)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"child failed:\n{so}\n{se[-2000:]}"
+        assert "SORTED 1200" in so
+    ref = str(tmp_path / "ref.bam")
+    sort_bam(path, ref)
+    assert open(out, "rb").read() == open(ref, "rb").read()
 
 
 def test_mesh_sort_cli(tmp_path):
