@@ -131,6 +131,22 @@ def _bucket_pack(hi, lo, bhi, blo, R):
     return perm, sb, rank
 
 
+def _send_matrices(hi, lo, gidx, perm, sb, rank, n_dev, R):
+    """Per-destination [n_dev, R] send matrices for the key triple —
+    sentinel-filled so unreceived cells sort last and drop at write
+    time.  Shared by both exchange modes (drift here would break their
+    byte-identity contract)."""
+    import jax.numpy as jnp
+
+    send_hi = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
+                       ).at[sb, rank].set(hi[perm])
+    send_lo = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
+                       ).at[sb, rank].set(lo[perm])
+    send_ix = jnp.full((n_dev, R), _I32_SENTINEL, jnp.int32
+                       ).at[sb, rank].set(gidx[perm])
+    return send_hi, send_lo, send_ix
+
+
 def _make_sort_step(mesh, records_cap: int):
     """shard_map step: tiles -> device keys -> all_to_all bucket exchange
     -> per-device multi-key sort.  Returns per-device sorted global
@@ -152,12 +168,8 @@ def _make_sort_step(mesh, records_cap: int):
         hi, lo, gidx = _device_keys(cols["refid"], cols["pos"], valid,
                                     base, R)
         perm, sb, rank = _bucket_pack(hi, lo, bhi, blo, R)
-        send_hi = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
-                           ).at[sb, rank].set(hi[perm])
-        send_lo = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
-                           ).at[sb, rank].set(lo[perm])
-        send_ix = jnp.full((n_dev, R), _I32_SENTINEL, jnp.int32
-                           ).at[sb, rank].set(gidx[perm])
+        send_hi, send_lo, send_ix = _send_matrices(hi, lo, gidx, perm,
+                                                   sb, rank, n_dev, R)
 
         # the shuffle: row b of each device goes to device b
         recv_hi = jax.lax.all_to_all(send_hi, "data", 0, 0, tiled=True)
@@ -234,12 +246,8 @@ def _make_bytes_sort_step(mesh, records_cap: int, stride: int):
         # capacity is structural (a source holds at most R records, so
         # no (src, dst) send cell can overflow)
         perm, sb, rank = _bucket_pack(hi, lo, bhi, blo, R)
-        send_hi = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
-                           ).at[sb, rank].set(hi[perm])
-        send_lo = jnp.full((n_dev, R), 0xFFFFFFFF, jnp.uint32
-                           ).at[sb, rank].set(lo[perm])
-        send_ix = jnp.full((n_dev, R), _I32_SENTINEL, jnp.int32
-                           ).at[sb, rank].set(gidx[perm])
+        send_hi, send_lo, send_ix = _send_matrices(hi, lo, gidx, perm,
+                                                   sb, rank, n_dev, R)
         send_ln = jnp.zeros((n_dev, R), jnp.int32
                             ).at[sb, rank].set(lens[perm])
         send_rows = jnp.zeros((n_dev, R, stride), jnp.uint8
@@ -433,6 +441,11 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
         # buckets), then host 0 re-blocks them into the continuous
         # stream so the merged file still matches sort_bam exactly
         shard_dir = output_path + ".mesh-shards"
+        if pid == 0:
+            # stale parts from an earlier failed run must not survive
+            # into this merge; barrier before anyone writes new ones
+            shutil.rmtree(shard_dir, ignore_errors=True)
+        multihost_utils.process_allgather(np.zeros(1, np.int32))
         os.makedirs(shard_dir, exist_ok=True)
         for b in sorted(b_rows):
             payload, n = bucket_payload(b)
@@ -452,22 +465,36 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
             f"exchange lost data; output is invalid")
     if n_proc > 1:
         from hadoop_bam_tpu.utils.mergers import merge_bam_shards_reblocked
+        merge_err = None
         if pid == 0:
-            # every device position writes exactly one part (empty buckets
-            # included), so a missing part means shared-FS lag or data
-            # loss — refuse to merge a truncated file
-            parts = [os.path.join(shard_dir, f"part-{b:05d}")
-                     for b in range(n_dev)]
-            missing = [p for p in parts if not os.path.exists(p)]
-            if missing:
-                raise RuntimeError(
-                    f"mesh sort shard(s) missing at merge time: "
-                    f"{missing[:3]}{'...' if len(missing) > 3 else ''} — "
-                    f"is {shard_dir} on a filesystem shared by all hosts?")
-            merge_bam_shards_reblocked(parts, output_path, out_header)
-            shutil.rmtree(shard_dir, ignore_errors=True)
-        # don't return before host 0's merge lands on the shared FS
-        multihost_utils.sync_global_devices("hbam_mesh_sort_done")
+            try:
+                # every device position writes exactly one part (empty
+                # buckets included), so a missing part means shared-FS
+                # lag or data loss — refuse to merge a truncated file
+                parts = [os.path.join(shard_dir, f"part-{b:05d}")
+                         for b in range(n_dev)]
+                missing = [p for p in parts if not os.path.exists(p)]
+                if missing:
+                    raise RuntimeError(
+                        f"mesh sort shard(s) missing at merge time: "
+                        f"{missing[:3]}"
+                        f"{'...' if len(missing) > 3 else ''} — is "
+                        f"{shard_dir} on a filesystem shared by all "
+                        f"hosts?")
+                merge_bam_shards_reblocked(parts, output_path, out_header)
+                shutil.rmtree(shard_dir, ignore_errors=True)
+            except Exception as e:  # noqa: BLE001 — must reach the barrier
+                merge_err = e
+        # barrier doubling as failure broadcast: a raise before this
+        # point on one process only would deadlock the others, so host
+        # 0 always arrives here and ships ok/failed to everyone
+        ok = np.asarray([0 if merge_err is not None else 1], np.int32)
+        g_ok = np.asarray(multihost_utils.process_allgather(ok))
+        if merge_err is not None:
+            raise merge_err
+        if int(g_ok.min()) == 0:
+            raise RuntimeError("mesh sort merge failed on host 0; "
+                               "output is invalid")
     return total
 
 

@@ -1,0 +1,298 @@
+"""Externally-derived interop fixtures (VERDICT r3 item #5).
+
+Every byte literal in this file was transcribed or hand-derived from the
+PUBLIC hts-specs documents (SAMv1.pdf, VCFv4.3.pdf, CRAMv3.pdf) — NOT
+produced by this repo's encoders — so these tests break the
+self-referential golden loop: they pin the codecs against the published
+wire formats themselves.  Each literal's derivation is spelled out next
+to it so an auditor can re-check it against the spec text without
+running any code.
+
+Families covered: BGZF (the spec's published EOF literal), BAM (the
+SAMv1 section 1.1 example read r001 hand-encoded via section 4.2's
+layout), the binning scheme (clean-room port of the section 5.3 C
+code), BCF2 typed values + a hand-built record (VCFv4.3 section 6.3),
+and CRAM ITF8/LTF8 vectors (CRAMv3 section 2.3).
+"""
+import struct
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# BGZF: the EOF marker is published byte-for-byte in SAMv1 section 4.1.2
+# ---------------------------------------------------------------------------
+
+# [SPEC-transcribed] SAMv1 4.1.2: "The absence of a final block with
+# SLEN=0 ... an end-of-file marker":
+SPEC_BGZF_EOF = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000")
+
+
+def test_bgzf_eof_literal_matches_spec():
+    from hadoop_bam_tpu.formats import bgzf
+
+    assert bgzf.EOF_BLOCK == SPEC_BGZF_EOF
+    info = bgzf.parse_block_header(SPEC_BGZF_EOF)
+    assert info.block_size == len(SPEC_BGZF_EOF) == 28
+    assert info.isize == 0
+    assert bgzf.inflate_block(SPEC_BGZF_EOF) == b""
+
+
+def test_bgzf_header_magic_fields():
+    """SAMv1 4.1: ID1=31, ID2=139, CM=8, FLG=4, XLEN>=6, SI1=66, SI2=67,
+    SLEN=2 — asserted on the spec's own EOF literal."""
+    b = SPEC_BGZF_EOF
+    assert (b[0], b[1], b[2], b[3]) == (31, 139, 8, 4)
+    xlen = struct.unpack_from("<H", b, 10)[0]
+    assert xlen == 6
+    assert (b[12], b[13]) == (66, 67)                  # 'B', 'C'
+    assert struct.unpack_from("<H", b, 14)[0] == 2     # SLEN
+    assert struct.unpack_from("<H", b, 16)[0] == 27    # BSIZE-1
+
+
+# ---------------------------------------------------------------------------
+# BAM record wire: SAMv1 section 1.1's first example alignment, encoded by
+# hand following the section 4.2 layout table.
+#
+#   r001  99  ref  7  30  8M2I4M1D3M  =  37  39  TTAGATAAAGGATACTG  *
+#
+# Field derivation (every value computed from the spec text, not code):
+#   block_size  = 32 fixed + 5 name + 20 cigar + 9 seq + 17 qual = 83
+#   refID       = 0,   pos = 7-1 = 6 (0-based)
+#   l_read_name = len("r001")+NUL = 5,  MAPQ = 30
+#   bin         = reg2bin(6, 22): CIGAR consumes 8M+4M+1D+3M = 16 ref
+#                 bases, so [beg,end) = [6,22); 6>>14 == 21>>14 == 0
+#                 -> 4681 + 0 = 4681 = 0x1249 (section 5.3)
+#   n_cigar_op  = 5,  FLAG = 99,  l_seq = 17
+#   next_refID  = 0 ('='),  next_pos = 37-1 = 36,  tlen = 39
+#   CIGAR uint32s (op_len<<4|op; MIDNSHP=X -> 0..8):
+#       8M=0x80  2I=0x21  4M=0x40  1D=0x12  3M=0x30
+#   SEQ nibbles ('=ACMGRSVTWYHKDBN' -> 0..15): T=8 A=1 G=4 C=2, pairs
+#       TT AG AT AA AG GA TA CT G. -> 88 14 18 11 14 41 81 28 40
+#   QUAL '*'    = 17 bytes of 0xFF (section 4.2.3)
+# ---------------------------------------------------------------------------
+
+SPEC_BAM_R001 = (
+    struct.pack("<i", 83)
+    + struct.pack("<iiBBHHHiiii",
+                  0, 6, 5, 30, 0x1249, 5, 99, 17, 0, 36, 39)
+    + b"r001\x00"
+    + bytes.fromhex("80000000" "21000000" "40000000" "12000000" "30000000")
+    + bytes.fromhex("881418111441812840")
+    + b"\xff" * 17
+)
+
+
+def _r001_header():
+    from hadoop_bam_tpu.formats.bam import SAMHeader
+
+    return SAMHeader.from_sam_text("@HD\tVN:1.6\n@SQ\tSN:ref\tLN:45\n")
+
+
+def test_bam_spec_example_decodes_field_by_field():
+    from hadoop_bam_tpu.formats.bam import BamBatch, walk_record_offsets
+
+    data = np.frombuffer(SPEC_BAM_R001, dtype=np.uint8)
+    offs = walk_record_offsets(data)
+    assert offs.size == 1
+    b = BamBatch(data, offs, header=_r001_header())
+    assert b.read_name(0) == "r001"
+    assert int(b.flag[0]) == 99
+    assert int(b.refid[0]) == 0
+    assert int(b.pos[0]) == 6
+    assert int(b.mapq[0]) == 30
+    assert int(b.bin[0]) == 4681
+    assert b.cigar_string(0) == "8M2I4M1D3M"
+    assert int(b.mate_refid[0]) == 0
+    assert int(b.mate_pos[0]) == 36
+    assert int(b.tlen[0]) == 39
+    assert b.seq_string(0) == "TTAGATAAAGGATACTG"
+    assert b.to_sam_line(0) == ("r001\t99\tref\t7\t30\t8M2I4M1D3M\t=\t37\t"
+                                "39\tTTAGATAAAGGATACTG\t*")
+
+
+def test_bam_spec_example_encodes_byte_identical():
+    """The encoder must reproduce the hand-derived spec bytes exactly."""
+    from hadoop_bam_tpu.formats.bam import encode_record
+
+    enc = encode_record(
+        name="r001", flag=99, refid=0, pos=6, mapq=30,
+        cigar=[(8, "M"), (2, "I"), (4, "M"), (1, "D"), (3, "M")],
+        mate_refid=0, mate_pos=36, tlen=39,
+        seq="TTAGATAAAGGATACTG", qual="*")
+    assert enc == SPEC_BAM_R001
+
+
+def _spec_reg2bin(beg: int, end: int) -> int:
+    """Clean-room transcription of SAMv1 section 5.3's C function
+    reg2bin(), used as an independent oracle for ours."""
+    end -= 1
+    if beg >> 14 == end >> 14:
+        return ((1 << 15) - 1) // 7 + (beg >> 14)
+    if beg >> 17 == end >> 17:
+        return ((1 << 12) - 1) // 7 + (beg >> 17)
+    if beg >> 20 == end >> 20:
+        return ((1 << 9) - 1) // 7 + (beg >> 20)
+    if beg >> 23 == end >> 23:
+        return ((1 << 6) - 1) // 7 + (beg >> 23)
+    if beg >> 26 == end >> 26:
+        return ((1 << 3) - 1) // 7 + (beg >> 26)
+    return 0
+
+
+def test_reg2bin_against_spec_oracle():
+    from hadoop_bam_tpu.formats.bam import reg2bin
+
+    # level anchors from the scheme: leaf bins start at 4681, 16 KiB wide
+    assert reg2bin(0, 1) == 4681
+    assert reg2bin(1 << 14, (1 << 14) + 1) == 4682
+    assert reg2bin(0, (1 << 29)) == 0      # whole-chromosome -> root bin
+    rng = np.random.default_rng(7)
+    for _ in range(500):
+        beg = int(rng.integers(0, 1 << 29))
+        end = beg + int(rng.integers(1, 1 << 20))
+        assert reg2bin(beg, end) == _spec_reg2bin(beg, end)
+    # boundary sweep: intervals straddling every level's tile edges
+    for shift in (14, 17, 20, 23, 26):
+        edge = 1 << shift
+        for beg, end in ((edge - 1, edge + 1), (edge, edge + 1),
+                         (edge - 1, edge)):
+            assert reg2bin(beg, end) == _spec_reg2bin(beg, end)
+
+
+# ---------------------------------------------------------------------------
+# BCF2 typed values: VCFv4.3 section 6.3.3.  Descriptor byte is
+# (count<<4)|type with types 1/2/3=int8/16/32, 5=float, 7=char; int8
+# MISSING=0x80, END_OF_VECTOR=0x81; counts >= 15 overflow into a
+# following typed int.
+# ---------------------------------------------------------------------------
+
+def test_bcf_typed_atoms_match_spec_literals():
+    from hadoop_bam_tpu.formats.bcf import (
+        encode_typed_int_scalar, encode_typed_ints, encode_typed_string,
+        read_typed,
+    )
+
+    # scalar 1 -> int8: descriptor 0x11, payload 0x01
+    assert encode_typed_int_scalar(1) == b"\x11\x01"
+    # 300 needs int16: descriptor 0x12, LE payload 0x2c 0x01
+    assert encode_typed_int_scalar(300) == b"\x12\x2c\x01"
+    # 70000 needs int32: descriptor 0x13
+    assert encode_typed_int_scalar(70000) == b"\x13" + struct.pack(
+        "<i", 70000)
+    # "PASS" -> descriptor (4<<4)|7 = 0x47 + ASCII
+    assert encode_typed_string("PASS") == b"\x47PASS"
+    # [3, None] -> int8 vector with MISSING sentinel 0x80
+    assert encode_typed_ints([3, None]) == b"\x21\x03\x80"
+    # padding uses END_OF_VECTOR 0x81
+    assert encode_typed_ints([3], pad_to=2) == b"\x21\x03\x81"
+    # count 15 overflows: descriptor 0xF1 + typed count + 16 payload bytes
+    enc = encode_typed_ints([1] * 16)
+    assert enc[:3] == b"\xf1\x11\x10"
+    # decode direction on a spec-shaped literal: 2 x int16 [256, -1]
+    typ, vals, off = read_typed(b"\x22\x00\x01\xff\xff", 0)
+    assert vals == [256, -1] and off == 5
+
+
+def test_bcf_hand_built_record_decodes():
+    """A complete BCF2 record assembled by hand from the section 6.3
+    layout table (l_shared/l_indiv, CHROM/POS/rlen/QUAL, packed counts,
+    typed site fields, typed genotype block), then decoded by the codec.
+
+    Site: chr1:100 rs1 A->C qual 30, FILTER PASS, INFO DP=7,
+    one sample with GT 0/1.
+    String dictionary [SPEC 6.2.1]: PASS=0, then DP=1, GT=2 (order of
+    appearance); contig dictionary: chr1=0.
+    """
+    from hadoop_bam_tpu.formats.bcf import BCFRecordCodec
+    from hadoop_bam_tpu.formats.vcf import VCFHeader
+
+    shared = (
+        struct.pack("<iii", 0, 99, 1)        # CHROM=0, POS0=99, rlen=1
+        + struct.pack("<f", 30.0)            # QUAL
+        + struct.pack("<HH", 1, 2)           # n_info=1 | n_allele=2
+        + struct.pack("<I", (1 << 24) | 1)   # n_fmt=1 | n_sample=1
+        + b"\x37rs1"                         # ID: 3 chars
+        + b"\x17A" + b"\x17C"                # REF, ALT alleles
+        + b"\x11\x00"                        # FILTER: [PASS=0]
+        + b"\x11\x01" + b"\x11\x07"          # INFO: key DP=1, value 7
+    )
+    indiv = (
+        b"\x11\x02"                          # FORMAT key GT=2
+        + b"\x21\x02\x04"                    # 2 x int8/sample: 0/1 ->
+    )                                        # (0+1)<<1=2, (1+1)<<1=4
+    rec_bytes = struct.pack("<II", len(shared), len(indiv)) + shared + indiv
+
+    header = VCFHeader.from_text(
+        "##fileformat=VCFv4.3\n"
+        "##contig=<ID=chr1,length=1000>\n"
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="Depth">\n'
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="Genotype">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\n")
+    assert header.string_dictionary()[:3] == ["PASS", "DP", "GT"]
+
+    codec = BCFRecordCodec(header)
+    rec, off = codec.decode(rec_bytes)
+    assert off == len(rec_bytes)
+    assert rec.chrom == "chr1"
+    assert rec.pos == 100                    # 1-based in VCF terms
+    assert rec.id == "rs1"
+    assert rec.ref == "A"
+    assert rec.alts == ("C",)
+    assert rec.qual == 30.0
+    assert rec.filters == ("PASS",)
+    assert rec.info.get("DP") in (7, "7")
+    assert rec.fmt == ("GT",)
+    assert rec.genotypes == ["0/1"]
+
+
+# ---------------------------------------------------------------------------
+# CRAM ITF8 / LTF8: CRAMv3 section 2.3.  The leading bits of the first
+# byte give the byte count; the 5-byte ITF8 form keeps only the LOW 4
+# bits of the final byte.  Vectors hand-derived from those rules.
+# ---------------------------------------------------------------------------
+
+ITF8_VECTORS = [
+    (0, "00"),
+    (1, "01"),
+    (127, "7f"),                    # largest 1-byte value (7 bits)
+    (128, "8080"),                  # 0x80|(v>>8), v&0xff
+    (16383, "bfff"),                # largest 2-byte value (14 bits)
+    (16384, "c04000"),              # 0xc0|(v>>16), ...
+    (2097151, "dfffff"),            # largest 3-byte value (21 bits)
+    (2097152, "e0200000"),
+    (268435455, "efffffff"),        # largest 4-byte value (28 bits)
+    (268435456, "f100000000"),      # 5-byte form: low nibble of last byte
+    (-1, "ffffffff0f"),             # 0xffffffff via the 5-byte quirk
+]
+
+LTF8_VECTORS = [
+    (0, "00"),
+    (127, "7f"),
+    (128, "8080"),
+    (1 << 14, "c0400000"[:6]),      # 16384 -> 3 bytes: c0 40 00
+    ((1 << 56) - 1, "fe" + "ff" * 7),
+    (-1, "ff" + "ff" * 8),          # 64-bit -1: 9 bytes, all set
+]
+
+
+@pytest.mark.parametrize("value,hexbytes", ITF8_VECTORS)
+def test_itf8_spec_vectors(value, hexbytes):
+    from hadoop_bam_tpu.formats.cram import read_itf8, write_itf8
+
+    raw = bytes.fromhex(hexbytes)
+    assert write_itf8(value) == raw
+    got, pos = read_itf8(raw, 0)
+    assert got == value and pos == len(raw)
+
+
+@pytest.mark.parametrize("value,hexbytes", LTF8_VECTORS)
+def test_ltf8_spec_vectors(value, hexbytes):
+    from hadoop_bam_tpu.formats.cram import read_ltf8, write_ltf8
+
+    raw = bytes.fromhex(hexbytes)
+    assert write_ltf8(value) == raw
+    got, pos = read_ltf8(raw, 0)
+    assert got == value and pos == len(raw)

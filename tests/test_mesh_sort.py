@@ -156,7 +156,13 @@ def test_mesh_sort_two_process_distributed(tmp_path):
         [_sys.executable, child, str(i), str(port), path, out],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env, cwd=repo) for i in range(2)]
-    outs = [p.communicate(timeout=240) for p in procs]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:        # a hung/failed child must not outlive pytest
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for p, (so, se) in zip(procs, outs):
         assert p.returncode == 0, f"child failed:\n{so}\n{se[-2000:]}"
         assert "SORTED 1200" in so
