@@ -735,6 +735,205 @@ def bench_deflate_tokenize(path: str):
 
 
 # ---------------------------------------------------------------------------
+# on-chip kernel rows (VERDICT r3 #7): what the TPU itself contributes per
+# stage, timed with the readback-grounded method from the r3 DEFLATE
+# experiment (BASELINE.md): block_until_ready can return before execution
+# completes on the tunneled chip, so each measurement is serialized chained
+# execution with a SCALAR readback per step, minus the measured
+# dispatch+readback floor.
+# ---------------------------------------------------------------------------
+
+_FLOOR_CACHE = {"v": None}
+
+
+def _readback_floor(reps: int = 10) -> float:
+    """Per-call dispatch + scalar-readback cost of a trivial jitted op.
+    Measured once and cached so all kernel rows share one floor."""
+    if _FLOOR_CACHE["v"] is not None:
+        return _FLOOR_CACHE["v"]
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((8, 128), jnp.float32)
+    f = jax.jit(lambda a: (a * 2.0).sum())
+    float(f(x))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        float(f(x))
+    _FLOOR_CACHE["v"] = (time.perf_counter() - t0) / reps
+    return _FLOOR_CACHE["v"]
+
+
+def _chained_time(fn, reps: int = 5) -> float:
+    """Mean wall seconds per fn() call, where fn returns a device scalar
+    whose float() forces completion through the tunnel."""
+    float(fn())                       # warmup: compile + caches
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        float(fn())
+    return (time.perf_counter() - t0) / reps
+
+
+def _scan_chain(step, length: int):
+    """Wrap a carry -> scalar kernel step in a length-iteration lax.scan
+    so one dispatch amortizes the ~70 ms floor over that many
+    data-dependent kernel executions (the carry feeds each step's
+    inputs, so XLA cannot hoist or elide the repeats).  Returns a
+    jitted fn(*args) -> scalar."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(*args):
+        def body(c, _):
+            return step(c, *args), ()
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=length)
+        return c
+    return run
+
+
+def _kernel_rate(step, args, work_per_iter: float):
+    """(work-units/s, extras) for one kernel iteration, floor-corrected.
+
+    The chain length adapts: it grows until the whole chain's wall time
+    dominates the dispatch floor (the tunneled floor is jittery, so a
+    fixed length can land inside its noise and make the subtraction
+    meaningless).  If even the longest chain stays within noise, the
+    row is flagged unreliable instead of reporting an absurd rate."""
+    floor = _readback_floor()
+    k = 16
+    while True:
+        run = _scan_chain(step, k)
+        raw = _chained_time(lambda: run(*args), reps=3)
+        if raw >= 4 * floor or k >= 4096:
+            break
+        k = min(k * 4, 4096)
+    dt = max(raw - floor, 1e-9)
+    extras = {"chain_len": k}
+    if raw < 1.5 * floor:
+        extras["unreliable"] = (
+            f"chain wall {raw * 1e3:.1f} ms is within noise of the "
+            f"{floor * 1e3:.1f} ms dispatch floor even at {k} steps")
+    return work_per_iter * k / dt, extras
+
+
+def bench_seq_pallas_kernel():
+    """Fused seq/qual Pallas kernel, bases/s on the device itself, vs the
+    single-thread NumPy host analog of the same stats."""
+    import jax.numpy as jnp
+
+    from hadoop_bam_tpu.ops.seq_pallas import (
+        seq_qual_stats, seq_qual_stats_host,
+    )
+
+    N, L = 8192, 151
+    rng = np.random.default_rng(3)
+    seq_np = rng.integers(0, 256, (N, (L + 1) // 2), dtype=np.uint8)
+    qual_np = rng.integers(0, 42, (N, L), dtype=np.uint8)
+    lens_np = np.full(N, L, np.int32)
+    seq, qual, lens = map(jnp.asarray, (seq_np, qual_np, lens_np))
+
+    def step(c, s, q, l):
+        # carry perturbs the qual tile: data dependence between steps
+        st = seq_qual_stats(s, (q + c.astype(jnp.uint8)) & 0x3F, l)
+        total = (st["gc"].sum() + st["mean_qual"].sum()
+                 + st["base_hist"].sum().astype(jnp.float32))
+        return c + 1.0 + total * jnp.float32(1e-20)   # keep st live
+
+    bases = N * L
+    rate, extras = _kernel_rate(step, (seq, qual, lens), bases)
+
+    _, bdt = _median_time(
+        lambda: seq_qual_stats_host(seq_np, qual_np, lens_np), reps=3)
+    return {"metric": "seq_pallas_kernel_bases_per_sec",
+            "value": round(rate, 1), "unit": "bases/s",
+            "vs_baseline": round(rate / (bases / bdt), 3),
+            "note": (f"on-chip only, adaptive scan chain, "
+                     "floor-corrected; baseline = single-thread NumPy "
+                     "host analog"), **extras}
+
+
+def bench_cigar_pileup_kernel(path: str):
+    """Device cigar-unpack + window-coverage kernels alone (no file IO,
+    no H2D in the timed region): records/s through the pileup math."""
+    import jax.numpy as jnp
+
+    from hadoop_bam_tpu.formats.bam import BamBatch, walk_record_offsets
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.ops.cigar import (
+        unpack_cigar_tiles, window_coverage_from_tiles,
+    )
+    from hadoop_bam_tpu.split.planners import plan_bam_spans
+    from hadoop_bam_tpu.parallel.pipeline import _decode_span_core
+
+    header, _ = read_bam_header(path)
+    span = plan_bam_spans(path, num_spans=4, header=header)[0]
+    data, offs, _v, _ = _decode_span_core(path, span, False, "auto",
+                                          want_voffs=False)
+    batch = BamBatch(data, offs)
+    n = len(batch)
+    max_cigar = max(int(batch.n_cigar.max()), 4)
+    window = 1 << 22
+
+    dev = {
+        "data": jnp.asarray(data),
+        "offsets": jnp.asarray(offs.astype(np.int32)),
+        "lrn": jnp.asarray(batch.l_read_name.astype(np.int32)),
+        "ncig": jnp.asarray(batch.n_cigar.astype(np.int32)),
+        "pos": jnp.asarray(batch.pos.astype(np.int32)),
+        "refid": jnp.asarray(batch.refid.astype(np.int32)),
+        "flag": jnp.asarray(batch.flag.astype(np.int32)),
+    }
+    valid = jnp.ones(n, bool)
+
+    def step(c, d):
+        # carry shifts the window start: dependent, never hoistable
+        tiles = unpack_cigar_tiles(d["data"], d["offsets"], d["lrn"],
+                                   d["ncig"], max_cigar)
+        depth = window_coverage_from_tiles(
+            tiles, d["pos"], d["refid"], d["flag"], valid,
+            jnp.int32(0), c.astype(jnp.int32) % 64, window)
+        return c + 1.0 + depth.sum().astype(jnp.float32) * jnp.float32(
+            1e-20)
+
+    rate, extras = _kernel_rate(step, (dev,), n)
+    return {"metric": "cigar_pileup_kernel_records_per_sec",
+            "value": round(rate, 1), "unit": "records/s",
+            "note": (f"on-chip unpack+pileup only ({n} records, "
+                     f"max_cigar={max_cigar}, 4 MiB window), "
+                     f"adaptive scan chain, floor-corrected"),
+            **extras}
+
+
+def bench_mesh_sort_kernel():
+    """The mesh sort's device stage alone: three-key lexicographic
+    lax.sort ((hi, lo, tie-break index), the bucket-local sort) —
+    keys/s on the chip."""
+    import jax
+    import jax.numpy as jnp
+
+    R = 1 << 18
+    rng = np.random.default_rng(11)
+    hi = jnp.asarray(rng.integers(0, 64, R, dtype=np.uint32))
+    lo = jnp.asarray(rng.integers(0, 1 << 28, R, dtype=np.uint32))
+    ix = jnp.arange(R, dtype=jnp.int32)
+
+    def step(c, a, b, t):
+        # carry xors the low key: each step sorts different data
+        a2 = a ^ c.astype(jnp.uint32)
+        _, _, six = jax.lax.sort((a2, b, t), num_keys=3)
+        return c + 1.0 + six.sum().astype(jnp.float32) * jnp.float32(
+            1e-20)
+
+    rate, extras = _kernel_rate(step, (hi, lo, ix), R)
+    return {"metric": "mesh_sort_device_sort_keys_per_sec",
+            "value": round(rate, 1), "unit": "keys/s",
+            "note": ("on-chip 3-key lax.sort of the bucket-local stage "
+                     f"({R} keys), adaptive scan chain, "
+                     "floor-corrected"), **extras}
+
+
+# ---------------------------------------------------------------------------
 # device-scaling curve (VERDICT r3 #2): flagstat/seq-stats/coverage at
 # 1/2/4/8 virtual CPU devices, each measured in a subprocess so the forced
 # device count can't leak into (or hang) the main run.  On this 1-core host
@@ -899,6 +1098,12 @@ def main() -> None:
                    "coverage_records_per_sec")
     _run_component(lambda: bench_bam_write(path),
                    "bam_write_records_per_sec")
+    _run_component(bench_seq_pallas_kernel,
+                   "seq_pallas_kernel_bases_per_sec")
+    _run_component(lambda: bench_cigar_pileup_kernel(path),
+                   "cigar_pileup_kernel_records_per_sec")
+    _run_component(bench_mesh_sort_kernel,
+                   "mesh_sort_device_sort_keys_per_sec")
 
     try:
         _STATE["scaling"] = bench_scaling()

@@ -446,18 +446,30 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
             # into this merge; barrier before anyone writes new ones
             shutil.rmtree(shard_dir, ignore_errors=True)
         multihost_utils.process_allgather(np.zeros(1, np.int32))
-        os.makedirs(shard_dir, exist_ok=True)
-        for b in sorted(b_rows):
-            payload, n = bucket_payload(b)
-            part = os.path.join(shard_dir, f"part-{b:05d}")
-            with BamWriter(part, out_header, write_header=False,
-                           write_eof=False) as w:
-                w.write_raw(payload, n_records=n)
-            written += n
+        write_err = None
+        try:
+            os.makedirs(shard_dir, exist_ok=True)
+            for b in sorted(b_rows):
+                payload, n = bucket_payload(b)
+                part = os.path.join(shard_dir, f"part-{b:05d}")
+                with BamWriter(part, out_header, write_header=False,
+                               write_eof=False) as w:
+                    w.write_raw(payload, n_records=n)
+                written += n
+        except Exception as e:  # noqa: BLE001 — must reach the collective
+            # a raise here on one host only (ENOSPC, EIO, ...) would
+            # strand the others in the allgather below; ship written=-1
+            # as the failure flag instead
+            write_err = e
 
     if n_proc > 1:
         g_written = np.asarray(multihost_utils.process_allgather(
-            np.asarray([written], np.int64)))
+            np.asarray([written if write_err is None else -1], np.int64)))
+        if write_err is not None:
+            raise write_err
+        if (g_written < 0).any():
+            raise RuntimeError("mesh sort shard write failed on another "
+                               "host; output is invalid")
         written = int(g_written.sum())
     if written != total:
         raise RuntimeError(

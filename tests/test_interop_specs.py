@@ -272,7 +272,7 @@ LTF8_VECTORS = [
     (0, "00"),
     (127, "7f"),
     (128, "8080"),
-    (1 << 14, "c0400000"[:6]),      # 16384 -> 3 bytes: c0 40 00
+    (1 << 14, "c04000"),            # 16384 -> 0xc0|(v>>16), 0x40, 0x00
     ((1 << 56) - 1, "fe" + "ff" * 7),
     (-1, "ff" + "ff" * 8),          # 64-bit -1: 9 bytes, all set
 ]
