@@ -34,9 +34,9 @@ CRAM_MINOR = 0
 RAW, GZIP, BZIP2, LZMA, RANS4x8 = 0, 1, 2, 3, 4
 RANSNx16, ARITH, FQZCOMP, NAME_TOK = 5, 6, 7, 8
 
-# 3.1 methods still unimplemented (tok3 is supported; see cram_name_tok3)
-_METHOD_31_NAMES = {ARITH: "adaptive arithmetic coder",
-                    FQZCOMP: "fqzcomp quality codec"}
+# 3.1 methods still unimplemented (tok3: cram_name_tok3; fqzcomp:
+# cram_fqzcomp)
+_METHOD_31_NAMES = {ARITH: "adaptive arithmetic coder"}
 
 # Block content types [SPEC section 8.1]
 FILE_HEADER = 0
@@ -199,6 +199,10 @@ class Block:
     content_id: int = 0
     data: bytes = b""
     method: int = RAW          # method to use when serializing
+    # method-specific serialization context: for FQZCOMP, the per-record
+    # lengths of the concatenated quality payload (the codec models
+    # record boundaries; a plain byte blob has none)
+    aux: Optional[list] = None
 
     def to_bytes(self) -> bytes:
         raw = self.data
@@ -227,6 +231,13 @@ class Block:
                 )
                 method = RANSNx16
                 comp = rans_nx16_encode(raw, NX16_PACK | NX16_RLE)
+        elif method == FQZCOMP:
+            from hadoop_bam_tpu.formats.cram_fqzcomp import fqz_encode
+            # no rANS fallback here: fqz_encode only raises when the
+            # per-record lengths disagree with the payload — a writer
+            # bug that must surface at write time, not ship as a
+            # silently-downgraded block
+            comp = fqz_encode(raw, self.aux if self.aux else [len(raw)])
         elif method == RAW:
             comp = raw
         else:
@@ -308,6 +319,9 @@ def decompress_block_payload(method: int, payload: bytes, rsize: int) -> bytes:
     if method == NAME_TOK:
         from hadoop_bam_tpu.formats.cram_name_tok3 import tok3_decode
         return tok3_decode(payload, rsize)
+    if method == FQZCOMP:
+        from hadoop_bam_tpu.formats.cram_fqzcomp import fqz_decode
+        return fqz_decode(payload, rsize)
     if method in _METHOD_31_NAMES:
         raise CRAMError(
             f"CRAM 3.1 block method {method} "

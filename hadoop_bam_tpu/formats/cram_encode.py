@@ -61,6 +61,7 @@ class _Streams:
         self.names = bytearray()
         self.tag_len: Dict[int, bytearray] = {}
         self.tag_val: Dict[int, bytearray] = {}
+        self.qual_lens: List[int] = []     # per-record QS lengths (fqzcomp)
 
     def put_int(self, key: str, v: int):
         self.ints[key] += write_itf8(v)
@@ -135,9 +136,10 @@ def encode_container(records: List[SamRecord], header: SAMHeader,
     # blocks: compression header, slice header, core, externals
     ext_blocks: List[Block] = []
     content_ids: List[int] = []
-    for cid, data, method in _external_payloads(streams, version):
+    for cid, data, method, aux in _external_payloads(streams, version):
         if data:
-            ext_blocks.append(Block(EXTERNAL_DATA, cid, bytes(data), method))
+            ext_blocks.append(Block(EXTERNAL_DATA, cid, bytes(data), method,
+                                    aux=aux))
             content_ids.append(cid)
 
     slice_hdr = SliceHeader(
@@ -179,6 +181,7 @@ def _encode_record(rec: SamRecord, s: _Streams, rid_of, rg_ids: List[str],
     cf = CF_DETACHED
     if has_qual:
         cf |= CF_QUAL_STORED
+        s.qual_lens.append(len(rec.qual))
     if not has_seq and not flag & 0x4:
         cf |= CF_UNKNOWN_BASES
     s.put_int("BF", bf)
@@ -304,6 +307,7 @@ def _external_payloads(s: _Streams, version: Tuple[int, int] = (3, 0)):
     # the well-understood GZIP method for interop-critical output.
     rans = RANSNx16 if version >= (3, 1) else RANS4x8
     names_method = GZIP
+    qual_method, qual_aux = rans, None
     if version >= (3, 1):
         knob = os.environ.get("HBAM_CRAM31_NAMES", "tok3").strip().lower()
         if knob not in ("tok3", "gzip"):   # fail closed, not open to tok3
@@ -311,19 +315,33 @@ def _external_payloads(s: _Streams, version: Tuple[int, int] = (3, 0)):
                 f"HBAM_CRAM31_NAMES={knob!r}: expected 'tok3' or 'gzip'")
         if knob == "tok3":
             names_method = NAME_TOK
+        # EXPERIMENTAL opt-in: quality series through the fqzcomp codec
+        # (decode is the supported direction; the layout caveat in
+        # cram_fqzcomp's docstring applies doubly to writes)
+        qknob = os.environ.get("HBAM_CRAM31_QUAL", "rans").strip().lower()
+        if qknob not in ("rans", "fqzcomp"):
+            raise ValueError(
+                f"HBAM_CRAM31_QUAL={qknob!r}: expected 'rans' or "
+                f"'fqzcomp'")
+        if qknob == "fqzcomp":
+            from hadoop_bam_tpu.formats.cram import FQZCOMP
+            qual_method, qual_aux = FQZCOMP, list(s.qual_lens)
     for k, data in s.ints.items():
-        yield _CID_INT[k], data, GZIP
+        yield _CID_INT[k], data, GZIP, None
     for k, data in s.bytes_.items():
         # QS = qualities, BA = literal bases: the two bulk byte series
-        yield _CID_BYTE[k], data, (rans if k in ("QS", "BA") else GZIP)
+        if k == "QS":
+            yield _CID_BYTE[k], data, qual_method, qual_aux
+        else:
+            yield _CID_BYTE[k], data, (rans if k == "BA" else GZIP), None
     for k in _ARRAY_SERIES:
-        yield _CID_ALEN[k], s.arr_len[k], GZIP
-        yield _CID_AVAL[k], s.arr_val[k], GZIP
-    yield _CID_NAMES, s.names, names_method
+        yield _CID_ALEN[k], s.arr_len[k], GZIP, None
+        yield _CID_AVAL[k], s.arr_val[k], GZIP, None
+    yield _CID_NAMES, s.names, names_method, None
     for key in s.tag_len:
         lo, hi = _tag_cids(key)
-        yield lo, s.tag_len[key], GZIP
-        yield hi, s.tag_val[key], GZIP
+        yield lo, s.tag_len[key], GZIP, None
+        yield hi, s.tag_val[key], GZIP, None
 
 
 def _build_compression_header(s: _Streams, tag_dict: List[bytes]

@@ -1,0 +1,537 @@
+"""CRAM 3.1 fqzcomp quality codec (block compression method 7).
+
+Rebuild of the fqzcomp_qual codec from the CRAM 3.1 compression-codecs
+spec (hts-specs CRAMcodecs: adaptive range coder + context-mixing
+quality model; upstream analog htscodecs/fqzcomp_qual.c, reached from
+hb via htsjdk's CRAM 3.1 reader per SURVEY.md §2.3).  Decode is the
+supported direction — it lets real 3.1 files whose quality blocks use
+method 7 read end-to-end (VERDICT r3 #8).  Encode exists primarily to
+exercise decode and as an EXPERIMENTAL opt-in for 3.1 writes
+(HBAM_CRAM31_QUAL=fqzcomp).
+
+Layout notes, honestly labelled:
+- The stream structure (vers=5, gflags/pflags bits, parameter block,
+  per-record sel/len/dup decoding, per-base context update) follows the
+  spec pseudocode [SPEC-recalled].
+- The adaptive-model constants (STEP, rescale bound) and the table
+  run-length serialization are [SPEC-recalled] reconstructions that have
+  NEVER been cross-validated against htscodecs output (no htslib in the
+  image — SURVEY.md §0).  They are centralized below so a later
+  calibration against a real file is a constants-only change.  Until
+  then 3.1 quality blocks default to rANS Nx16 on write.
+
+Model: per-context adaptive frequency coding.  Contexts mix the last
+few quantized qualities (qtab/qshift/qbits), position along the read
+(ptab), a running delta count (dtab) and the parameter selector, each
+shifted into a 16-bit context word — the fqzcomp design.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+FQZ_VERS = 5
+
+# gflags [SPEC]
+GFLAG_MULTI_PARAM = 1
+GFLAG_HAVE_STAB = 2
+GFLAG_DO_REV = 4
+
+# pflags [SPEC]
+PFLAG_DO_DEDUP = 2
+PFLAG_DO_LEN = 4
+PFLAG_DO_SEL = 8
+PFLAG_HAVE_QMAP = 16
+PFLAG_HAVE_PTAB = 32
+PFLAG_HAVE_DTAB = 64
+PFLAG_HAVE_QTAB = 128
+
+CTX_SIZE = 1 << 16
+CTX_MASK = CTX_SIZE - 1
+
+# adaptive-model constants [SPEC-recalled — see module docstring]
+MODEL_STEP = 8
+MODEL_MAX_TOTAL = (1 << 16) - MODEL_STEP
+
+
+class FqzError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Adaptive range coder (LZMA-style carry handling: the encoder keeps a
+# 64-bit low with a cache byte + pending-0xFF run; the first output byte
+# is the initial zero cache, which the decoder skips) [SPEC-recalled]
+# ---------------------------------------------------------------------------
+
+class RangeEncoder:
+    __slots__ = ("low", "range", "cache", "cache_size", "out")
+
+    def __init__(self) -> None:
+        self.low = 0
+        self.range = 0xFFFFFFFF
+        self.cache = 0
+        self.cache_size = 1
+        self.out = bytearray()
+
+    def _shift_low(self) -> None:
+        carry = self.low >> 32
+        low32 = self.low & 0xFFFFFFFF
+        if low32 < 0xFF000000 or carry:
+            self.out.append((self.cache + carry) & 0xFF)
+            while self.cache_size > 1:
+                self.out.append((0xFF + carry) & 0xFF)
+                self.cache_size -= 1
+            self.cache = (low32 >> 24) & 0xFF
+            self.cache_size = 0
+        self.cache_size += 1
+        self.low = (low32 << 8) & 0xFFFFFFFF
+
+    def encode(self, cum: int, freq: int, tot: int) -> None:
+        r = self.range // tot
+        self.low += cum * r
+        self.range = r * freq
+        while self.range < (1 << 24):
+            self.range = (self.range << 8) & 0xFFFFFFFF
+            self._shift_low()
+
+    def finish(self) -> bytes:
+        for _ in range(5):
+            self._shift_low()
+        return bytes(self.out)
+
+
+class RangeDecoder:
+    __slots__ = ("buf", "pos", "code", "range")
+
+    def __init__(self, buf: bytes, pos: int = 0) -> None:
+        if len(buf) - pos < 5:
+            raise FqzError("fqzcomp stream truncated in range-coder init")
+        self.buf = buf
+        self.pos = pos + 1                 # skip the initial cache byte
+        self.code = int.from_bytes(buf[self.pos:self.pos + 4], "big")
+        self.pos += 4
+        self.range = 0xFFFFFFFF
+
+    def get_freq(self, tot: int) -> int:
+        self.range //= tot
+        f = self.code // self.range
+        if f >= tot:
+            raise FqzError("corrupt fqzcomp stream: frequency out of range")
+        return f
+
+    def advance(self, cum: int, freq: int) -> None:
+        self.code -= cum * self.range
+        self.range *= freq
+        buf, n = self.buf, len(self.buf)
+        while self.range < (1 << 24):
+            self.range <<= 8
+            b = buf[self.pos] if self.pos < n else 0
+            self.code = ((self.code << 8) | b) & 0xFFFFFFFF
+            self.pos += 1
+
+
+class SimpleModel:
+    """Adaptive frequency model: freqs start at 1, bump by MODEL_STEP on
+    use, halve when the total crosses MODEL_MAX_TOTAL; a used symbol
+    swaps one slot toward the front when it overtakes its neighbour
+    (fqzcomp's cheap approximate sort) [SPEC-recalled]."""
+    __slots__ = ("total", "freqs", "syms")
+
+    def __init__(self, nsym: int) -> None:
+        self.total = nsym
+        self.freqs = [1] * nsym
+        self.syms = list(range(nsym))
+
+    def _bump(self, i: int) -> None:
+        self.freqs[i] += MODEL_STEP
+        self.total += MODEL_STEP
+        if i > 0 and self.freqs[i] > self.freqs[i - 1]:
+            f, s = self.freqs, self.syms
+            f[i - 1], f[i] = f[i], f[i - 1]
+            s[i - 1], s[i] = s[i], s[i - 1]
+        if self.total > MODEL_MAX_TOTAL:
+            t = 0
+            f = self.freqs
+            for j in range(len(f)):
+                f[j] -= f[j] >> 1
+                t += f[j]
+            self.total = t
+
+    def decode(self, rc: RangeDecoder) -> int:
+        f = rc.get_freq(self.total)
+        acc = 0
+        freqs = self.freqs
+        i = 0
+        while acc + freqs[i] <= f:
+            acc += freqs[i]
+            i += 1
+        rc.advance(acc, freqs[i])
+        sym = self.syms[i]
+        self._bump(i)
+        return sym
+
+    def encode(self, rc: RangeEncoder, sym: int) -> None:
+        i = self.syms.index(sym)
+        acc = sum(self.freqs[:i])
+        rc.encode(acc, self.freqs[i], self.total)
+        self._bump(i)
+
+
+# ---------------------------------------------------------------------------
+# table (de)serialization: quantizer tables are step functions over
+# consecutive small values, stored as a run length per value 0,1,2,...
+# with 255-extension [SPEC-recalled — see module docstring]
+# ---------------------------------------------------------------------------
+
+def _read_array(buf: bytes, p: int, n: int) -> Tuple[List[int], int]:
+    a = [0] * n
+    i = 0
+    v = 0
+    while i < n:
+        run = 0
+        while True:
+            if p >= len(buf):
+                raise FqzError("fqzcomp table truncated")
+            b = buf[p]
+            p += 1
+            run += b
+            if b != 255:
+                break
+        if i + run > n:
+            raise FqzError("fqzcomp table run overflows")
+        for _ in range(run):
+            a[i] = v
+            i += 1
+        v += 1
+    return a, p
+
+
+def _store_array(a: Sequence[int]) -> bytes:
+    out = bytearray()
+    i = 0
+    v = 0
+    n = len(a)
+    while i < n:
+        if a[i] < v:
+            raise FqzError("fqzcomp tables must be non-decreasing")
+        run = 0
+        while i < n and a[i] == v:
+            i += 1
+            run += 1
+        while run >= 255:
+            out.append(255)
+            run -= 255
+        out.append(run)
+        v += 1
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# parameter sets
+# ---------------------------------------------------------------------------
+
+class FqzParam:
+    __slots__ = ("context", "pflags", "max_sym", "qbits", "qshift", "qloc",
+                 "sloc", "ploc", "dloc", "qmap", "qtab", "ptab", "dtab",
+                 "qmask")
+
+    def __init__(self) -> None:
+        self.context = 0
+        self.pflags = 0
+        self.max_sym = 64
+        self.qbits = 9
+        self.qshift = 3
+        self.qloc = 0
+        self.sloc = 14
+        self.ploc = 9
+        self.dloc = 12
+        self.qmap: Optional[List[int]] = None
+        self.qtab = list(range(256))
+        self.ptab = [0] * 1024
+        self.dtab = [0] * 256
+        self.qmask = (1 << self.qbits) - 1
+
+    @property
+    def do_dedup(self) -> bool:
+        return bool(self.pflags & PFLAG_DO_DEDUP)
+
+    @property
+    def do_len(self) -> bool:
+        return bool(self.pflags & PFLAG_DO_LEN)
+
+    @property
+    def do_sel(self) -> bool:
+        return bool(self.pflags & PFLAG_DO_SEL)
+
+    @property
+    def do_pos(self) -> bool:
+        return bool(self.pflags & PFLAG_HAVE_PTAB)
+
+    @property
+    def do_delta(self) -> bool:
+        return bool(self.pflags & PFLAG_HAVE_DTAB)
+
+
+def _read_param(buf: bytes, p: int) -> Tuple[FqzParam, int]:
+    pm = FqzParam()
+    if p + 7 > len(buf):
+        raise FqzError("fqzcomp parameter block truncated")
+    pm.context = struct.unpack_from("<H", buf, p)[0]
+    pm.pflags = buf[p + 2]
+    pm.max_sym = buf[p + 3]
+    x = buf[p + 4]
+    pm.qbits, pm.qshift = x >> 4, x & 15
+    x = buf[p + 5]
+    pm.qloc, pm.sloc = x >> 4, x & 15
+    x = buf[p + 6]
+    pm.ploc, pm.dloc = x >> 4, x & 15
+    pm.qmask = (1 << pm.qbits) - 1
+    p += 7
+    if pm.pflags & PFLAG_HAVE_QMAP:
+        if p + pm.max_sym > len(buf):
+            raise FqzError("fqzcomp qmap truncated")
+        pm.qmap = list(buf[p:p + pm.max_sym])
+        p += pm.max_sym
+    if pm.pflags & PFLAG_HAVE_QTAB:
+        pm.qtab, p = _read_array(buf, p, 256)
+    if pm.pflags & PFLAG_HAVE_PTAB:
+        pm.ptab, p = _read_array(buf, p, 1024)
+    if pm.pflags & PFLAG_HAVE_DTAB:
+        pm.dtab, p = _read_array(buf, p, 256)
+    return pm, p
+
+
+def _write_param(pm: FqzParam) -> bytes:
+    out = bytearray(struct.pack("<H", pm.context))
+    out.append(pm.pflags)
+    out.append(pm.max_sym)
+    out.append((pm.qbits << 4) | pm.qshift)
+    out.append((pm.qloc << 4) | pm.sloc)
+    out.append((pm.ploc << 4) | pm.dloc)
+    if pm.pflags & PFLAG_HAVE_QMAP:
+        assert pm.qmap is not None and len(pm.qmap) == pm.max_sym
+        out += bytes(pm.qmap)
+    if pm.pflags & PFLAG_HAVE_QTAB:
+        out += _store_array(pm.qtab)
+    if pm.pflags & PFLAG_HAVE_PTAB:
+        out += _store_array(pm.ptab)
+    if pm.pflags & PFLAG_HAVE_DTAB:
+        out += _store_array(pm.dtab)
+    return bytes(out)
+
+
+class _Models:
+    """All adaptive models of one stream, created lazily per context."""
+
+    def __init__(self, nsym: int, max_sel: int) -> None:
+        self.nsym = nsym
+        self.qual: Dict[int, SimpleModel] = {}
+        self.len = [SimpleModel(256) for _ in range(4)]
+        self.rev = SimpleModel(2)
+        self.dup = SimpleModel(2)
+        self.sel = SimpleModel(max_sel + 1)
+
+    def qual_model(self, ctx: int) -> SimpleModel:
+        m = self.qual.get(ctx)
+        if m is None:
+            m = self.qual[ctx] = SimpleModel(self.nsym)
+        return m
+
+
+def _update_ctx(pm: FqzParam, state: dict, q: int) -> int:
+    """One context step [SPEC-recalled]: mix quantized-quality history,
+    position, delta and selector into a 16-bit context."""
+    last = pm.context
+    state["qctx"] = ((state["qctx"] << pm.qshift) + pm.qtab[q]) & 0xFFFFFFFF
+    last += (state["qctx"] & pm.qmask) << pm.qloc
+    if pm.do_pos:
+        state["p"] -= 1
+        last += pm.ptab[min(1023, state["p"])] << pm.ploc
+    if pm.do_delta:
+        last += pm.dtab[min(255, state["delta"])] << pm.dloc
+        state["delta"] += 1 if state["prevq"] != q else 0
+        state["prevq"] = q
+    if pm.do_sel:
+        last += state["s"] << pm.sloc
+    return last & CTX_MASK
+
+
+def _decode_length(models: _Models, rc: RangeDecoder) -> int:
+    b0 = models.len[0].decode(rc)
+    b1 = models.len[1].decode(rc)
+    b2 = models.len[2].decode(rc)
+    b3 = models.len[3].decode(rc)
+    return b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+
+
+def _encode_length(models: _Models, rc: RangeEncoder, ln: int) -> None:
+    models.len[0].encode(rc, ln & 0xFF)
+    models.len[1].encode(rc, (ln >> 8) & 0xFF)
+    models.len[2].encode(rc, (ln >> 16) & 0xFF)
+    models.len[3].encode(rc, (ln >> 24) & 0xFF)
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+def fqz_decode(buf: bytes, out_size: int) -> bytes:
+    """Decode one fqzcomp quality stream into ``out_size`` bytes of
+    concatenated per-record quality values (CRAM QS series).
+
+    Returns raw quality values (no +33 offset), the series' own domain.
+    """
+    try:
+        return _fqz_decode(buf, out_size)
+    except (IndexError, struct.error) as e:
+        # any out-of-range read/model index on a corrupt stream must
+        # surface as the module's error type, not a bare IndexError
+        raise FqzError(f"corrupt fqzcomp stream: {e}") from e
+
+
+def _fqz_decode(buf: bytes, out_size: int) -> bytes:
+    if len(buf) < 2:
+        raise FqzError("fqzcomp stream too short")
+    if buf[0] != FQZ_VERS:
+        raise FqzError(f"fqzcomp version {buf[0]} unsupported "
+                       f"(expected {FQZ_VERS})")
+    gflags = buf[1]
+    p = 2
+    nparam = 1
+    if gflags & GFLAG_MULTI_PARAM:
+        nparam = buf[p]
+        p += 1
+        if nparam < 1:
+            raise FqzError("fqzcomp: zero parameter sets")
+    if gflags & GFLAG_HAVE_STAB:
+        max_sel = buf[p]
+        p += 1
+        stab, p = _read_array(buf, p, 256)
+    else:
+        max_sel = nparam - 1
+        stab = [min(i, nparam - 1) for i in range(256)]
+    params: List[FqzParam] = []
+    for _ in range(nparam):
+        pm, p = _read_param(buf, p)
+        params.append(pm)
+    max_nsym = max(pm.max_sym for pm in params) + 1
+    models = _Models(max_nsym, max(max_sel, 0))
+    rc = RangeDecoder(buf, p)
+
+    out = bytearray(out_size)
+    rev_flags: List[Tuple[int, int]] = []   # (start, len) of reversed recs
+    i = 0
+    last_len = 0
+    rec_start = 0
+    pm = params[0]
+    state = {"qctx": 0, "p": 0, "delta": 0, "prevq": 0, "s": 0}
+    while i < out_size:
+        # --- record header ---
+        s = models.sel.decode(rc) if max_sel > 0 else 0
+        x = stab[s] if s < 256 else 0
+        if x >= nparam:
+            raise FqzError("fqzcomp: selector exceeds parameter sets")
+        pm = params[x]
+        if pm.do_len or last_len == 0:
+            last_len = _decode_length(models, rc)
+        if last_len <= 0 or i + last_len > out_size:
+            raise FqzError("fqzcomp: record length out of bounds")
+        rec_start = i
+        if gflags & GFLAG_DO_REV:
+            if models.rev.decode(rc):
+                rev_flags.append((rec_start, last_len))
+        if pm.do_dedup and models.dup.decode(rc):
+            if rec_start < last_len:
+                raise FqzError("fqzcomp: dup of nonexistent record")
+            out[rec_start:rec_start + last_len] = \
+                out[rec_start - last_len:rec_start]
+            i = rec_start + last_len
+            continue
+        # --- per-base ---
+        state = {"qctx": 0, "p": last_len, "delta": 0, "prevq": 0, "s": s}
+        ctx = pm.context
+        if pm.do_sel:
+            ctx = (ctx + (s << pm.sloc)) & CTX_MASK
+        qmap = pm.qmap
+        for _ in range(last_len):
+            q = models.qual_model(ctx).decode(rc)
+            if qmap is not None:
+                if q >= len(qmap):
+                    raise FqzError("corrupt fqzcomp stream: symbol "
+                                   "outside qmap")
+                out[i] = qmap[q]
+            else:
+                out[i] = q
+            i += 1
+            ctx = _update_ctx(pm, state, q)
+    for start, ln in rev_flags:
+        out[start:start + ln] = out[start:start + ln][::-1]
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# encode (EXPERIMENTAL: round-trip driver for decode + 3.1 opt-in)
+# ---------------------------------------------------------------------------
+
+def _default_param(quals: bytes, lens: Sequence[int]) -> Tuple[int, FqzParam]:
+    """Single default parameter set in the spirit of fqz_pick_parameters:
+    qmap when the alphabet is sparse, position + delta contexts on."""
+    seen = sorted(set(quals)) if quals else [0]
+    pm = FqzParam()
+    pm.pflags = PFLAG_HAVE_PTAB | PFLAG_HAVE_DTAB | PFLAG_HAVE_QTAB
+    if len(set(lens)) > 1:
+        pm.pflags |= PFLAG_DO_LEN
+    if len(seen) <= 16 and seen[-1] > len(seen) - 1:
+        # sparse alphabet: decoded symbols are indices into qmap
+        pm.pflags |= PFLAG_HAVE_QMAP
+        pm.qmap = list(seen)
+        pm.max_sym = len(seen)
+    else:
+        pm.max_sym = seen[-1]
+    # context layout (16 bits): q history bits 0-8, pos 9-12, delta 13-15
+    pm.qbits, pm.qshift, pm.qloc = 9, 3, 0
+    pm.qmask = (1 << pm.qbits) - 1
+    pm.qtab = [min(v, (1 << pm.qshift) - 1) for v in range(256)]
+    pm.ptab = [min(15, pos >> 6) for pos in range(1024)]
+    pm.ploc = 9
+    pm.dtab = [min(7, d >> 2) for d in range(256)]
+    pm.dloc = 13
+    return 0, pm
+
+
+def fqz_encode(quals: bytes, lens: Sequence[int]) -> bytes:
+    """Encode concatenated per-record quality bytes (lengths ``lens``)
+    as one fqzcomp stream decodable by :func:`fqz_decode`."""
+    if sum(lens) != len(quals):
+        raise FqzError("record lengths do not sum to the payload size")
+    if any(l <= 0 for l in lens):
+        raise FqzError("record lengths must be positive")
+    gflags, pm = _default_param(quals, lens)
+    head = bytearray([FQZ_VERS, gflags])
+    head += _write_param(pm)
+    models = _Models(pm.max_sym + 1, 0)
+    rc = RangeEncoder()
+    if pm.qmap is not None:
+        inv = {v: i for i, v in enumerate(pm.qmap)}
+    else:
+        inv = None
+    i = 0
+    last_len = 0
+    for ln in lens:
+        if pm.do_len or last_len == 0:
+            _encode_length(models, rc, ln)
+        elif ln != last_len:
+            raise FqzError("varying lengths need PFLAG_DO_LEN")
+        last_len = ln
+        state = {"qctx": 0, "p": ln, "delta": 0, "prevq": 0, "s": 0}
+        ctx = pm.context
+        for _ in range(ln):
+            v = quals[i]
+            q = inv[v] if inv is not None else v
+            if q >= pm.max_sym + 1:
+                raise FqzError(f"quality {v} exceeds max_sym")
+            models.qual_model(ctx).encode(rc, q)
+            i += 1
+            ctx = _update_ctx(pm, state, q)
+    return bytes(head) + rc.finish()

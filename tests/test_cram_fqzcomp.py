@@ -1,0 +1,308 @@
+"""fqzcomp quality codec tests (formats/cram_fqzcomp.py).
+
+Round-trips drive the decoder through the encoder's feature matrix;
+hand-assembled streams (built from the module's own primitives,
+mirroring the spec's stream grammar) cover the decode-only features the
+default encoder never emits (multi-param + selector, dedup, reverse).
+Corrupt streams must fail loudly, never return wrong bytes silently.
+"""
+import random
+import struct
+
+import pytest
+
+from hadoop_bam_tpu.formats.cram_fqzcomp import (
+    FQZ_VERS, GFLAG_DO_REV, GFLAG_HAVE_STAB, GFLAG_MULTI_PARAM,
+    PFLAG_DO_DEDUP, PFLAG_DO_LEN, PFLAG_DO_SEL, FqzError, FqzParam,
+    RangeDecoder, RangeEncoder, SimpleModel, _Models, _encode_length,
+    _read_array, _store_array, _update_ctx, _write_param, fqz_decode,
+    fqz_encode,
+)
+
+
+def _mkquals(n_recs, lens, seed=1, alphabet=(2, 11, 25, 37, 40)):
+    rng = random.Random(seed)
+    quals = bytearray()
+    out_lens = []
+    for i in range(n_recs):
+        ln = lens[i % len(lens)]
+        out_lens.append(ln)
+        prev = rng.choice(alphabet)
+        for _ in range(ln):
+            # quality-like data: sticky with occasional jumps
+            if rng.random() < 0.8:
+                q = prev
+            else:
+                q = rng.choice(alphabet)
+            quals.append(q)
+            prev = q
+    return bytes(quals), out_lens
+
+
+# ---------------------------------------------------------------------------
+# range coder + model primitives
+# ---------------------------------------------------------------------------
+
+def test_range_coder_roundtrip():
+    rng = random.Random(3)
+    syms = [rng.randrange(64) for _ in range(5000)]
+    enc_model = SimpleModel(64)
+    rc = RangeEncoder()
+    for s in syms:
+        enc_model.encode(rc, s)
+    comp = rc.finish()
+    dec_model = SimpleModel(64)
+    rd = RangeDecoder(comp)
+    assert [dec_model.decode(rd) for _ in syms] == syms
+
+
+def test_model_adaptation_compresses_skew():
+    """A heavily skewed stream must compress well below 1 byte/symbol —
+    evidence the adaptive frequencies actually adapt."""
+    syms = [0] * 9000 + [1] * 100
+    random.Random(5).shuffle(syms)
+    m = SimpleModel(2)
+    rc = RangeEncoder()
+    for s in syms:
+        m.encode(rc, s)
+    comp = rc.finish()
+    assert len(comp) < len(syms) / 8
+
+
+def test_store_read_array_roundtrip():
+    cases = [
+        [0] * 256,
+        list(range(256)),
+        [min(15, i >> 4) for i in range(256)],
+        [min(15, i >> 6) for i in range(1024)],
+        [0] * 300 + [5] * 724,          # value jump -> zero-length runs
+    ]
+    for a in cases:
+        raw = _store_array(a)
+        got, p = _read_array(raw, 0, len(a))
+        assert got == a and p == len(raw)
+
+
+# ---------------------------------------------------------------------------
+# encoder-driven round-trips
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("lens", [[151], [151, 151], [100, 151, 75]])
+def test_fqz_roundtrip(lens):
+    quals, out_lens = _mkquals(40, lens, seed=7)
+    comp = fqz_encode(quals, out_lens)
+    assert fqz_decode(comp, len(quals)) == quals
+    # it should actually compress quality-like data
+    assert len(comp) < len(quals)
+
+
+def test_fqz_roundtrip_dense_alphabet():
+    """>16 distinct values: no qmap, raw symbol domain."""
+    quals, lens = _mkquals(30, [120], seed=9,
+                           alphabet=tuple(range(2, 42)))
+    comp = fqz_encode(quals, lens)
+    assert fqz_decode(comp, len(quals)) == quals
+
+
+def test_fqz_roundtrip_single_record():
+    quals = bytes([30] * 500)
+    comp = fqz_encode(quals, [500])
+    assert fqz_decode(comp, 500) == quals
+
+
+def test_fqz_encode_validates():
+    with pytest.raises(FqzError):
+        fqz_encode(b"\x01\x02", [3])
+    with pytest.raises(FqzError):
+        fqz_encode(b"\x01\x02", [2, 0])
+
+
+# ---------------------------------------------------------------------------
+# hand-assembled streams: decode-only features
+# ---------------------------------------------------------------------------
+
+def _simple_param(pflags=0, context=0, max_sym=40):
+    pm = FqzParam()
+    pm.pflags = pflags
+    pm.context = context
+    pm.max_sym = max_sym
+    pm.qbits, pm.qshift, pm.qloc = 9, 3, 0
+    pm.qmask = (1 << pm.qbits) - 1
+    pm.qtab = [min(v, 7) for v in range(256)]
+    pm.pflags |= 128                       # HAVE_QTAB
+    return pm
+
+
+_encode_lengths = _encode_length   # the module's real length encoder
+
+
+def test_fqz_decode_multi_param_selector():
+    """Two parameter sets + selector table, records alternating between
+    them — the stream grammar the single-param encoder never emits."""
+    recs = [bytes([10, 10, 12, 12, 10]), bytes([30, 31, 30, 31, 30]),
+            bytes([10, 12, 10, 12, 10]), bytes([31, 31, 30, 30, 31])]
+    pms = [_simple_param(pflags=PFLAG_DO_SEL | PFLAG_DO_LEN),
+           _simple_param(pflags=PFLAG_DO_SEL | PFLAG_DO_LEN, context=1234)]
+    for pm in pms:
+        pm.sloc = 14
+    stab = [0, 1] + [1] * 254
+    head = bytearray([FQZ_VERS, GFLAG_MULTI_PARAM | GFLAG_HAVE_STAB, 2, 1])
+    head += _store_array(stab)
+    for pm in pms:
+        head += _write_param(pm)
+    models = _Models(41, 1)
+    rc = RangeEncoder()
+    for r, rec in enumerate(recs):
+        s = r % 2
+        models.sel.encode(rc, s)
+        pm = pms[s]
+        _encode_lengths(models, rc, len(rec))
+        state = {"qctx": 0, "p": len(rec), "delta": 0, "prevq": 0, "s": s}
+        ctx = (pm.context + (s << pm.sloc)) & 0xFFFF
+        for v in rec:
+            models.qual_model(ctx).encode(rc, v)
+            ctx = _update_ctx(pm, state, v)
+    comp = bytes(head) + rc.finish()
+    assert fqz_decode(comp, sum(map(len, recs))) == b"".join(recs)
+
+
+def test_fqz_decode_dedup():
+    """PFLAG_DO_DEDUP: a dup=1 record copies the previous record."""
+    rec = bytes([20, 21, 20, 22, 20, 20])
+    pm = _simple_param(pflags=PFLAG_DO_DEDUP)
+    head = bytearray([FQZ_VERS, 0]) + _write_param(pm)
+    models = _Models(41, 0)
+    rc = RangeEncoder()
+    # record 1: lengths encoded once (fixed length), dup=0, then bases
+    _encode_lengths(models, rc, len(rec))
+    models.dup.encode(rc, 0)
+    state = {"qctx": 0, "p": len(rec), "delta": 0, "prevq": 0, "s": 0}
+    ctx = pm.context
+    for v in rec:
+        models.qual_model(ctx).encode(rc, v)
+        ctx = _update_ctx(pm, state, v)
+    # record 2: dup=1 -> no bases in the stream
+    models.dup.encode(rc, 1)
+    comp = bytes(head) + rc.finish()
+    assert fqz_decode(comp, 2 * len(rec)) == rec + rec
+
+
+def test_fqz_decode_reverse_flag():
+    """GFLAG_DO_REV: flagged records come out reversed."""
+    rec = bytes([5, 6, 7, 8, 9, 10])
+    pm = _simple_param()
+    head = bytearray([FQZ_VERS, GFLAG_DO_REV]) + _write_param(pm)
+    models = _Models(41, 0)
+    rc = RangeEncoder()
+    for flag in (0, 1):
+        if flag == 0:
+            _encode_lengths(models, rc, len(rec))   # first record only
+        models.rev.encode(rc, flag)
+        state = {"qctx": 0, "p": len(rec), "delta": 0, "prevq": 0, "s": 0}
+        ctx = pm.context
+        for v in rec:
+            models.qual_model(ctx).encode(rc, v)
+            ctx = _update_ctx(pm, state, v)
+    comp = bytes(head) + rc.finish()
+    assert fqz_decode(comp, 2 * len(rec)) == rec + rec[::-1]
+
+
+# ---------------------------------------------------------------------------
+# corrupt inputs fail loudly
+# ---------------------------------------------------------------------------
+
+def test_fqz_corrupt_inputs_raise():
+    quals, lens = _mkquals(10, [50], seed=11)
+    comp = bytearray(fqz_encode(quals, lens))
+    with pytest.raises(FqzError):
+        fqz_decode(b"", 10)
+    with pytest.raises(FqzError):
+        fqz_decode(b"\x04\x00", 10)          # wrong version
+    with pytest.raises(FqzError):
+        fqz_decode(bytes(comp[:8]), len(quals))   # truncated header
+    # wrong out_size: the decoder must not fabricate a record
+    with pytest.raises(FqzError):
+        fqz_decode(bytes(comp), len(quals) + 1)
+
+
+# ---------------------------------------------------------------------------
+# wired through the CRAM block layer (method 7)
+# ---------------------------------------------------------------------------
+
+def test_block_method_dispatch():
+    from hadoop_bam_tpu.formats.cram import FQZCOMP, decompress_block_payload
+    quals, lens = _mkquals(20, [151], seed=13)
+    comp = fqz_encode(quals, lens)
+    assert decompress_block_payload(FQZCOMP, comp, len(quals)) == quals
+
+
+def test_fqz_byteflip_fuzz_never_escapes_fqzerror():
+    """Every single-bit corruption either still yields out_size bytes
+    (wrong data is fine — range coders can absorb flips) or raises
+    FqzError; bare IndexError/struct.error must never escape."""
+    quals, lens = _mkquals(10, [80], seed=19)
+    comp = bytearray(fqz_encode(quals, lens))
+    rng = random.Random(23)
+    for _ in range(300):
+        pos = rng.randrange(len(comp))
+        bit = 1 << rng.randrange(8)
+        comp[pos] ^= bit
+        try:
+            out = fqz_decode(bytes(comp), len(quals))
+            assert len(out) == len(quals)
+        except FqzError:
+            pass
+        comp[pos] ^= bit
+
+
+def test_cram31_file_roundtrip_fqzcomp_quals(tmp_path, monkeypatch):
+    """HBAM_CRAM31_QUAL=fqzcomp routes the QS series of a 3.1 file
+    through method 7; the file must read back record-identical (and the
+    blocks must really be fqzcomp, not a silent rans fallback)."""
+    from fixtures import make_header, make_records
+    from hadoop_bam_tpu.formats.cram import (
+        FQZCOMP, ContainerHeader, FileDefinition, parse_raw_block,
+    )
+    from hadoop_bam_tpu.formats.cramio import CramWriter, read_cram
+
+    monkeypatch.setenv("HBAM_CRAM31_QUAL", "fqzcomp")
+    header = make_header()
+    recs = make_records(header, 200, seed=17)
+    path = str(tmp_path / "fqz31.cram")
+    with CramWriter(path, header, records_per_container=50,
+                    version=(3, 1)) as w:
+        w.write_records(recs)
+
+    buf = open(path, "rb").read()
+    pos = FileDefinition.SIZE
+    methods = set()
+    while pos < len(buf):
+        hdr, pos = ContainerHeader.from_buffer(buf, pos)
+        end = pos + hdr.length
+        while pos < end:
+            raw, pos = parse_raw_block(buf, pos)
+            methods.add(raw.method)
+    assert FQZCOMP in methods
+
+    _, out = read_cram(path)
+    assert [r.to_line() for r in out] == [r.to_line() for r in recs]
+
+
+def test_cram31_qual_knob_validates(monkeypatch):
+    from fixtures import make_header, make_records
+    from hadoop_bam_tpu.formats.cramio import CramWriter
+
+    monkeypatch.setenv("HBAM_CRAM31_QUAL", "zstd")
+    header = make_header()
+    with pytest.raises(ValueError, match="HBAM_CRAM31_QUAL"):
+        import io
+        with CramWriter(io.BytesIO(), header, version=(3, 1)) as w:
+            w.write_records(make_records(header, 5, seed=1))
+
+
+def test_arith_still_clear_error():
+    from hadoop_bam_tpu.formats.cram import (
+        ARITH, CRAMError, decompress_block_payload,
+    )
+    with pytest.raises(CRAMError, match="arith"):
+        decompress_block_payload(ARITH, b"\x00\x01", 4)
