@@ -1052,9 +1052,9 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         src = as_byte_source(path)
         n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
         src.close()
-        from hadoop_bam_tpu.split.planners import plan_spans_maybe_intervals
-        spans = plan_spans_maybe_intervals(path, header, config,
-                                           num_spans=n_spans)
+        from hadoop_bam_tpu.split.planners import plan_spans_cached
+        spans = plan_spans_cached(path, header, config,
+                                  num_spans=n_spans)
 
     step = make_seq_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
@@ -1107,9 +1107,9 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
         src = as_byte_source(path)
         n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
         src.close()
-        from hadoop_bam_tpu.split.planners import plan_spans_maybe_intervals
-        spans = plan_spans_maybe_intervals(path, header, config,
-                                           num_spans=n_spans)
+        from hadoop_bam_tpu.split.planners import plan_spans_cached
+        spans = plan_spans_cached(path, header, config,
+                                  num_spans=n_spans)
 
     projection = FLAGSTAT_PROJECTION
     row_bytes = projection_row_bytes(projection)
@@ -1322,8 +1322,9 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
             src = as_byte_source(path)
             n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
             src.close()
-            spans = plan_bam_spans(path, num_spans=n_spans, config=config,
-                                   header=header)
+            from hadoop_bam_tpu.split.planners import plan_spans_cached
+            spans = plan_spans_cached(path, header, config,
+                                      num_spans=n_spans)
 
     sharding = NamedSharding(mesh, P("data"))
     rep = NamedSharding(mesh, P())

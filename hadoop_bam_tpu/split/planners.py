@@ -182,6 +182,38 @@ def _next_name_group_start(path: str, boundary: int, header: SAMHeader,
     return boundary   # name group exceeds the window: leave the boundary
 
 
+_PLAN_CACHE: "dict[tuple, list]" = {}
+_PLAN_CACHE_MAX = 32
+
+
+def plan_spans_cached(path: str, header, config,
+                      num_spans: Optional[int] = None):
+    """plan_spans_maybe_intervals memoized per (file identity, request).
+
+    The reference computes ``getSplits()`` ONCE per job on the client
+    (SURVEY.md section 3.1); repeated driver calls over an unchanged file
+    should not re-run the split guessers, whose probe I/O and inflation
+    are a measurable share of a whole-file stats pass on fast paths.
+    The key includes file size + mtime, so a rewritten file replans; the
+    config participates via its repr (intervals, guesser knobs)."""
+    try:
+        st = os.stat(path)
+        key = (os.path.abspath(path), st.st_size, st.st_mtime_ns,
+               num_spans, repr(config))
+    except (OSError, TypeError):       # non-path sources: no caching
+        return plan_spans_maybe_intervals(path, header, config,
+                                          num_spans=num_spans)
+    hit = _PLAN_CACHE.get(key)
+    if hit is not None:
+        return list(hit)
+    plan = plan_spans_maybe_intervals(path, header, config,
+                                      num_spans=num_spans)
+    if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+        _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+    _PLAN_CACHE[key] = list(plan)
+    return list(plan)
+
+
 def plan_spans_maybe_intervals(path: str, header, config,
                                num_spans: Optional[int] = None):
     """plan_bam_spans, but when ``config.bam_intervals`` is set and a

@@ -204,3 +204,44 @@ def test_index_on_write_matches_posthoc(tmp_path):
         for r in records:
             w.write_sam_record(r)
     assert os.path.exists(path2 + ".sbi")
+
+
+def test_plan_spans_cached_semantics(tmp_path):
+    """The getSplits()-once cache: identical request -> same plan without
+    re-guessing; rewriting the file invalidates; returned lists are
+    copies (caller mutation cannot poison the cache)."""
+    import os
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import BamWriter, read_bam_header
+    from hadoop_bam_tpu.split.planners import (
+        plan_spans_cached, plan_spans_maybe_intervals,
+    )
+
+    header = make_header()
+    path = str(tmp_path / "c.bam")
+    with BamWriter(path, header) as w:
+        for r in make_records(header, 800, seed=4):
+            w.write_sam_record(r)
+    hdr, _ = read_bam_header(path)
+    fresh = plan_spans_maybe_intervals(path, hdr, DEFAULT_CONFIG,
+                                       num_spans=4)
+    a = plan_spans_cached(path, hdr, DEFAULT_CONFIG, num_spans=4)
+    assert [s.to_dict() for s in a] == [s.to_dict() for s in fresh]
+    a.clear()                               # must not poison the cache
+    b = plan_spans_cached(path, hdr, DEFAULT_CONFIG, num_spans=4)
+    assert [s.to_dict() for s in b] == [s.to_dict() for s in fresh]
+    # a different request is a different key
+    c = plan_spans_cached(path, hdr, DEFAULT_CONFIG, num_spans=2)
+    assert len(c) <= len(b)
+
+    # rewrite -> invalidated (size/mtime key)
+    with BamWriter(path, header) as w:
+        for r in make_records(header, 100, seed=5):
+            w.write_sam_record(r)
+    os.utime(path)                          # ensure the mtime moves
+    hdr2, _ = read_bam_header(path)
+    d = plan_spans_cached(path, hdr2, DEFAULT_CONFIG, num_spans=4)
+    fresh2 = plan_spans_maybe_intervals(path, hdr2, DEFAULT_CONFIG,
+                                        num_spans=4)
+    assert [s.to_dict() for s in d] == [s.to_dict() for s in fresh2]
