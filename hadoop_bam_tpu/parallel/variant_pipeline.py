@@ -435,7 +435,8 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
     if geometry is None:
         geometry = VariantGeometry(n_samples=header.n_samples)
     cap = geometry.tile_records
-    spans = ds.spans()
+    from hadoop_bam_tpu.parallel.pipeline import pipeline_span_count
+    spans = ds.spans(num_spans=pipeline_span_count(path, n_dev, config))
     step = make_variant_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
