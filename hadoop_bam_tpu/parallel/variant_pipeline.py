@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.vcf import VariantBatch, VCFHeader
 from hadoop_bam_tpu.parallel.pipeline import (
-    _STEP_CACHE, _StatTotals, _iter_windowed,
+    _STEP_CACHE, _StatTotals, _iter_windowed, pipeline_span_count,
 )
 
 
@@ -435,7 +435,6 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
     if geometry is None:
         geometry = VariantGeometry(n_samples=header.n_samples)
     cap = geometry.tile_records
-    from hadoop_bam_tpu.parallel.pipeline import pipeline_span_count
     spans = ds.spans(num_spans=pipeline_span_count(path, n_dev, config))
     step = make_variant_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))

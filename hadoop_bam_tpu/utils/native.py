@@ -95,6 +95,9 @@ def load() -> Optional[ctypes.CDLL]:
             fn.restype = ctypes.c_int
             fn.argtypes = [i8p, ctypes.c_int64, ctypes.c_int64,
                            u32p, u32p, i8p, i8p, ctypes.c_int64]
+        lib.hbam_itf8_decode_batch.restype = ctypes.c_int64
+        lib.hbam_itf8_decode_batch.argtypes = [
+            i8p, ctypes.c_int64, ctypes.c_int64, i32p]
         lib.hbam_crc32_batch.restype = ctypes.c_int
         lib.hbam_crc32_batch.argtypes = [
             i8p, i64p, i32p, ctypes.c_int32, u32p, ctypes.c_int32]
@@ -295,6 +298,26 @@ def deflate_tokenize_batch(src: np.ndarray, cdata_off: np.ndarray,
             f"deflate tokenize failed at block {block}: "
             f"{kinds.get(kind, f'error {kind}')}")
     return tokens, n_tokens, out_lens
+
+
+def itf8_decode_batch(buf: np.ndarray, count: int
+                      ) -> "tuple[np.ndarray, int]":
+    """Decode ``count`` ITF8 varints from ``buf`` in one native pass.
+
+    Returns (values int32[count], bytes_consumed).  Raises ValueError on
+    a truncated stream.  Callers must handle load() failure themselves
+    (available() gate) — CRAM's predecode falls back to the per-record
+    Python path."""
+    lib = load()
+    assert lib is not None
+    out = np.empty(count, dtype=np.int32)
+    buf = np.ascontiguousarray(buf)
+    consumed = lib.hbam_itf8_decode_batch(
+        _ptr(buf, ctypes.c_uint8), buf.size, count,
+        _ptr(out, ctypes.c_int32))
+    if consumed < 0:
+        raise ValueError("ITF8 stream truncated")
+    return out, int(consumed)
 
 
 def available() -> bool:

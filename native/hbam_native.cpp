@@ -398,6 +398,38 @@ int hbam_rans1_decode(const uint8_t* buf, int64_t buf_len, int64_t ptr,
   return 0;
 }
 
+// Decode n ITF8 varints (CRAM spec 2.3: leading-ones byte count; the
+// 5-byte form keeps only the low 4 bits of its final byte) from buf into
+// out.  Returns bytes consumed, or -1 if the stream ends mid-value.
+// One C pass replaces the per-value Python loop in CRAM series decode.
+long long hbam_itf8_decode_batch(const unsigned char* buf,
+                                 long long buf_len, long long n,
+                                 int32_t* out) {
+  long long p = 0;
+  for (long long i = 0; i < n; ++i) {
+    if (p >= buf_len) return -1;
+    unsigned b0 = buf[p];
+    uint32_t v;
+    int extra;
+    if (b0 < 0x80)      { v = b0;        extra = 0; }
+    else if (b0 < 0xC0) { v = b0 & 0x3F; extra = 1; }
+    else if (b0 < 0xE0) { v = b0 & 0x1F; extra = 2; }
+    else if (b0 < 0xF0) { v = b0 & 0x0F; extra = 3; }
+    else                { v = b0 & 0x0F; extra = 4; }
+    if (p + 1 + extra > buf_len) return -1;
+    if (extra == 4) {
+      v = (v << 28) | ((uint32_t)buf[p + 1] << 20)
+        | ((uint32_t)buf[p + 2] << 12) | ((uint32_t)buf[p + 3] << 4)
+        | (buf[p + 4] & 0x0F);
+    } else {
+      for (int j = 1; j <= extra; ++j) v = (v << 8) | buf[p + j];
+    }
+    out[i] = (int32_t)v;
+    p += 1 + extra;
+  }
+  return p;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------

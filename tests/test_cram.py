@@ -407,3 +407,39 @@ def test_cram_tensor_tiles_quality_less_reads(tmp_path):
             for r in range(int(counts[d])):
                 row = qual[d, r, :int(lens[d, r])]
                 assert row.max(initial=0) <= 41, row  # never 0xff filler
+
+
+def test_predecode_fast_path_parity(tmp_path, monkeypatch):
+    """decode_slice_records must be record-identical with the vectorized
+    fixed-series predecode ON (native batch ITF8) and OFF (per-record
+    fallback) — including mates, tags, unmapped records, and multiref."""
+    from fixtures import make_header, make_records
+    from hadoop_bam_tpu.formats import cram_decode
+    from hadoop_bam_tpu.formats.cramio import CramWriter, read_cram
+    from hadoop_bam_tpu.utils import native
+
+    if not native.available():
+        pytest.skip("native library unavailable; no fast path to compare")
+
+    header = make_header()
+    recs = make_records(header, 300, seed=41)
+    path = str(tmp_path / "p.cram")
+    with CramWriter(path, header, records_per_container=64) as w:
+        w.write_records(recs)
+
+    calls = {"fast": 0}
+    real_fast = cram_decode._decode_slice_records_fast
+
+    def counting_fast(*a, **k):
+        calls["fast"] += 1
+        return real_fast(*a, **k)
+
+    monkeypatch.setattr(cram_decode, "_decode_slice_records_fast",
+                        counting_fast)
+    _, fast = read_cram(path)
+    assert calls["fast"] > 0, "predecode eligibility regressed: the " \
+                              "vectorized path never ran"
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)   # force fallback path
+    _, slow = read_cram(path)
+    assert [r.to_line() for r in fast] == [r.to_line() for r in slow]

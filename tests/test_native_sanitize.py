@@ -80,6 +80,18 @@ tokens, n_tokens, out_lens = native.deflate_tokenize_batch(
     src, table["cdata_off"], table["cdata_len"],
     int(table["isize"].max()) + 16, n_threads=4)
 assert (out_lens == table["isize"]).all()
+
+# batch ITF8 (CRAM fixed-series predecode), incl. the truncation path
+from hadoop_bam_tpu.formats.cram import write_itf8
+vals = [0, 1, 127, 128, 16383, 2**28, -1] * 50
+itf = np.frombuffer(b"".join(write_itf8(v) for v in vals), np.uint8)
+got, used = native.itf8_decode_batch(itf, len(vals))
+assert [int(v) for v in got] == vals and used == itf.size
+try:
+    native.itf8_decode_batch(itf[:3], 7)
+    raise AssertionError("truncated ITF8 did not raise")
+except ValueError:
+    pass
 print("SANITIZED-OK")
 """
 
