@@ -39,13 +39,32 @@ def _round_up(x: int, m: int) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class VariantGeometry:
-    """Static shapes of one device's variant tile (jit contract)."""
-    tile_records: int = 1 << 14    # variants per device per step
+    """Static shapes of one device's variant tile (jit contract).
+
+    ``tile_records=None`` (the default) sizes the tile from the sample
+    count: as many variants per step as keep the dosage tile within
+    ~8 MB, clamped to [4096, 65536].  Fewer, larger dispatches win on
+    high-latency links (~100 ms per step issue measured on the tunnel),
+    but a fixed 64k tile would be gigabytes for cohort-scale VCFs —
+    the device step materializes int32 casts of the whole dosage tile.
+    """
+    tile_records: "Optional[int]" = None
     n_samples: int = 0             # from the header; padded to samples_pad
+
+    def __post_init__(self):
+        if self.tile_records is None:
+            budget = (8 << 20) // max(1, self.samples_pad)
+            object.__setattr__(
+                self, "tile_records",
+                max(1 << 12, min(1 << 16, _round_up(budget, 8))))
 
     @property
     def samples_pad(self) -> int:
-        return max(128, _round_up(self.n_samples, 128))
+        # transfer-compact (8-byte steps), not lane-aligned: a 3-sample
+        # VCF padded to 128 lanes shipped 40x the dosage bytes over the
+        # H2D link, which is the scarce resource on every measured
+        # config; Mosaic/XLA pad the lane dim in VMEM for free
+        return max(8, _round_up(self.n_samples, 8))
 
 
 FLAG_PASS = 1
