@@ -617,8 +617,11 @@ def _predecode_fixed(comp: CompressionHeader, slice_hdr: SliceHeader,
         for cid in _encoding_cids(enc):
             cid_users[cid] = cid_users.get(cid, 0) + 1
 
-    def batch(name: str, count: int) -> Optional[np.ndarray]:
-        """count values of one fixed series; None = not eligible."""
+    def batch(name: str, count: int,
+              raw_bytes: bool = False) -> Optional[np.ndarray]:
+        """count values of one fixed series; None = not eligible.
+        ``raw_bytes`` reads one raw byte per value (the decode_byte
+        contract, e.g. FC) instead of one ITF8 varint."""
         if count == 0:
             return np.zeros(0, np.int32)
         enc = comp.data_series.get(name)
@@ -630,6 +633,12 @@ def _predecode_fixed(comp: CompressionHeader, slice_hdr: SliceHeader,
             cid = enc.content_id
             if cid_users.get(cid, 0) != 1 or cid not in external:
                 return None
+            if raw_bytes:
+                raw = external[cid]
+                if len(raw) < count:
+                    return None        # truncated: per-record path raises
+                return np.frombuffer(raw[:count], np.uint8
+                                     ).astype(np.int32)
             try:
                 vals, _used = native.itf8_decode_batch(
                     np.frombuffer(external[cid], np.uint8), count)
@@ -668,6 +677,18 @@ def _predecode_fixed(comp: CompressionHeader, slice_hdr: SliceHeader,
             out["AP"], dtype=np.int64)
     else:
         out["POS"] = out["AP"].astype(np.int64)
+
+    # feature streams: FC is one byte per feature and FP one ITF8 per
+    # feature, totalling sum(FN) values each — batchable exactly like
+    # the fixed series.  Optional: absence just keeps features on the
+    # record-serial path.
+    total_fn = int(out["FN"].sum())
+    if total_fn:
+        fc = batch("FC", total_fn, raw_bytes=True)
+        fp = batch("FP", total_fn) if fc is not None else None
+        if fc is not None and fp is not None:
+            out["FC"] = fc
+            out["FP"] = fp
     return out
 
 
@@ -753,8 +774,9 @@ def _decode_slice_records_fast(comp: CompressionHeader,
     names_inc = comp.read_names_included
     rn = comp.data_series.get("RN")
     tag_dict, tag_encodings = comp.tag_dict, comp.tag_encodings
+    fc_all, fp_all = pre.get("FC"), pre.get("FP")
     records: List[CramRecord] = []
-    di = wi = mi = 0
+    di = wi = mi = fi = 0
     for i in range(slice_hdr.n_records):
         r = CramRecord()
         r.bf = int(bf[i])
@@ -780,8 +802,15 @@ def _decode_slice_records_fast(comp: CompressionHeader,
             enc = tag_encodings[tag_key(tag, typ)]
             r.tags.append(_tag_from_raw(tag, typ, enc.decode_array(st)))
         if not r.bf & 0x4:
-            _decode_mapped(comp, st, r, ref_names, ref_source,
-                           fn=int(fn[mi]), mq=int(mq[mi]))
+            k = int(fn[mi])
+            if fc_all is not None:
+                _decode_mapped(comp, st, r, ref_names, ref_source,
+                               fn=k, mq=int(mq[mi]),
+                               fc=fc_all[fi:fi + k], fp=fp_all[fi:fi + k])
+                fi += k
+            else:
+                _decode_mapped(comp, st, r, ref_names, ref_source,
+                               fn=k, mq=int(mq[mi]))
             mi += 1
         else:
             ba = comp.series("BA")
@@ -809,18 +838,25 @@ def _decode_mapped(comp: CompressionHeader, st: DecodeState, r: CramRecord,
                    ref_names: List[str],
                    ref_source: Optional[ReferenceSource],
                    fn: Optional[int] = None,
-                   mq: Optional[int] = None) -> None:
-    # fn/mq arrive predecoded from the vectorized fast path; None means
-    # decode them from the record-serial streams here
+                   mq: Optional[int] = None,
+                   fc=None, fp=None) -> None:
+    # fn/mq (ints) and fc/fp (this record's feature-code/position
+    # slices) arrive predecoded from the vectorized fast path; None
+    # means decode them from the record-serial streams here
     if fn is None:
         fn = comp.series("FN").decode_int(st)
-    fc_enc = comp.series("FC")
-    fp_enc = comp.series("FP")
+    if fc is None:
+        fc_enc = comp.series("FC")
+        fp_enc = comp.series("FP")
     features = []
     fpos = 0
-    for _ in range(fn):
-        code = chr(fc_enc.decode_byte(st))
-        fpos += fp_enc.decode_int(st)
+    for j in range(fn):
+        if fc is not None:             # predecoded feature streams
+            code = chr(int(fc[j]))
+            fpos += int(fp[j])
+        else:
+            code = chr(fc_enc.decode_byte(st))
+            fpos += fp_enc.decode_int(st)
         if code in _FEATURE_HAS_ARRAY:
             val = comp.series(_FEATURE_HAS_ARRAY[code]).decode_array(st)
         elif code in _FEATURE_HAS_INT:
