@@ -93,6 +93,47 @@ def assign_spans(spans: Sequence[FileVirtualSpan],
     return out
 
 
+def distributed_flagstat(path: str, config=None, header=None):
+    """Whole-file flagstat across a multi-host ``jax.distributed`` job.
+
+    The reference shape (SURVEY.md sections 2.9/3.2): client-side
+    ``getSplits()`` once, map tasks reduce their own splits, one final
+    combine.  Host 0 plans and broadcasts the span list; each process
+    decodes ONLY its ``assign_spans`` share over its local devices
+    (flagstat counters are sum-combinable, so no cross-host collective
+    is needed until the end); the per-host vectors combine with one
+    allgather.  Single-process calls degrade to plain flagstat_file.
+    """
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.parallel.pipeline import (
+        flagstat_file, pipeline_span_count,
+    )
+    from hadoop_bam_tpu.split.planners import plan_spans_cached
+
+    config = DEFAULT_CONFIG if config is None else config
+    if header is None:
+        header, _ = read_bam_header(path)
+    if jax.process_count() == 1:
+        return flagstat_file(path, config=config, header=header)
+    plan = None
+    if jax.process_index() == 0:   # only the planner needs the file size
+        n_spans = pipeline_span_count(path, jax.device_count(), config)
+        plan = plan_spans_cached(path, header, config, num_spans=n_spans)
+    spans = broadcast_plan(plan)
+    mine = assign_spans(spans)
+    mesh = make_mesh(devices=jax.local_devices())
+    stats = flagstat_file(path, mesh=mesh, config=config, header=header,
+                          spans=mine)
+    from jax.experimental import multihost_utils
+
+    vec = np.asarray([stats[k] for k in FLAGSTAT_FIELDS], np.int64)
+    g = np.asarray(multihost_utils.process_allgather(vec))
+    return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, g.sum(axis=0))}
+
+
 def retry_span(decode_fn, span: FileVirtualSpan, attempts: int = 3):
     """Span-level retry — the framework's failure-recovery unit."""
     last: Exception

@@ -1,4 +1,6 @@
 """Mesh pipeline tests on the virtual 8-device CPU mesh."""
+import os
+
 import numpy as np
 import pytest
 
@@ -228,4 +230,64 @@ def test_two_host_simulation(bam):
         for k in FLAGSTAT_FIELDS:
             merged[k] += stats[k]
     assert merged == whole
+    assert whole["total"] == len(records)
+
+
+_DIST_FLAGSTAT_CHILD = """\
+import json, os, sys
+idx, port, src = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["XLA_FLAGS"] = ""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=idx)
+from hadoop_bam_tpu.parallel.distributed import distributed_flagstat
+stats = distributed_flagstat(src)
+print("STATS", json.dumps(stats), flush=True)
+"""
+
+
+def test_distributed_flagstat_two_process(bam, tmp_path):
+    """REAL 2-process jax.distributed flagstat (gloo CPU collectives):
+    host 0 plans + broadcasts, each process reduces only its share over
+    its local devices, one allgather combines — both processes must
+    report the identical whole-file answer."""
+    import json
+    import socket
+    import subprocess
+    import sys as _sys
+
+    path, header, records, _ = bam
+    whole = flagstat_file(path, header=header)
+
+    child = str(tmp_path / "dist_flagstat_child.py")
+    with open(child, "w") as f:
+        f.write(_DIST_FLAGSTAT_CHILD)
+    with socket.socket() as s:
+        # bind-then-close has a TOCTOU window; acceptable on the
+        # single-tenant CI host (same pattern as test_mesh_sort)
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [_sys.executable, child, str(i), str(port), path],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=repo) for i in range(2)]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    got = []
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"child failed:\n{so}\n{se[-2000:]}"
+        line = next(ln for ln in so.splitlines() if ln.startswith("STATS "))
+        got.append(json.loads(line[6:]))
+    assert got[0] == got[1] == whole
     assert whole["total"] == len(records)
