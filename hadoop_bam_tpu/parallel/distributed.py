@@ -118,19 +118,43 @@ def distributed_flagstat(path: str, config=None, header=None):
         header, _ = read_bam_header(path)
     if jax.process_count() == 1:
         return flagstat_file(path, config=config, header=header)
+    from jax.experimental import multihost_utils
+
+    # failure-flag convention (as in mesh_sort): a raise on one host
+    # before a collective would strand the others in it, so every phase
+    # reaches its collective and ships an ok/failed flag instead
     plan = None
+    plan_err = None
     if jax.process_index() == 0:   # only the planner needs the file size
-        n_spans = pipeline_span_count(path, jax.device_count(), config)
-        plan = plan_spans_cached(path, header, config, num_spans=n_spans)
+        try:
+            n_spans = pipeline_span_count(path, jax.device_count(), config)
+            plan = plan_spans_cached(path, header, config,
+                                     num_spans=n_spans)
+        except Exception as e:  # noqa: BLE001 — must reach the collective
+            plan_err = e
+    ok = np.asarray([0 if plan_err is not None else 1], np.int32)
+    g_ok = np.asarray(multihost_utils.process_allgather(ok))
+    if plan_err is not None:
+        raise plan_err
+    if int(g_ok.min()) == 0:
+        raise RuntimeError("distributed flagstat: span planning failed "
+                           "on host 0")
     spans = broadcast_plan(plan)
     mine = assign_spans(spans)
     mesh = make_mesh(devices=jax.local_devices())
-    stats = flagstat_file(path, mesh=mesh, config=config, header=header,
-                          spans=mine)
-    from jax.experimental import multihost_utils
-
-    vec = np.asarray([stats[k] for k in FLAGSTAT_FIELDS], np.int64)
+    stat_err = None
+    vec = np.full(len(FLAGSTAT_FIELDS), -1, np.int64)   # failure sentinel
+    try:
+        stats = flagstat_file(path, mesh=mesh, config=config,
+                              header=header, spans=mine)
+        vec = np.asarray([stats[k] for k in FLAGSTAT_FIELDS], np.int64)
+    except Exception as e:  # noqa: BLE001 — must reach the collective
+        stat_err = e
     g = np.asarray(multihost_utils.process_allgather(vec))
+    if stat_err is not None:
+        raise stat_err
+    if (g < 0).any():
+        raise RuntimeError("distributed flagstat failed on another host")
     return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, g.sum(axis=0))}
 
 

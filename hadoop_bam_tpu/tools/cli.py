@@ -197,8 +197,10 @@ def cmd_cat(args) -> int:
 
 def cmd_summarize(args) -> int:
     from hadoop_bam_tpu.ops.flagstat import format_flagstat
-    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
-    stats = flagstat_file(args.path)
+    from hadoop_bam_tpu.parallel.distributed import distributed_flagstat
+    # plan-once + per-host shares + one allgather under jax.distributed;
+    # identical to flagstat_file in a single-process run
+    stats = distributed_flagstat(args.path)
     sys.stdout.write(format_flagstat(stats))
     if args.metrics:
         from hadoop_bam_tpu.utils.metrics import METRICS
@@ -509,8 +511,39 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _resilient_backend() -> None:
+    """Survive a stale JAX_PLATFORMS pin.
+
+    The environment may pin JAX_PLATFORMS to a plugin name (e.g. a
+    tunneled-TPU plugin) that this process's plugin registration does
+    not expose under that name — an intermittent race observed with the
+    axon plugin, which sometimes registers as plain 'tpu'.  bench.py
+    already probes around this; the CLI gets the cheap version: if the
+    pinned platform cannot initialize, clear the pin and let jax choose
+    (real TPU when registered, CPU otherwise) instead of crashing."""
+    import os
+
+    if not os.environ.get("JAX_PLATFORMS"):
+        return
+    try:
+        import jax
+
+        jax.devices()
+    except RuntimeError:
+        os.environ.pop("JAX_PLATFORMS", None)
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", None)
+            jax.devices()
+        except RuntimeError as e:
+            print(f"warning: JAX backend init failed ({e}); downstream "
+                  f"device steps will fail", file=sys.stderr)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    _resilient_backend()
     try:
         return args.fn(args)
     except (ValueError, FileNotFoundError) as e:

@@ -1,0 +1,44 @@
+"""Shared 2-process ``jax.distributed`` test harness.
+
+Both multi-host tests (mesh sort, distributed flagstat) spawn two real
+coordinated processes with gloo CPU collectives; this is the one copy
+of the orchestration (child script materialization, coordinator port,
+PYTHONPATH, spawn, kill-on-failure).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+
+def run_two_process(tmp_path, child_source: str, child_args,
+                    timeout: float = 240.0):
+    """Run ``child_source`` in two coordinated subprocesses.
+
+    Each child gets argv ``(index, coordinator_port, *child_args)``.
+    Children that outlive a timeout or failure are killed.  Returns
+    ``[(returncode, stdout, stderr), ...]`` in process order.
+    """
+    child = str(tmp_path / "multihost_child.py")
+    with open(child, "w") as f:
+        f.write(child_source)
+    with socket.socket() as s:
+        # bind-then-close has a TOCTOU window; acceptable on the
+        # single-tenant CI host
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, child, str(i), str(port), *map(str, child_args)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=repo) for i in range(2)]
+    try:
+        outs = [p.communicate(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:        # a hung/failed child must not outlive pytest
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return [(p.returncode, so, se) for p, (so, se) in zip(procs, outs)]

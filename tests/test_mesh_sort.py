@@ -134,37 +134,15 @@ def test_mesh_sort_two_process_distributed(tmp_path):
     """The VERDICT r3 acceptance bar: a REAL 2-process jax.distributed
     run (gloo CPU collectives, 2 devices per process) where each process
     decodes only its spans, byte-identical to sort_bam."""
-    import socket
-    import subprocess
-    import sys as _sys
+    from _multihost import run_two_process
 
     header = make_header()
     recs = make_records(header, 1200, seed=33)
     path = _write_shuffled(tmp_path, recs, header, seed=33)
     out = str(tmp_path / "dist.bam")
-    child = str(tmp_path / "child.py")
-    with open(child, "w") as f:
-        f.write(_MULTIHOST_CHILD)
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [_sys.executable, child, str(i), str(port), path, out],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env, cwd=repo) for i in range(2)]
-    try:
-        outs = [p.communicate(timeout=240) for p in procs]
-    finally:
-        for p in procs:        # a hung/failed child must not outlive pytest
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
-    for p, (so, se) in zip(procs, outs):
-        assert p.returncode == 0, f"child failed:\n{so}\n{se[-2000:]}"
+    for rc, so, se in run_two_process(tmp_path, _MULTIHOST_CHILD,
+                                      [path, out]):
+        assert rc == 0, f"child failed:\n{so}\n{se[-2000:]}"
         assert "SORTED 1200" in so
     ref = str(tmp_path / "ref.bam")
     sort_bam(path, ref)

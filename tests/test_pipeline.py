@@ -255,38 +255,16 @@ def test_distributed_flagstat_two_process(bam, tmp_path):
     its local devices, one allgather combines — both processes must
     report the identical whole-file answer."""
     import json
-    import socket
-    import subprocess
-    import sys as _sys
+
+    from _multihost import run_two_process
 
     path, header, records, _ = bam
     whole = flagstat_file(path, header=header)
 
-    child = str(tmp_path / "dist_flagstat_child.py")
-    with open(child, "w") as f:
-        f.write(_DIST_FLAGSTAT_CHILD)
-    with socket.socket() as s:
-        # bind-then-close has a TOCTOU window; acceptable on the
-        # single-tenant CI host (same pattern as test_mesh_sort)
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [_sys.executable, child, str(i), str(port), path],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env, cwd=repo) for i in range(2)]
-    try:
-        outs = [p.communicate(timeout=240) for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
     got = []
-    for p, (so, se) in zip(procs, outs):
-        assert p.returncode == 0, f"child failed:\n{so}\n{se[-2000:]}"
+    for rc, so, se in run_two_process(tmp_path, _DIST_FLAGSTAT_CHILD,
+                                      [path]):
+        assert rc == 0, f"child failed:\n{so}\n{se[-2000:]}"
         line = next(ln for ln in so.splitlines() if ln.startswith("STATS "))
         got.append(json.loads(line[6:]))
     assert got[0] == got[1] == whole
