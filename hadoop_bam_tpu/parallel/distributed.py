@@ -47,22 +47,41 @@ def process_index() -> int:
     return jax.process_index()
 
 
-def broadcast_plan(spans: Optional[Sequence[FileVirtualSpan]],
-                   max_bytes: int = 1 << 24) -> List[FileVirtualSpan]:
+def serialize_plan(spans: Sequence, max_bytes: int = 1 << 24) -> bytes:
+    """JSON payload of a span plan, class-tagged; raises if it exceeds
+    the fixed broadcast buffer.  Exposed separately so callers under a
+    failure-flag protocol can validate the size INSIDE their flagged
+    phase (a raise mid-broadcast strands the receiving hosts)."""
+    payload = json.dumps(
+        [{"k": type(s).__name__, **s.to_dict()} for s in spans]).encode()
+    if len(payload) + 8 > max_bytes:
+        raise ValueError(f"plan of {len(spans)} spans serializes to "
+                         f"{len(payload)} bytes — exceeds the "
+                         f"{max_bytes}-byte broadcast buffer; raise "
+                         f"max_bytes or plan coarser spans")
+    return payload
+
+
+def broadcast_plan(spans: Optional[Sequence],
+                   max_bytes: int = 1 << 24) -> List:
     """Host 0 passes its plan; other hosts pass None and receive it.
 
     Uses a fixed-size uint8 buffer through broadcast_one_to_all (the payload
-    must have identical shape on all hosts).
+    must have identical shape on all hosts).  Both span flavors travel
+    (virtual-offset BAM spans and plain byte spans for text formats),
+    tagged with their class.
     """
+    from hadoop_bam_tpu.split.spans import FileByteSpan
+
+    span_classes = {"FileVirtualSpan": FileVirtualSpan,
+                    "FileByteSpan": FileByteSpan}
     if jax.process_count() == 1:
         assert spans is not None
         return list(spans)
     from jax.experimental import multihost_utils
 
     if jax.process_index() == 0:
-        payload = json.dumps([s.to_dict() for s in spans]).encode()
-        if len(payload) + 8 > max_bytes:
-            raise ValueError("plan too large for broadcast buffer")
+        payload = serialize_plan(spans, max_bytes)
         buf = np.zeros(max_bytes, dtype=np.uint8)
         buf[:8] = np.frombuffer(np.int64(len(payload)).tobytes(), np.uint8)
         buf[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
@@ -72,7 +91,8 @@ def broadcast_plan(spans: Optional[Sequence[FileVirtualSpan]],
     out = np.asarray(out)
     n = int(np.frombuffer(out[:8].tobytes(), np.int64)[0])
     plan = json.loads(out[8:8 + n].tobytes().decode())
-    return [FileVirtualSpan.from_dict(d) for d in plan]
+    return [span_classes[d.pop("k", "FileVirtualSpan")].from_dict(d)
+            for d in plan]
 
 
 def assign_spans(spans: Sequence[FileVirtualSpan],
@@ -83,8 +103,14 @@ def assign_spans(spans: Sequence[FileVirtualSpan],
     count = jax.process_count() if count is None else count
     if count == 1:
         return list(spans)
-    sizes = np.asarray([max(s.compressed_size, 1) for s in spans],
-                       dtype=np.float64)
+
+    def size_of(s):
+        sz = getattr(s, "compressed_size", None)   # virtual-offset spans
+        if sz is None:
+            sz = s.end - s.start                   # plain byte spans
+        return max(int(sz), 1)
+
+    sizes = np.asarray([size_of(s) for s in spans], dtype=np.float64)
     cum = np.cumsum(sizes)
     total = cum[-1]
     lo, hi = total * index / count, total * (index + 1) / count
@@ -93,21 +119,68 @@ def assign_spans(spans: Sequence[FileVirtualSpan],
     return out
 
 
-def distributed_flagstat(path: str, config=None, header=None):
-    """Whole-file flagstat across a multi-host ``jax.distributed`` job.
+def _multihost_reduce(plan_builder, local_reducer, payload_len: int
+                      ) -> np.ndarray:
+    """Shared scaffold of the multi-host stat drivers.
 
     The reference shape (SURVEY.md sections 2.9/3.2): client-side
     ``getSplits()`` once, map tasks reduce their own splits, one final
-    combine.  Host 0 plans and broadcasts the span list; each process
-    decodes ONLY its ``assign_spans`` share over its local devices
-    (flagstat counters are sum-combinable, so no cross-host collective
-    is needed until the end); the per-host vectors combine with one
-    allgather.  Single-process calls degrade to plain flagstat_file.
+    combine.  Host 0 runs ``plan_builder`` and broadcasts; each process
+    runs ``local_reducer(assigned_spans)`` -> float64[payload_len] over
+    ONLY its share; one allgather stacks the rows.
+
+    Failure-flag convention (as in mesh_sort): a raise on one host
+    before a collective would strand the others in it, so every phase
+    reaches its collective and ships an ok/failed flag instead.
+    Counters travel as float64 — exact up to 2^53, far beyond any
+    record count here.  Returns the (n_hosts, payload_len) matrix.
     """
+    from jax.experimental import multihost_utils
+
+    plan = None
+    err = None
+    if jax.process_index() == 0:
+        try:
+            plan = plan_builder()
+            serialize_plan(plan)   # size-check INSIDE the flagged phase
+        except Exception as e:  # noqa: BLE001 — must reach the collective
+            err = e
+    ok = np.asarray([0 if err is not None else 1], np.int32)
+    g_ok = np.asarray(multihost_utils.process_allgather(ok))
+    if err is not None:
+        raise err
+    if int(g_ok.min()) == 0:
+        raise RuntimeError("distributed reduce: span planning failed on "
+                           "host 0")
+    mine = assign_spans(broadcast_plan(plan))
+    row = np.zeros(1 + payload_len, np.float64)
+    try:
+        row[1:] = local_reducer(mine)
+        row[0] = 1.0
+    except Exception as e:  # noqa: BLE001 — must reach the collective
+        err = e
+        row[:] = 0.0
+    g = np.asarray(multihost_utils.process_allgather(row))
+    if err is not None:
+        raise err
+    if (g[:, 0] < 1).any():
+        raise RuntimeError("distributed reduce failed on another host")
+    return g[:, 1:]
+
+
+def _local_mesh():
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=jax.local_devices())
+
+
+def distributed_flagstat(path: str, config=None, header=None):
+    """Whole-file flagstat across a multi-host ``jax.distributed`` job;
+    single-process calls degrade to plain flagstat_file.  Flagstat
+    counters are sum-combinable, so the combine is one addition."""
     from hadoop_bam_tpu.config import DEFAULT_CONFIG
     from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS
-    from hadoop_bam_tpu.parallel.mesh import make_mesh
     from hadoop_bam_tpu.parallel.pipeline import (
         flagstat_file, pipeline_span_count,
     )
@@ -118,44 +191,96 @@ def distributed_flagstat(path: str, config=None, header=None):
         header, _ = read_bam_header(path)
     if jax.process_count() == 1:
         return flagstat_file(path, config=config, header=header)
-    from jax.experimental import multihost_utils
 
-    # failure-flag convention (as in mesh_sort): a raise on one host
-    # before a collective would strand the others in it, so every phase
-    # reaches its collective and ships an ok/failed flag instead
-    plan = None
-    plan_err = None
-    if jax.process_index() == 0:   # only the planner needs the file size
-        try:
-            n_spans = pipeline_span_count(path, jax.device_count(), config)
-            plan = plan_spans_cached(path, header, config,
-                                     num_spans=n_spans)
-        except Exception as e:  # noqa: BLE001 — must reach the collective
-            plan_err = e
-    ok = np.asarray([0 if plan_err is not None else 1], np.int32)
-    g_ok = np.asarray(multihost_utils.process_allgather(ok))
-    if plan_err is not None:
-        raise plan_err
-    if int(g_ok.min()) == 0:
-        raise RuntimeError("distributed flagstat: span planning failed "
-                           "on host 0")
-    spans = broadcast_plan(plan)
-    mine = assign_spans(spans)
-    mesh = make_mesh(devices=jax.local_devices())
-    stat_err = None
-    vec = np.full(len(FLAGSTAT_FIELDS), -1, np.int64)   # failure sentinel
-    try:
-        stats = flagstat_file(path, mesh=mesh, config=config,
+    def plan():
+        n = pipeline_span_count(path, jax.device_count(), config)
+        return plan_spans_cached(path, header, config, num_spans=n)
+
+    def local(mine):
+        stats = flagstat_file(path, mesh=_local_mesh(), config=config,
                               header=header, spans=mine)
-        vec = np.asarray([stats[k] for k in FLAGSTAT_FIELDS], np.int64)
-    except Exception as e:  # noqa: BLE001 — must reach the collective
-        stat_err = e
-    g = np.asarray(multihost_utils.process_allgather(vec))
-    if stat_err is not None:
-        raise stat_err
-    if (g < 0).any():
-        raise RuntimeError("distributed flagstat failed on another host")
-    return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, g.sum(axis=0))}
+        return np.asarray([stats[k] for k in FLAGSTAT_FIELDS], np.float64)
+
+    tot = _multihost_reduce(plan, local, len(FLAGSTAT_FIELDS)).sum(axis=0)
+    return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, tot)}
+
+
+def distributed_seq_stats(path: str, config=None, header=None,
+                          geometry=None):
+    """Multi-host seq_stats_file: counts and histograms sum; the means
+    combine weighted by each host's read count."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.ops.seq_pallas import N_CODES
+    from hadoop_bam_tpu.parallel.pipeline import (
+        pipeline_span_count, seq_stats_file,
+    )
+    from hadoop_bam_tpu.split.planners import plan_spans_cached
+
+    config = DEFAULT_CONFIG if config is None else config
+    if header is None:
+        header, _ = read_bam_header(path)
+    if jax.process_count() == 1:
+        return seq_stats_file(path, config=config, header=header,
+                              geometry=geometry)
+
+    def plan():
+        n = pipeline_span_count(path, jax.device_count(), config)
+        return plan_spans_cached(path, header, config, num_spans=n)
+
+    n_codes = N_CODES
+
+    def local(mine):
+        s = seq_stats_file(path, mesh=_local_mesh(), config=config,
+                           header=header, spans=mine, geometry=geometry)
+        n = float(s["n_reads"])
+        return np.concatenate([
+            [n, s["mean_gc"] * n, s["mean_qual"] * n],
+            np.asarray(s["base_hist"], np.float64)])
+
+    g = _multihost_reduce(plan, local, 3 + n_codes).sum(axis=0)
+    n = max(g[0], 1.0)
+    return {"n_reads": int(g[0]), "mean_gc": float(g[1] / n),
+            "mean_qual": float(g[2] / n),
+            "base_hist": g[3:].astype(np.int64)}
+
+
+def distributed_variant_stats(path: str, config=None, header=None):
+    """Multi-host variant_stats_file: counts sum; mean_af combines
+    weighted by n_af; per-sample call rates by n_variants."""
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.parallel.pipeline import pipeline_span_count
+    from hadoop_bam_tpu.parallel.variant_pipeline import (
+        variant_stats_file,
+    )
+
+    config = DEFAULT_CONFIG if config is None else config
+    if jax.process_count() == 1:
+        return variant_stats_file(path, config=config, header=header)
+    ds = open_vcf(path, config)        # one open: header + span planner
+    if header is None:
+        header = ds.header
+    n_samples = header.n_samples
+
+    def plan():
+        n = pipeline_span_count(path, jax.device_count(), config)
+        return ds.spans(num_spans=n)
+
+    def local(mine):
+        s = variant_stats_file(path, mesh=_local_mesh(), config=config,
+                               header=header, spans=mine)
+        nv = float(s["n_variants"])
+        return np.concatenate([
+            [nv, s["n_snp"], s["n_pass"], s["n_af"],
+             s["mean_af"] * s["n_af"]],
+            np.asarray(s["sample_callrate"], np.float64) * nv])
+
+    g = _multihost_reduce(plan, local, 5 + n_samples).sum(axis=0)
+    nv = int(g[0])
+    return {"n_variants": nv, "n_snp": int(g[1]), "n_pass": int(g[2]),
+            "mean_af": float(g[4] / max(g[3], 1.0)), "n_af": int(g[3]),
+            "sample_callrate": g[5:] / max(nv, 1)}
 
 
 def retry_span(decode_fn, span: FileVirtualSpan, attempts: int = 3):

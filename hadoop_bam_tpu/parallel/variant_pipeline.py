@@ -437,6 +437,7 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
                        config: HBamConfig = DEFAULT_CONFIG,
                        geometry: Optional[VariantGeometry] = None,
                        header: Optional[VCFHeader] = None,
+                       spans=None,
                        prefetch: int = 2) -> Dict[str, object]:
     """Distributed variant stats over a whole VCF/BCF (any container the
     dispatcher recognises): variant/SNP/PASS counts, mean ALT allele
@@ -454,7 +455,9 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
     if geometry is None:
         geometry = VariantGeometry(n_samples=header.n_samples)
     cap = geometry.tile_records
-    spans = ds.spans(num_spans=pipeline_span_count(path, n_dev, config))
+    if spans is None:
+        spans = ds.spans(num_spans=pipeline_span_count(path, n_dev,
+                                                       config))
     step = make_variant_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
@@ -512,7 +515,7 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
             dispatch()
     if not totals:
         return {"n_variants": 0, "n_snp": 0, "n_pass": 0, "mean_af": 0.0,
-                "sample_callrate": np.zeros(header.n_samples)}
+                "n_af": 0, "sample_callrate": np.zeros(header.n_samples)}
     tf, ints = totals.drain()
     sum_af = float(tf[0])
     n_variants = int(ints[0])
@@ -524,5 +527,8 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
         "n_snp": int(ints[1]),
         "n_pass": int(ints[2]),
         "mean_af": float(sum_af / max(int(ints[3]), 1)),
+        # the mean_af denominator (variants with computable AF): exposed
+        # so multi-host combiners can weight means exactly
+        "n_af": int(ints[3]),
         "sample_callrate": callrate,
     }

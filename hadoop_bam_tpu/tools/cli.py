@@ -307,15 +307,16 @@ def cmd_coverage(args) -> int:
 
 
 def cmd_seq_stats(args) -> int:
+    from hadoop_bam_tpu.parallel.distributed import distributed_seq_stats
     from hadoop_bam_tpu.parallel.pipeline import (
         TEXT_READ_EXTS, PayloadGeometry, fastq_seq_stats_file,
-        seq_stats_file,
     )
     geometry = PayloadGeometry(max_len=args.max_len)
     if args.path.lower().endswith(TEXT_READ_EXTS):
+        # text read formats have no multi-host driver yet; single-host
         stats = fastq_seq_stats_file(args.path, geometry=geometry)
     else:
-        stats = seq_stats_file(args.path, geometry=geometry)
+        stats = distributed_seq_stats(args.path, geometry=geometry)
     print(f"reads\t{stats['n_reads']}")
     print(f"mean_gc\t{stats['mean_gc']:.6f}")
     print(f"mean_qual\t{stats['mean_qual']:.3f}")
@@ -330,8 +331,10 @@ def cmd_seq_stats(args) -> int:
 
 
 def cmd_vcf_stats(args) -> int:
-    from hadoop_bam_tpu.parallel.variant_pipeline import variant_stats_file
-    stats = variant_stats_file(args.path)
+    from hadoop_bam_tpu.parallel.distributed import (
+        distributed_variant_stats,
+    )
+    stats = distributed_variant_stats(args.path)
     print(f"variants\t{stats['n_variants']}")
     print(f"snps\t{stats['n_snp']}")
     print(f"pass\t{stats['n_pass']}")
@@ -429,7 +432,7 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-H", "--header-only", action="store_true")
     v.add_argument("-c", "--count", action="store_true")
     v.add_argument("--no-header", action="store_true")
-    v.set_defaults(fn=cmd_view)
+    v.set_defaults(fn=cmd_view, uses_device=False)
 
     i = sub.add_parser("index", help="build splitting index sidecar(s)")
     i.add_argument("paths", nargs="+")
@@ -440,31 +443,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bai = genomic BAI for BAM; tbi = tabix for BGZF "
                         "VCF (both need coordinate-sorted input and "
                         "enable interval queries/trimming)")
-    i.set_defaults(fn=cmd_index)
+    i.set_defaults(fn=cmd_index, uses_device=False)
 
     c = sub.add_parser("cat", help="concatenate same-header BAMs")
     c.add_argument("output")
     c.add_argument("inputs", nargs="+")
-    c.set_defaults(fn=cmd_cat)
+    c.set_defaults(fn=cmd_cat, uses_device=False)
 
     s = sub.add_parser("summarize", help="distributed flagstat")
     s.add_argument("path")
     s.add_argument("--metrics", action="store_true",
                    help="dump pipeline stage counters/timers to stderr")
-    s.set_defaults(fn=cmd_summarize)
+    s.set_defaults(fn=cmd_summarize, uses_device=True)
 
     sq = sub.add_parser("seq-stats",
                         help="GC/quality/base stats via the Pallas "
                              "payload kernel")
     sq.add_argument("path")
     sq.add_argument("--max-len", type=int, default=160)
-    sq.set_defaults(fn=cmd_seq_stats)
+    sq.set_defaults(fn=cmd_seq_stats, uses_device=True)
 
     vst = sub.add_parser("vcf-stats",
                          help="variant counts, allele freq, call rates "
                               "on the mesh")
     vst.add_argument("path")
-    vst.set_defaults(fn=cmd_vcf_stats)
+    vst.set_defaults(fn=cmd_vcf_stats, uses_device=True)
 
     so = sub.add_parser("sort", help="sort a BAM (external spill-merge)")
     so.add_argument("input")
@@ -482,7 +485,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "all_to_all; single-host) or 'bytes' (record bytes "
                          "ride it; required and default under "
                          "jax.distributed multi-host runs)")
-    so.set_defaults(fn=cmd_sort)
+    so.set_defaults(fn=cmd_sort, uses_device=False)
 
     cov = sub.add_parser("coverage",
                          help="per-base aligned depth over a region "
@@ -495,19 +498,19 @@ def build_parser() -> argparse.ArgumentParser:
                           "exceeded)")
     cov.add_argument("--bedgraph", metavar="PATH",
                      help="write non-zero depth runs as bedGraph")
-    cov.set_defaults(fn=cmd_coverage)
+    cov.set_defaults(fn=cmd_coverage, uses_device=True)
 
     f = sub.add_parser("fixmate", help="fill mate fields on name-grouped BAM")
     f.add_argument("input")
     f.add_argument("output")
-    f.set_defaults(fn=cmd_fixmate)
+    f.set_defaults(fn=cmd_fixmate, uses_device=False)
 
     vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos) "
                                          "(external spill-merge)")
     vs.add_argument("input")
     vs.add_argument("output")
     vs.add_argument("--run-records", type=int, default=1_000_000)
-    vs.set_defaults(fn=cmd_vcf_sort)
+    vs.set_defaults(fn=cmd_vcf_sort, uses_device=False)
     return p
 
 
@@ -543,7 +546,10 @@ def _resilient_backend() -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    _resilient_backend()
+    # device verbs only: pure-IO verbs must not pay jax import/backend
+    # init (or grab the accelerator) at startup
+    if getattr(args, "uses_device", False) or getattr(args, "mesh", False):
+        _resilient_backend()
     try:
         return args.fn(args)
     except (ValueError, FileNotFoundError) as e:

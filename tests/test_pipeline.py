@@ -233,9 +233,11 @@ def test_two_host_simulation(bam):
     assert whole["total"] == len(records)
 
 
-_DIST_FLAGSTAT_CHILD = """\
+_DIST_STATS_CHILD = """\
 import json, os, sys
-idx, port, src = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+import numpy as np
+idx, port, bam_src, vcf_src = (int(sys.argv[1]), sys.argv[2],
+                               sys.argv[3], sys.argv[4])
 os.environ["XLA_FLAGS"] = ""
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -243,29 +245,66 @@ jax.config.update("jax_num_cpu_devices", 2)
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(f"localhost:{port}", num_processes=2,
                            process_id=idx)
-from hadoop_bam_tpu.parallel.distributed import distributed_flagstat
-stats = distributed_flagstat(src)
-print("STATS", json.dumps(stats), flush=True)
+from hadoop_bam_tpu.parallel.distributed import (
+    distributed_flagstat, distributed_seq_stats, distributed_variant_stats,
+)
+print("FLAGSTAT", json.dumps(distributed_flagstat(bam_src)), flush=True)
+s = distributed_seq_stats(bam_src)
+s["base_hist"] = [int(v) for v in s["base_hist"]]
+print("SEQ", json.dumps(s), flush=True)
+v = distributed_variant_stats(vcf_src)
+v["sample_callrate"] = [round(float(x), 9) for x in v["sample_callrate"]]
+print("VAR", json.dumps(v), flush=True)
 """
 
 
-def test_distributed_flagstat_two_process(bam, tmp_path):
-    """REAL 2-process jax.distributed flagstat (gloo CPU collectives):
-    host 0 plans + broadcasts, each process reduces only its share over
-    its local devices, one allgather combines — both processes must
-    report the identical whole-file answer."""
+def test_distributed_stats_two_process(bam, tmp_path):
+    """REAL 2-process jax.distributed stats drivers (gloo CPU
+    collectives): host 0 plans + broadcasts, each process reduces only
+    its share over its local devices, one allgather combines — both
+    processes must report whole-file answers matching single-process."""
     import json
 
     from _multihost import run_two_process
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+    from hadoop_bam_tpu.parallel.pipeline import seq_stats_file
+    from hadoop_bam_tpu.parallel.variant_pipeline import variant_stats_file
 
     path, header, records, _ = bam
     whole = flagstat_file(path, header=header)
+    whole_seq = seq_stats_file(path, header=header)
 
-    got = []
-    for rc, so, se in run_two_process(tmp_path, _DIST_FLAGSTAT_CHILD,
-                                      [path]):
+    vh = VCFHeader.from_text(
+        "##fileformat=VCFv4.2\n##contig=<ID=chr1,length=248956422>\n"
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="G">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\ts0\n")
+    vcf_path = str(tmp_path / "dist.vcf.gz")
+    with open_vcf_writer(vcf_path, vh) as w:
+        for i in range(500):
+            w.write_record(VcfRecord.from_line(
+                f"chr1\t{100 + i * 7}\t.\tA\tC\t30\tPASS\t.\tGT\t"
+                f"{'0/1' if i % 3 else './.'}"))
+    whole_var = variant_stats_file(vcf_path)
+
+    got = {"FLAGSTAT": [], "SEQ": [], "VAR": []}
+    for rc, so, se in run_two_process(tmp_path, _DIST_STATS_CHILD,
+                                      [path, vcf_path]):
         assert rc == 0, f"child failed:\n{so}\n{se[-2000:]}"
-        line = next(ln for ln in so.splitlines() if ln.startswith("STATS "))
-        got.append(json.loads(line[6:]))
-    assert got[0] == got[1] == whole
+        for key in got:
+            line = next(ln for ln in so.splitlines()
+                        if ln.startswith(key + " "))
+            got[key].append(json.loads(line[len(key) + 1:]))
+    assert got["FLAGSTAT"][0] == got["FLAGSTAT"][1] == whole
+    for g in got["SEQ"]:
+        assert g["n_reads"] == whole_seq["n_reads"]
+        # f32 partial sums regroup across hosts: tolerance is f32-scale
+        assert abs(g["mean_gc"] - whole_seq["mean_gc"]) < 1e-4
+        assert abs(g["mean_qual"] - whole_seq["mean_qual"]) < 1e-4
+        assert g["base_hist"] == [int(v) for v in whole_seq["base_hist"]]
+    for g in got["VAR"]:
+        assert g["n_variants"] == whole_var["n_variants"] == 500
+        assert g["n_snp"] == whole_var["n_snp"]
+        assert g["n_pass"] == whole_var["n_pass"]
+        assert abs(g["mean_af"] - whole_var["mean_af"]) < 1e-4
     assert whole["total"] == len(records)
