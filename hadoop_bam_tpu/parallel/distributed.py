@@ -228,21 +228,59 @@ def distributed_seq_stats(path: str, config=None, header=None,
         n = pipeline_span_count(path, jax.device_count(), config)
         return plan_spans_cached(path, header, config, num_spans=n)
 
-    n_codes = N_CODES
-
     def local(mine):
-        s = seq_stats_file(path, mesh=_local_mesh(), config=config,
-                           header=header, spans=mine, geometry=geometry)
-        n = float(s["n_reads"])
-        return np.concatenate([
-            [n, s["mean_gc"] * n, s["mean_qual"] * n],
-            np.asarray(s["base_hist"], np.float64)])
+        return _pack_seq_stats(seq_stats_file(
+            path, mesh=_local_mesh(), config=config, header=header,
+            spans=mine, geometry=geometry))
 
-    g = _multihost_reduce(plan, local, 3 + n_codes).sum(axis=0)
+    return _combine_seq_stats(
+        _multihost_reduce(plan, local, 3 + N_CODES))
+
+
+def _pack_seq_stats(s) -> np.ndarray:
+    """One host's seq stats as a sum-combinable row: counts plus
+    n-weighted means (the exact inverse of _combine_seq_stats)."""
+    n = float(s["n_reads"])
+    return np.concatenate([
+        [n, s["mean_gc"] * n, s["mean_qual"] * n],
+        np.asarray(s["base_hist"], np.float64)])
+
+
+def _combine_seq_stats(rows: np.ndarray) -> dict:
+    g = rows.sum(axis=0)
     n = max(g[0], 1.0)
     return {"n_reads": int(g[0]), "mean_gc": float(g[1] / n),
             "mean_qual": float(g[2] / n),
             "base_hist": g[3:].astype(np.int64)}
+
+
+def distributed_fastq_seq_stats(path: str, config=None, geometry=None):
+    """Multi-host fastq_seq_stats_file (FASTQ/QSEQ): same weighted
+    combine as distributed_seq_stats, over byte-span plans."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.ops.seq_pallas import N_CODES
+    from hadoop_bam_tpu.parallel.pipeline import (
+        QSEQ_EXTS, fastq_seq_stats_file, pipeline_span_count,
+    )
+
+    config = DEFAULT_CONFIG if config is None else config
+    if jax.process_count() == 1:
+        return fastq_seq_stats_file(path, config=config, geometry=geometry)
+
+    def plan():   # runs on host 0 only
+        from hadoop_bam_tpu.api.read_datasets import open_fastq, open_qseq
+        opener = open_qseq if path.lower().endswith(QSEQ_EXTS) \
+            else open_fastq
+        n = pipeline_span_count(path, jax.device_count(), config)
+        return opener(path, config).spans(num_spans=n)
+
+    def local(mine):
+        return _pack_seq_stats(fastq_seq_stats_file(
+            path, mesh=_local_mesh(), config=config, geometry=geometry,
+            spans=mine))
+
+    return _combine_seq_stats(
+        _multihost_reduce(plan, local, 3 + N_CODES))
 
 
 def distributed_variant_stats(path: str, config=None, header=None):

@@ -968,6 +968,7 @@ TEXT_READ_EXTS = FASTQ_EXTS + QSEQ_EXTS
 def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                          config: HBamConfig = DEFAULT_CONFIG,
                          geometry: Optional[PayloadGeometry] = None,
+                         spans=None,
                          prefetch: int = 2) -> Dict[str, object]:
     """Distributed GC / quality / base stats over a FASTQ (or QSEQ) file —
     the text-format twin of seq_stats_file, through the same fused Pallas
@@ -998,7 +999,9 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         fast_tiles = not config.fastq_filter_failed_qc
         qual_offset = config.fastq_base_quality_encoding.value
         text_to_tiles = fastq_text_to_payload_tiles
-    spans = ds.spans(num_spans=pipeline_span_count(path, n_dev, config))
+    if spans is None:
+        spans = ds.spans(
+            num_spans=pipeline_span_count(path, n_dev, config))
     step = make_read_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))

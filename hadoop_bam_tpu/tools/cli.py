@@ -307,14 +307,15 @@ def cmd_coverage(args) -> int:
 
 
 def cmd_seq_stats(args) -> int:
-    from hadoop_bam_tpu.parallel.distributed import distributed_seq_stats
+    from hadoop_bam_tpu.parallel.distributed import (
+        distributed_fastq_seq_stats, distributed_seq_stats,
+    )
     from hadoop_bam_tpu.parallel.pipeline import (
-        TEXT_READ_EXTS, PayloadGeometry, fastq_seq_stats_file,
+        TEXT_READ_EXTS, PayloadGeometry,
     )
     geometry = PayloadGeometry(max_len=args.max_len)
     if args.path.lower().endswith(TEXT_READ_EXTS):
-        # text read formats have no multi-host driver yet; single-host
-        stats = fastq_seq_stats_file(args.path, geometry=geometry)
+        stats = distributed_fastq_seq_stats(args.path, geometry=geometry)
     else:
         stats = distributed_seq_stats(args.path, geometry=geometry)
     print(f"reads\t{stats['n_reads']}")

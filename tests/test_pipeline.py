@@ -236,8 +236,9 @@ def test_two_host_simulation(bam):
 _DIST_STATS_CHILD = """\
 import json, os, sys
 import numpy as np
-idx, port, bam_src, vcf_src = (int(sys.argv[1]), sys.argv[2],
-                               sys.argv[3], sys.argv[4])
+idx, port, bam_src, vcf_src, fq_src = (int(sys.argv[1]), sys.argv[2],
+                                       sys.argv[3], sys.argv[4],
+                                       sys.argv[5])
 os.environ["XLA_FLAGS"] = ""
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -246,7 +247,8 @@ jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(f"localhost:{port}", num_processes=2,
                            process_id=idx)
 from hadoop_bam_tpu.parallel.distributed import (
-    distributed_flagstat, distributed_seq_stats, distributed_variant_stats,
+    distributed_fastq_seq_stats, distributed_flagstat, distributed_seq_stats,
+    distributed_variant_stats,
 )
 print("FLAGSTAT", json.dumps(distributed_flagstat(bam_src)), flush=True)
 s = distributed_seq_stats(bam_src)
@@ -255,6 +257,9 @@ print("SEQ", json.dumps(s), flush=True)
 v = distributed_variant_stats(vcf_src)
 v["sample_callrate"] = [round(float(x), 9) for x in v["sample_callrate"]]
 print("VAR", json.dumps(v), flush=True)
+f = distributed_fastq_seq_stats(fq_src)
+f["base_hist"] = [int(x) for x in f["base_hist"]]
+print("FQ", json.dumps(f), flush=True)
 """
 
 
@@ -287,9 +292,20 @@ def test_distributed_stats_two_process(bam, tmp_path):
                 f"{'0/1' if i % 3 else './.'}"))
     whole_var = variant_stats_file(vcf_path)
 
-    got = {"FLAGSTAT": [], "SEQ": [], "VAR": []}
+    import random as _random
+    from hadoop_bam_tpu.parallel.pipeline import fastq_seq_stats_file
+    rng = _random.Random(9)
+    fq_path = str(tmp_path / "dist.fastq")
+    with open(fq_path, "w") as f:
+        for i in range(3000):
+            seq = "".join(rng.choice("ACGT") for _ in range(100))
+            qual = "".join(chr(33 + rng.randint(2, 40)) for _ in range(100))
+            f.write(f"@r{i}\n{seq}\n+\n{qual}\n")
+    whole_fq = fastq_seq_stats_file(fq_path)
+
+    got = {"FLAGSTAT": [], "SEQ": [], "VAR": [], "FQ": []}
     for rc, so, se in run_two_process(tmp_path, _DIST_STATS_CHILD,
-                                      [path, vcf_path]):
+                                      [path, vcf_path, fq_path]):
         assert rc == 0, f"child failed:\n{so}\n{se[-2000:]}"
         for key in got:
             line = next(ln for ln in so.splitlines()
@@ -307,4 +323,9 @@ def test_distributed_stats_two_process(bam, tmp_path):
         assert g["n_snp"] == whole_var["n_snp"]
         assert g["n_pass"] == whole_var["n_pass"]
         assert abs(g["mean_af"] - whole_var["mean_af"]) < 1e-4
+    for g in got["FQ"]:
+        assert g["n_reads"] == whole_fq["n_reads"] == 3000
+        assert abs(g["mean_gc"] - whole_fq["mean_gc"]) < 1e-4
+        assert abs(g["mean_qual"] - whole_fq["mean_qual"]) < 1e-4
+        assert g["base_hist"] == [int(v) for v in whole_fq["base_hist"]]
     assert whole["total"] == len(records)
