@@ -1,6 +1,9 @@
-"""Benchmark: the BASELINE.md measurement matrix, one JSON line.
+"""Benchmark: the BASELINE.md measurement matrix, cumulative JSON lines.
 
-Prints ONE JSON line.  The top-level keys keep the driver contract
+Prints a cumulative JSON line after every component; the LAST stdout
+line is the authoritative result (the driver parses the last line, so
+an external kill at any moment costs at most the in-flight row).
+The top-level keys keep the driver contract
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 for the headline metric (BAM decode records/sec/chip), and add
     "components": [ {metric, value, unit[, vs_baseline]}, ... ]
@@ -41,8 +44,8 @@ _HDR_TEXT = ("@HD\tVN:1.6\tSO:coordinate\n"
              "@SQ\tSN:chr20\tLN:64444167\n@SQ\tSN:chr21\tLN:46709983\n")
 
 # ---------------------------------------------------------------------------
-# resilience: the driver contract is ONE JSON line on stdout, rc=0 — always.
-# The TPU backend behind the tunnel can fail to init or hang outright
+# resilience: the driver contract is JSON on stdout (last line wins), rc=0 —
+# always.  The TPU backend behind the tunnel can fail to init or hang outright
 # (BENCH_r03 was lost to exactly that), so:
 #   * the backend is probed in a SUBPROCESS with a timeout and retries;
 #     on terminal failure the run falls back to CPU and records it;
@@ -52,10 +55,17 @@ _HDR_TEXT = ("@HD\tVN:1.6\tSO:coordinate\n"
 #     exits 0 if the whole run would blow its deadline.
 # ---------------------------------------------------------------------------
 
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
-PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
-DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "2400"))
-SCALING_DEVICES = (1, 2, 4, 8)
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
+# r3 and r4 were both lost to the driver's *external* timeout (rc=124)
+# killing a run whose single JSON line only appeared at the very end.
+# Two defenses now:  the internal deadline defaults well under any
+# plausible external budget, and the cumulative JSON line is re-printed
+# after EVERY component (the driver parses the last line, so a kill at
+# any moment costs at most the in-flight row, never the round).
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "420"))
+SCALING_DEVICES = (1, 8, 2, 4)   # endpoints first: a truncated curve
+                                 # still brackets the scaling range
 
 _T0 = time.monotonic()
 _EMITTED = threading.Event()
@@ -68,12 +78,7 @@ def _remaining() -> float:
     return DEADLINE_S - (time.monotonic() - _T0)
 
 
-def _emit(status: str) -> None:
-    # watchdog + main thread can race here; exactly one may print
-    with _EMIT_LOCK:
-        if _EMITTED.is_set():
-            return
-        _EMITTED.set()
+def _snapshot(status: str) -> dict:
     head = _STATE["headline"]
     if status == "ok" and head is None:
         # never report a failed headline as a measured 0.0-ok
@@ -93,7 +98,25 @@ def _emit(status: str) -> None:
         out["scaling"] = _STATE["scaling"]
     if _STATE["notes"]:
         out["notes"] = _STATE["notes"]
-    print(json.dumps(out), flush=True)
+    return out
+
+
+def _emit_progress() -> None:
+    """Cumulative line after each component: last line wins downstream."""
+    with _EMIT_LOCK:
+        if _EMITTED.is_set():
+            return
+        print(json.dumps(_snapshot("partial")), flush=True)
+
+
+def _emit(status: str) -> None:
+    # watchdog + main thread can race here; exactly one may print the
+    # final line (progress lines before it are superseded, by contract)
+    with _EMIT_LOCK:
+        if _EMITTED.is_set():
+            return
+        _EMITTED.set()
+        print(json.dumps(_snapshot(status)), flush=True)
 
 
 _CHILD = {"proc": None}   # in-flight scaling subprocess, for watchdog kill
@@ -113,6 +136,22 @@ def _watchdog() -> None:
                     pass
             os._exit(0)
         time.sleep(min(5.0, max(0.5, _remaining())))
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compile cache under bench_data/: rounds after the
+    first hit the cache instead of re-paying every jit/scan compile
+    (tens of seconds each on the tunneled chip) inside the budget."""
+    import jax
+
+    try:
+        cache_dir = os.path.join(BENCH_DIR, "jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass   # cache is an optimization, never a requirement
 
 
 _PROBE_SRC = (
@@ -135,6 +174,7 @@ def acquire_platform() -> str:
     """
     import jax
 
+    _enable_compile_cache()
     forced = os.environ.get("BENCH_PLATFORM", "").strip().lower()
     if forced and forced != "cpu":
         _STATE["notes"].append(
@@ -179,16 +219,23 @@ def acquire_platform() -> str:
     return devs[0].platform
 
 
-def _run_component(fn, label: str) -> None:
-    """Append fn()'s component dict; convert failures into error rows."""
-    if _remaining() < 90:
+def _run_component(fn, label: str, est_s: float = 30.0) -> None:
+    """Append fn()'s component dict; convert failures into error rows.
+
+    ``est_s`` is the component's expected cost: it is skipped (with a
+    row saying so) rather than started when the remaining budget could
+    not absorb it — a skipped row is recoverable next round, a run
+    that straddles the external kill loses the in-flight row."""
+    if _remaining() < est_s + 20:
         _STATE["components"].append({"metric": label, "skipped": "deadline"})
+        _emit_progress()
         return
     try:
         _STATE["components"].append(fn())
     except Exception as e:
         _STATE["components"].append(
             {"metric": label, "error": f"{type(e).__name__}: {e}"})
+    _emit_progress()
 
 
 def _median_time(fn, reps: int = 3):
@@ -801,13 +848,16 @@ def _kernel_rate(step, args, work_per_iter: float):
     meaningless).  If even the longest chain stays within noise, the
     row is flagged unreliable instead of reporting an absurd rate."""
     floor = _readback_floor()
-    k = 16
+    # start long and cap low: every retry is a fresh lax.scan compile
+    # (~tens of seconds on the tunneled chip), and the r3/r4 runs spent
+    # more budget compiling chain lengths than measuring them
+    k = 64
     while True:
         run = _scan_chain(step, k)
         raw = _chained_time(lambda: run(*args), reps=3)
-        if raw >= 4 * floor or k >= 4096:
+        if raw >= 4 * floor or k >= 1024:
             break
-        k = min(k * 4, 4096)
+        k = min(k * 4, 1024)
     dt = max(raw - floor, 1e-9)
     extras = {"chain_len": k}
     if raw < 1.5 * floor:
@@ -947,6 +997,9 @@ def _scaling_child(n_dev: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: the children re-trace the same programs
+    # every round — cached, a child's cost is runs, not compiles
+    _enable_compile_cache()
 
     from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.parallel.mesh import make_mesh
@@ -955,12 +1008,17 @@ def _scaling_child(n_dev: int) -> None:
     )
     from hadoop_bam_tpu.utils.metrics import METRICS
 
-    path = BENCH_BAM
+    path = os.environ.get("BENCH_SCALING_BAM", BENCH_BAM)
     header, _ = read_bam_header(path)
     mesh = make_mesh()
     out = {"n_devices": n_dev, "jax_devices": len(jax.devices())}
+    # cumulative emission, same contract as the parent: the parent reads
+    # the LAST '{' line, so a child killed mid-pipeline still delivers
+    # every pipeline it finished (the r3/r4 loss mode, fixed one level
+    # down too)
+    print(json.dumps(out), flush=True)
 
-    def timed(fn, reps=3):
+    def timed(fn, reps=2):
         fn()                       # warmup: jit compile + page cache
         METRICS.reset()
         times = []
@@ -969,7 +1027,9 @@ def _scaling_child(n_dev: int) -> None:
             res = fn()
             times.append(time.perf_counter() - t0)
         timers = {k: round(v / reps, 4) for k, v in METRICS.timers.items()}
-        return res, sorted(times)[len(times) // 2], timers
+        # lower median: best-of for reps=2, true median for odd reps —
+        # never the max (a GC hiccup must not define the curve)
+        return res, sorted(times)[(len(times) - 1) // 2], timers
 
     stats, dt, timers = timed(
         lambda: flagstat_file(path, mesh=mesh, header=header))
@@ -982,10 +1042,12 @@ def _scaling_child(n_dev: int) -> None:
     out["flagstat_stage_seconds_per_run"] = timers
     out["stage_timer_note"] = ("host_decode/inflate/walk are thread-summed "
                                "work seconds; device_* are wall seconds")
+    print(json.dumps(out), flush=True)
 
     sstats, dt, _ = timed(lambda: seq_stats_file(path, mesh=mesh))
     out["seq_stats_records_per_sec"] = round(
         int(sstats.get("n_reads", n_file_records)) / dt, 1)
+    print(json.dumps(out), flush=True)
 
     # no .bai sidecar on the bench fixture: coverage streams every record
     _, dt, _ = timed(lambda: coverage_file(path, "chr20:1-4194304",
@@ -995,16 +1057,41 @@ def _scaling_child(n_dev: int) -> None:
     print(json.dumps(out), flush=True)
 
 
-def bench_scaling() -> dict:
+def _scaling_fixture(path: str) -> str:
+    """A smaller sorted BAM for the scaling children: the curve measures
+    work partitioning, which a 100k slice shows as well as the full
+    fixture at a third of the per-child cost on this 1-core host."""
+    n = min(BENCH_RECORDS, int(os.environ.get("BENCH_SCALING_RECORDS",
+                                              "100000")))
+    if n >= BENCH_RECORDS:
+        return path
+    dst = os.path.join(BENCH_DIR, f"bench_scaling_{n}.bam")
+    if not os.path.exists(dst):
+        from hadoop_bam_tpu.formats.bamio import BamWriter
+
+        ds, recs = _collect_record_bytes(path, n)
+        with BamWriter(dst + ".tmp", ds.header) as w:
+            for r in recs:
+                w.write_record_bytes(r)
+        os.replace(dst + ".tmp", dst)
+    return dst
+
+
+def bench_scaling(path: str) -> dict:
     rows = []
+    try:
+        scaling_bam = _scaling_fixture(path)
+    except Exception as e:
+        return {"error": f"scaling fixture: {type(e).__name__}: {e}"}
     for n in SCALING_DEVICES:
-        if _remaining() < 240:
+        if _remaining() < 70:
             rows.append({"n_devices": n, "skipped": "deadline"})
             continue
         env = dict(os.environ)
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                             + f" --xla_force_host_platform_device_count={n}"
                             ).strip()
+        env["BENCH_SCALING_BAM"] = scaling_bam
         try:
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__),
@@ -1012,22 +1099,29 @@ def bench_scaling() -> dict:
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 text=True, env=env)
             _CHILD["proc"] = proc
+            timed_out = False
             try:
                 stdout, stderr = proc.communicate(
-                    timeout=min(900.0, max(60.0, _remaining() - 120)))
+                    timeout=min(180.0, max(45.0, _remaining() - 30)))
             except subprocess.TimeoutExpired:
                 proc.kill()
-                proc.communicate()
-                rows.append({"n_devices": n, "error": "timeout"})
-                continue
+                stdout, stderr = proc.communicate()
+                timed_out = True
             finally:
                 _CHILD["proc"] = None
-            line = next((ln for ln in reversed(stdout.splitlines())
+            line = next((ln for ln in reversed((stdout or "").splitlines())
                          if ln.startswith("{")), None)
-            if proc.returncode == 0 and line:
-                rows.append(json.loads(line))
+            if line and (timed_out or proc.returncode == 0):
+                row = json.loads(line)
+                if timed_out:
+                    # the child emits cumulatively too: keep whatever
+                    # pipelines it finished before the kill
+                    row["partial"] = "timeout"
+                rows.append(row)
+            elif timed_out:
+                rows.append({"n_devices": n, "error": "timeout"})
             else:
-                err = stderr.strip().splitlines()
+                err = (stderr or "").strip().splitlines()
                 rows.append({"n_devices": n, "error":
                              f"rc={proc.returncode}: "
                              f"{err[-1][:200] if err else 'no output'}"})
@@ -1081,34 +1175,45 @@ def main() -> None:
         _STATE["components"].append(
             {"metric": "bam_decode_records_per_sec_per_chip",
              "error": f"{type(e).__name__}: {e}"})
+    _emit_progress()
 
-    _run_component(lambda: bench_bgzf_inflate(path), "bgzf_inflate_gbps")
-    _run_component(lambda: bench_deflate_tokenize(path),
-                   "deflate_tokenize_gbps")
-    _run_component(lambda: bench_cram(build_cram_fixture()),
-                   "cram_tensor_records_per_sec")
-    _run_component(lambda: bench_vcf(build_vcf_fixture()),
-                   "vcf_variants_per_sec")
-    _run_component(lambda: bench_fastq(build_fastq_fixture()),
-                   "fastq_reads_per_sec")
+    # ordered cheapest/highest-value first: an external kill costs the
+    # tail, so the tail is the rows a verdict can best live without
+    _run_component(lambda: bench_bgzf_inflate(path), "bgzf_inflate_gbps",
+                   est_s=15)
     _run_component(lambda: bench_split_guess(path),
-                   "split_guess_p50_ms_per_boundary")
-    _run_component(lambda: bench_sort(path), "sort_records_per_sec_mesh")
-    _run_component(lambda: bench_coverage(path),
-                   "coverage_records_per_sec")
+                   "split_guess_p50_ms_per_boundary", est_s=10)
+    _run_component(lambda: bench_cram(build_cram_fixture()),
+                   "cram_tensor_records_per_sec", est_s=25)
+    _run_component(lambda: bench_vcf(build_vcf_fixture()),
+                   "vcf_variants_per_sec", est_s=25)
+    _run_component(lambda: bench_fastq(build_fastq_fixture()),
+                   "fastq_reads_per_sec", est_s=25)
     _run_component(lambda: bench_bam_write(path),
-                   "bam_write_records_per_sec")
-    _run_component(bench_seq_pallas_kernel,
-                   "seq_pallas_kernel_bases_per_sec")
-    _run_component(lambda: bench_cigar_pileup_kernel(path),
-                   "cigar_pileup_kernel_records_per_sec")
-    _run_component(bench_mesh_sort_kernel,
-                   "mesh_sort_device_sort_keys_per_sec")
+                   "bam_write_records_per_sec", est_s=25)
+    _run_component(lambda: bench_deflate_tokenize(path),
+                   "deflate_tokenize_gbps", est_s=15)
+    _run_component(lambda: bench_coverage(path),
+                   "coverage_records_per_sec", est_s=35)
+    _run_component(lambda: bench_sort(path), "sort_records_per_sec_mesh",
+                   est_s=45)
 
-    try:
-        _STATE["scaling"] = bench_scaling()
-    except Exception as e:
-        _STATE["scaling"] = {"error": f"{type(e).__name__}: {e}"}
+    # the scaling curve outranks the single-kernel rows (VERDICT r4 #3)
+    if _remaining() > 70:
+        try:
+            _STATE["scaling"] = bench_scaling(path)
+        except Exception as e:
+            _STATE["scaling"] = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        _STATE["scaling"] = {"skipped": "deadline"}
+    _emit_progress()
+
+    _run_component(bench_seq_pallas_kernel,
+                   "seq_pallas_kernel_bases_per_sec", est_s=40)
+    _run_component(lambda: bench_cigar_pileup_kernel(path),
+                   "cigar_pileup_kernel_records_per_sec", est_s=40)
+    _run_component(bench_mesh_sort_kernel,
+                   "mesh_sort_device_sort_keys_per_sec", est_s=40)
 
     _emit("ok")
 
