@@ -1109,10 +1109,17 @@ def bench_scaling(path: str) -> dict:
                 timed_out = True
             finally:
                 _CHILD["proc"] = None
-            line = next((ln for ln in reversed((stdout or "").splitlines())
-                         if ln.startswith("{")), None)
-            if line and (timed_out or proc.returncode == 0):
-                row = json.loads(line)
+            row = None
+            for ln in reversed((stdout or "").splitlines()):
+                # a kill can truncate the final line mid-write: take the
+                # newest line that actually parses
+                if ln.startswith("{"):
+                    try:
+                        row = json.loads(ln)
+                        break
+                    except ValueError:
+                        continue
+            if row is not None and (timed_out or proc.returncode == 0):
                 if timed_out:
                     # the child emits cumulatively too: keep whatever
                     # pipelines it finished before the kill

@@ -61,39 +61,31 @@ class CramDataset:
         """Device-resident read batches (same layout as
         FastqDataset.tensor_batches) decoded from CRAM containers.
 
-        Columnar fast path: spans decode to pre-SAM CramRecords
-        (read_cram_span_raw) whose seq/qual pack straight into tiles —
-        no SamRecord materialization, no mate resolution, no per-record
-        Python packing."""
-        import numpy as np
-
+        Columnar fast path: spans decode straight to columns
+        (read_cram_span_columns — the vectorized slice decoder, no
+        CramRecord objects) whose seq/qual runs pack directly into
+        tiles; slices outside the vectorizable layout fall back to the
+        record decoder with identical output."""
         from hadoop_bam_tpu.api.read_datasets import (
             ragged_to_payload_tiles,
         )
         from hadoop_bam_tpu.parallel.pipeline import (
             stream_read_tensor_batches,
         )
-        from hadoop_bam_tpu.split.cram_planner import read_cram_span_raw
-
-        from hadoop_bam_tpu.formats.cram_decode import CF_QUAL_STORED
+        from hadoop_bam_tpu.split.cram_planner import (
+            read_cram_span_columns,
+        )
 
         def tiles(span, geom):
-            recs = read_cram_span_raw(self.path, span, header=self.header,
-                                      ref_source=self._ref_source)
-            seqs = [r.seq if r.seq != "*" else "" for r in recs]
-            seq_cat = "".join(seqs).encode("latin-1")
-            seq_lens = np.fromiter((len(s) for s in seqs), np.int64,
-                                   len(seqs))
-            # same gate as _to_sam: without CF_QUAL_STORED, r.qual is the
-            # decoder's 0xff filler, not data — those reads have qual '*'
-            quals = [r.qual if r.cf & CF_QUAL_STORED else b""
-                     for r in recs]
-            qual_cat = b"".join(quals)
-            qual_lens = np.fromiter((len(q) for q in quals), np.int64,
-                                    len(quals))
+            cols = read_cram_span_columns(self.path, span,
+                                          header=self.header,
+                                          ref_source=self._ref_source)
+            # qual_lens gate == the CF_QUAL_STORED gate in _to_sam:
+            # without stored quals the column is already empty
             return ragged_to_payload_tiles(
-                seq_cat, seq_lens, qual_cat, qual_lens, geom.seq_stride,
-                geom.qual_stride, geom.max_len, qual_offset=0)
+                cols["seq_cat"], cols["seq_lens"], cols["qual_cat"],
+                cols["qual_lens"], geom.seq_stride, geom.qual_stride,
+                geom.max_len, qual_offset=0)
 
         yield from stream_read_tensor_batches(
             self.spans(num_spans), None, self.config, mesh, geometry,

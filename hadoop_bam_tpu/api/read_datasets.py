@@ -451,11 +451,23 @@ def ragged_to_payload_tiles(seq_cat: bytes, seq_lens: np.ndarray,
 
     L = int(lengths.max())
     if L:
-        L_even = L + (L & 1)
-        col = np.arange(L_even, dtype=np.int64)[None, :]
-        mask = col < lengths[:, None]
-        g = np.minimum(s0[:, None] + col, max(sbuf.size - 1, 0))
-        codes = np.where(mask, _NIBBLE_CODE[sbuf[g]], 0).astype(np.uint8)
+        # uniform read length (the overwhelmingly common case): the
+        # concatenated buffer IS the (n, len) matrix — reshape instead
+        # of building per-row gather/mask matrices
+        if int(seq_lens.min()) == int(seq_lens.max()):
+            rl0 = int(seq_lens[0])
+            mat = sbuf[:n * rl0].reshape(n, rl0)[:, :L]
+            codes = _NIBBLE_CODE[mat]
+            if L & 1:
+                codes = np.concatenate(
+                    [codes, np.zeros((n, 1), np.uint8)], axis=1)
+        else:
+            L_even = L + (L & 1)
+            col = np.arange(L_even, dtype=np.int64)[None, :]
+            mask = col < lengths[:, None]
+            g = np.minimum(s0[:, None] + col, max(sbuf.size - 1, 0))
+            codes = np.where(mask, _NIBBLE_CODE[sbuf[g]], 0
+                             ).astype(np.uint8)
         packed = (codes[:, 0::2] << 4) | codes[:, 1::2]
         ks = min(packed.shape[1], seq_stride)
         seq[:, :ks] = packed[:, :ks]
@@ -463,12 +475,23 @@ def ragged_to_payload_tiles(seq_cat: bytes, seq_lens: np.ndarray,
     qlen = np.minimum(qual_lens, max_len).astype(np.int64)
     Lq = int(qlen.max(initial=0))
     if Lq and qbuf.size:
-        colq = np.arange(Lq, dtype=np.int64)[None, :]
-        maskq = colq < qlen[:, None]
-        gq = np.minimum(q0[:, None] + colq, qbuf.size - 1)
-        vals = np.where(maskq, qbuf[gq].astype(np.int16) - qual_offset, 0)
         kq = min(Lq, qual_stride)
-        qual[:, :kq] = np.clip(vals, 0, 255).astype(np.uint8)[:, :kq]
+        if int(qual_lens.min()) == int(qual_lens.max()):
+            ql0 = int(qual_lens[0])
+            mat = qbuf[:n * ql0].reshape(n, ql0)[:, :kq]
+            if qual_offset:
+                qual[:, :kq] = np.clip(
+                    mat.astype(np.int16) - qual_offset, 0, 255
+                ).astype(np.uint8)
+            else:
+                qual[:, :kq] = mat
+        else:
+            colq = np.arange(Lq, dtype=np.int64)[None, :]
+            maskq = colq < qlen[:, None]
+            gq = np.minimum(q0[:, None] + colq, qbuf.size - 1)
+            vals = np.where(maskq, qbuf[gq].astype(np.int16)
+                            - qual_offset, 0)
+            qual[:, :kq] = np.clip(vals, 0, 255).astype(np.uint8)[:, :kq]
     return seq, qual, lengths
 
 

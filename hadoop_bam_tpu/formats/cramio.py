@@ -147,21 +147,15 @@ def read_cram_header(source) -> Tuple[SAMHeader, int]:
     raise CRAMError("first container carries no FILE_HEADER block")
 
 
-def decode_container_slices(cont: Container, header: SAMHeader,
-                            ref_source: Optional[ReferenceSource] = None
-                            ) -> List[Tuple[int, List["CramRecord"]]]:
-    """Decode one data container into per-slice pre-SAM CramRecord lists
-    (features resolved, mates NOT linked), each paired with its slice's
-    record-counter base.  The columnar stats path consumes these directly
-    — seq/qual/length are final here — skipping mate resolution and
-    SamRecord materialization; decode_container builds on this for the
-    full SAM view."""
+def iter_container_slices(cont: Container):
+    """(comp, slice_hdr, core, external) for each slice of one data
+    container — the shared walk under both the record-object and the
+    columnar slice decoders."""
     if cont.header.is_eof or not cont.blocks:
-        return []
+        return
     if cont.blocks[0].content_type != COMPRESSION_HEADER:
         raise CRAMError("container does not start with a compression header")
     comp = CompressionHeader.from_bytes(cont.blocks[0].data)
-    out: List[Tuple[int, List["CramRecord"]]] = []
     i = 1
     while i < len(cont.blocks):
         blk = cont.blocks[i]
@@ -179,10 +173,24 @@ def decode_container_slices(cont: Container, header: SAMHeader,
                 core = b.data
             elif b.content_type == EXTERNAL_DATA:
                 external[b.content_id] = b.data
+        yield comp, slice_hdr, core, external
+        i += 1 + slice_hdr.n_blocks
+
+
+def decode_container_slices(cont: Container, header: SAMHeader,
+                            ref_source: Optional[ReferenceSource] = None
+                            ) -> List[Tuple[int, List["CramRecord"]]]:
+    """Decode one data container into per-slice pre-SAM CramRecord lists
+    (features resolved, mates NOT linked), each paired with its slice's
+    record-counter base.  The columnar stats path consumes these directly
+    — seq/qual/length are final here — skipping mate resolution and
+    SamRecord materialization; decode_container builds on this for the
+    full SAM view."""
+    out: List[Tuple[int, List["CramRecord"]]] = []
+    for comp, slice_hdr, core, external in iter_container_slices(cont):
         records = decode_slice_records(comp, slice_hdr, core, external,
                                        header.ref_names, ref_source)
         out.append((slice_hdr.record_counter, records))
-        i += 1 + slice_hdr.n_blocks
     return out
 
 

@@ -113,3 +113,30 @@ def read_cram_span_raw(source, span: FileByteSpan, *, header: SAMHeader,
                                                       ref_source):
             out.extend(records)
     return out
+
+
+def read_cram_span_columns(source, span: FileByteSpan, *,
+                           header: SAMHeader, ref_source=None,
+                           want_names: bool = False) -> dict:
+    """One span as columns (cram_columns.decode_slice_columns layout):
+    the vectorized slice decoder where the layout allows, the record
+    path (converted) where it doesn't — output identical either way."""
+    from hadoop_bam_tpu.formats.cram_columns import (
+        concat_columns, decode_slice_columns, records_to_columns,
+    )
+    from hadoop_bam_tpu.formats.cram_decode import decode_slice_records
+    from hadoop_bam_tpu.formats.cramio import iter_container_slices
+
+    parts = []
+    for cont in _iter_span_containers(source, span):
+        for comp, slice_hdr, core, external in iter_container_slices(cont):
+            cols = decode_slice_columns(comp, slice_hdr, core, external,
+                                        header.ref_names, ref_source,
+                                        want_names=want_names)
+            if cols is None:
+                cols = records_to_columns(
+                    decode_slice_records(comp, slice_hdr, core, external,
+                                         header.ref_names, ref_source),
+                    want_names=want_names)
+            parts.append(cols)
+    return concat_columns(parts)

@@ -1,0 +1,381 @@
+"""Parity: the vectorized columnar CRAM slice decoder vs the record path.
+
+The columnar decoder (formats/cram_columns.py) must be byte-identical to
+assembling the same columns from decode_slice_records — over encoder-
+produced files AND over hand-built slices that exercise the feature codes
+our encoder never emits (X substitutions, B/i single bases, q/Q qual
+overlays, D/N/P/H with reference fill).
+
+Reference scope: htsjdk CRAM slice decode via hb/CRAMInputFormat.java
+(SURVEY.md section 2.3).
+"""
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.cram import write_itf8
+from hadoop_bam_tpu.formats.cram_columns import (
+    decode_slice_columns, records_to_columns,
+)
+from hadoop_bam_tpu.formats.cram_decode import (
+    ByteArrayLenEncoding, ByteArrayStopEncoding, CF_QUAL_STORED,
+    CF_UNKNOWN_BASES, CompressionHeader, CRAMError, ExternalEncoding,
+    FastaReferenceSource, HuffmanEncoding, SliceHeader,
+    decode_slice_records,
+)
+
+HDR = SAMHeader.from_sam_text(
+    "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:c1\tLN:100000\n"
+    "@SQ\tSN:c2\tLN:100000\n")
+
+
+def _assert_columns_match(cols, recs):
+    ref = records_to_columns(recs, want_names=True)
+    assert cols is not None
+    assert cols["n"] == ref["n"]
+    for k in ("bf", "cf", "ref_id", "rl", "pos", "mapq", "read_group",
+              "seq_lens", "qual_lens", "name_lens"):
+        np.testing.assert_array_equal(cols[k], ref[k], err_msg=k)
+    for k in ("seq_cat", "qual_cat", "name_cat"):
+        assert cols[k] == ref[k], k
+
+
+# ---------------------------------------------------------------------------
+# hand-built slices: full control over features and layout
+# ---------------------------------------------------------------------------
+
+class _SliceBuilder:
+    """Serialize records into the encoder-default external layout
+    (everything external, arrays ByteArrayLen, names ByteArrayStop) in
+    exact record-serial stream order — the order both decoders must
+    agree on."""
+
+    INT_SERIES = ("BF", "CF", "RL", "AP", "RG", "TL", "MF", "NS", "NP",
+                  "TS", "NF", "MQ", "FN", "FP", "DL", "RS", "PD", "HC")
+    BYTE_SERIES = ("FC", "QS", "BA", "BS")
+    ARRAY_SERIES = ("BB", "QQ", "IN", "SC")
+
+    def __init__(self, ref_seq_id=0):
+        self.ints = {k: bytearray() for k in self.INT_SERIES}
+        self.bytes_ = {k: bytearray() for k in self.BYTE_SERIES}
+        self.arr_len = {k: bytearray() for k in self.ARRAY_SERIES}
+        self.arr_val = {k: bytearray() for k in self.ARRAY_SERIES}
+        self.names = bytearray()
+        self.n = 0
+        self.ref_seq_id = ref_seq_id
+
+    def put_int(self, k, v):
+        self.ints[k] += write_itf8(v)
+
+    def put_byte(self, k, v):
+        self.bytes_[k].append(v & 0xFF)
+
+    def put_arr(self, k, data: bytes):
+        self.arr_len[k] += write_itf8(len(data))
+        self.arr_val[k] += data
+
+    def add(self, *, bf=0, cf=CF_QUAL_STORED, rl=10, ap=100, rg=-1,
+            name=b"r", features=(), mq=60, qual=None, ba=None):
+        """features: (fpos, code, payload) with absolute 1-based fpos;
+        payload is bytes for b/q/I/S, int for D/N/P/H/X, (base, qual)
+        for B, base int for i, qual int for Q."""
+        self.n += 1
+        self.put_int("BF", bf)
+        self.put_int("CF", cf)
+        self.put_int("RL", rl)
+        self.put_int("AP", ap)
+        self.put_int("RG", rg)
+        self.names += bytes(name) + b"\x00"
+        self.put_int("TL", 0)
+        if not bf & 0x4:
+            self.put_int("FN", len(features))
+            prev = 0
+            for fpos, code, payload in features:
+                self.put_byte("FC", ord(code))
+                self.put_int("FP", fpos - prev)
+                prev = fpos
+                if code in ("b", "q", "I", "S"):
+                    self.put_arr({"b": "BB", "q": "QQ", "I": "IN",
+                                  "S": "SC"}[code], payload)
+                elif code in ("D", "N", "P", "H"):
+                    self.put_int({"D": "DL", "N": "RS", "P": "PD",
+                                  "H": "HC"}[code], payload)
+                elif code == "X":
+                    self.put_byte("BS", payload)
+                elif code == "B":
+                    self.put_byte("BA", payload[0])
+                    self.put_byte("QS", payload[1])
+                elif code == "i":
+                    self.put_byte("BA", payload)
+                elif code == "Q":
+                    self.put_byte("QS", payload)
+                else:
+                    raise AssertionError(code)
+            self.put_int("MQ", mq)
+            if cf & CF_QUAL_STORED:
+                q = qual if qual is not None else bytes(range(rl))
+                assert len(q) == rl
+                self.bytes_["QS"] += q
+        else:
+            b = ba if ba is not None else b"N" * rl
+            assert len(b) == rl
+            self.bytes_["BA"] += b
+            if cf & CF_QUAL_STORED:
+                q = qual if qual is not None else bytes(range(rl))
+                assert len(q) == rl
+                self.bytes_["QS"] += q
+
+    def build(self):
+        comp = CompressionHeader(read_names_included=True, ap_delta=False)
+        external = {}
+        cid = 1
+        for k in self.INT_SERIES:
+            comp.data_series[k] = ExternalEncoding(cid)
+            external[cid] = bytes(self.ints[k])
+            cid += 1
+        for k in self.BYTE_SERIES:
+            comp.data_series[k] = ExternalEncoding(cid)
+            external[cid] = bytes(self.bytes_[k])
+            cid += 1
+        for k in self.ARRAY_SERIES:
+            comp.data_series[k] = ByteArrayLenEncoding(
+                ExternalEncoding(cid), ExternalEncoding(cid + 1))
+            external[cid] = bytes(self.arr_len[k])
+            external[cid + 1] = bytes(self.arr_val[k])
+            cid += 2
+        comp.data_series["RN"] = ByteArrayStopEncoding(0, cid)
+        external[cid] = bytes(self.names)
+        hdr = SliceHeader(ref_seq_id=self.ref_seq_id, start=1, span=0,
+                          n_records=self.n)
+        return comp, hdr, b"", external
+
+    def decode_both(self, ref_source=None, ref_names=("c1", "c2")):
+        comp, hdr, core, external = self.build()
+        recs = decode_slice_records(comp, hdr, core, dict(external),
+                                    list(ref_names), ref_source)
+        cols = decode_slice_columns(comp, hdr, core, dict(external),
+                                    list(ref_names), ref_source,
+                                    want_names=True)
+        return cols, recs
+
+
+REF = FastaReferenceSource(b">c1\n" + b"ACGTACGTGG" * 10000
+                           + b"\n>c2\n" + b"TTGGCCAATT" * 10000 + b"\n")
+
+
+def test_verbatim_bases_no_reference():
+    b = _SliceBuilder()
+    b.add(rl=8, ap=10, features=[(1, "b", b"ACGTACGT")])
+    b.add(rl=6, ap=20, features=[(1, "b", b"GGGTTT")], name=b"second")
+    cols, recs = b.decode_both()
+    _assert_columns_match(cols, recs)
+
+
+def test_unmapped_and_unknown_bases():
+    b = _SliceBuilder(ref_seq_id=-1)
+    b.add(bf=0x4, rl=7, ap=0, ba=b"ACGTNNN")
+    b.add(bf=0x4, cf=0, rl=5, ap=0, ba=b"AAAAA")           # no quals
+    b.add(bf=0x4, cf=CF_UNKNOWN_BASES | CF_QUAL_STORED, rl=4, ap=0,
+          ba=b"NNNN")
+    cols, recs = b.decode_both()
+    _assert_columns_match(cols, recs)
+    # unmapped records keep their BA bases even under CF_UNKNOWN_BASES
+    # (the record path's '*' rewrite is mapped-only)
+    assert cols["seq_lens"][2] == 4
+    assert cols["qual_lens"][1] == 0       # no CF_QUAL_STORED, no qual
+
+
+def test_mapped_unknown_bases_drop_seq():
+    b = _SliceBuilder()
+    b.add(rl=6, ap=5, cf=CF_UNKNOWN_BASES | CF_QUAL_STORED,
+          features=[(1, "b", b"ACGTAC")])
+    cols, recs = b.decode_both()
+    _assert_columns_match(cols, recs)
+    assert recs[0].seq == "*"
+    assert cols["seq_lens"][0] == 0
+
+
+def test_reference_fill_and_substitution():
+    b = _SliceBuilder()
+    # pure match: whole read from the reference
+    b.add(rl=10, ap=5, features=[])
+    # X substitution mid-read (code 0-3 against the default matrix)
+    b.add(rl=10, ap=17, features=[(4, "X", 2)])
+    # deletion + insertion + soft clip with ref fill around them
+    b.add(rl=12, ap=31, features=[(3, "D", 4), (5, "I", b"TT"),
+                                  (11, "S", b"GG")])
+    # refskip + pad + hardclip consume no read bases
+    b.add(rl=9, ap=55, features=[(4, "N", 6), (6, "P", 2), (6, "H", 3)])
+    cols, recs = b.decode_both(ref_source=REF)
+    _assert_columns_match(cols, recs)
+
+
+def test_single_base_features_and_qual_overlays():
+    b = _SliceBuilder()
+    # B: base+qual pair; i: inserted base; Q/q: qual-only overlays
+    b.add(rl=10, ap=5, features=[(2, "B", (ord("T"), 7)), (5, "i", ord("C")),
+                                 (8, "Q", 9)])
+    b.add(rl=10, ap=30, features=[(3, "q", bytes([1, 2, 3]))])
+    # overlays on a record WITHOUT stored quals only touch the filler
+    b.add(rl=6, ap=60, cf=0, features=[(2, "Q", 11)])
+    cols, recs = b.decode_both(ref_source=REF)
+    _assert_columns_match(cols, recs)
+
+
+def test_colliding_qual_overlays_apply_in_feature_order():
+    b = _SliceBuilder()
+    # 'Q' writes qual pos 3, then a zero-advance 'q' overlapping pos 3:
+    # the record path applies features in order, so the 'q' value wins
+    b.add(rl=8, ap=5, features=[(3, "Q", 41), (3, "q", bytes([7, 8, 9]))])
+    # and the reverse: 'q' first, overlapping 'Q' second -> 'Q' wins
+    b.add(rl=8, ap=40, features=[(2, "q", bytes([5, 6, 7])), (3, "Q", 42)])
+    cols, recs = b.decode_both(ref_source=REF)
+    _assert_columns_match(cols, recs)
+    assert cols["qual_cat"][2] == 7        # rec 0, pos 3: 'q' won
+    assert cols["qual_cat"][8 + 2] == 42   # rec 1, pos 3: 'Q' won
+
+
+def test_multiref_slice_and_second_contig():
+    b = _SliceBuilder(ref_seq_id=-2)
+    b.ints["RI"] = bytearray()
+    b.INT_SERIES = b.INT_SERIES + ("RI",)
+    b.ints.setdefault("RI", bytearray())
+    # rebuild with RI values: interleave manually
+    b2 = _SliceBuilder(ref_seq_id=-2)
+    b2.ints["RI"] = bytearray()
+    orig_add = b2.add
+
+    def add_with_ri(ri, **kw):
+        b2.ints["RI"] += write_itf8(ri)
+        orig_add(**kw)
+    b2.add_with_ri = add_with_ri
+    b2.add_with_ri(0, rl=8, ap=11, features=[])
+    b2.add_with_ri(1, rl=8, ap=21, features=[(3, "X", 1)])
+    comp, hdr, core, external = b2.build()
+    comp.data_series["RI"] = ExternalEncoding(99)
+    external[99] = bytes(b2.ints["RI"])
+    recs = decode_slice_records(comp, hdr, core, dict(external),
+                                HDR.ref_names, REF)
+    cols = decode_slice_columns(comp, hdr, core, dict(external),
+                                HDR.ref_names, REF, want_names=True)
+    _assert_columns_match(cols, recs)
+    assert recs[1].seq[2] != "G"           # substitution applied vs c2
+
+
+def test_missing_reference_falls_back_to_record_error():
+    b = _SliceBuilder()
+    b.add(rl=10, ap=5, features=[])        # needs ref fill
+    comp, hdr, core, external = b.build()
+    assert decode_slice_columns(comp, hdr, core, dict(external),
+                                HDR.ref_names, None) is None
+    with pytest.raises(CRAMError):
+        decode_slice_records(comp, hdr, core, dict(external),
+                             HDR.ref_names, None)
+
+
+def test_core_bit_codec_declines():
+    b = _SliceBuilder()
+    b.add(rl=4, ap=5, features=[(1, "b", b"ACGT")])
+    comp, hdr, core, external = b.build()
+    # a non-constant Huffman (core bits) on a skipped series disables
+    # the columnar path
+    comp.tag_encodings[0x414143] = HuffmanEncoding([1, 2], [1, 1])
+    assert decode_slice_columns(comp, hdr, core, dict(external),
+                                HDR.ref_names, None) is None
+
+
+def test_unknown_feature_code_raises_like_record_path():
+    b = _SliceBuilder()
+    b.add(rl=4, ap=5, features=[(1, "b", b"ACGT")])
+    comp, hdr, core, external = b.build()
+    # corrupt FC to an unknown code on both paths
+    fc_cid = comp.data_series["FC"].content_id
+    external[fc_cid] = b"z"
+    with pytest.raises(CRAMError):
+        decode_slice_records(comp, hdr, core, dict(external),
+                             HDR.ref_names, REF)
+    with pytest.raises(CRAMError):
+        decode_slice_columns(comp, hdr, core, dict(external),
+                             HDR.ref_names, REF)
+
+
+# ---------------------------------------------------------------------------
+# encoder-produced files: whole-file parity through the span reader
+# ---------------------------------------------------------------------------
+
+def _roundtrip_columns(records, header=HDR):
+    import io
+
+    from hadoop_bam_tpu.formats.cramio import CramWriter
+    from hadoop_bam_tpu.split.cram_planner import (
+        plan_cram_spans, read_cram_span_columns, read_cram_span_raw,
+    )
+    sink = io.BytesIO()
+    with CramWriter(sink, header) as w:
+        w.write_records(records)
+    data = sink.getvalue()
+    import os
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".cram", delete=False) as f:
+        f.write(data)
+        path = f.name
+    try:
+        spans = plan_cram_spans(path)
+        all_cols = []
+        all_recs = []
+        for s in spans:
+            all_cols.append(read_cram_span_columns(
+                path, s, header=header, want_names=True))
+            all_recs.extend(read_cram_span_raw(path, s, header=header))
+        from hadoop_bam_tpu.formats.cram_columns import concat_columns
+        return concat_columns(all_cols), all_recs
+    finally:
+        os.unlink(path)
+
+
+def test_file_parity_mixed_cigars():
+    from hadoop_bam_tpu.formats.sam import SamRecord
+    recs = []
+    pos = 1
+    for i in range(300):
+        kind = i % 4
+        if kind == 0:
+            cig, seq = "20M", "ACGTACGTACGTACGTACGT"
+        elif kind == 1:
+            cig, seq = "8M4I8M", "ACGTACGTTTTTACGTACGT"
+        elif kind == 2:
+            cig, seq = "5S10M5S", "GGGGGACGTACGTACGGGGG"
+        else:
+            cig, seq = "10M6D10M", "ACGTACGTACACGTACGTAC"
+        pos += 7
+        recs.append(SamRecord(
+            qname=f"q{i}", flag=0, rname="c1", pos=pos, mapq=50 + i % 10,
+            cigar=cig, rnext="*", pnext=0, tlen=0, seq=seq,
+            qual="".join(chr(33 + (i + j) % 40) for j in range(len(seq)))))
+    # a few unmapped and qual-less records in the same container
+    recs.append(SamRecord(qname="u1", flag=4, rname="*", pos=0, mapq=0,
+                          cigar="*", rnext="*", pnext=0, tlen=0,
+                          seq="ACGTN", qual="IIIII"))
+    recs.append(SamRecord(qname="u2", flag=4, rname="*", pos=0, mapq=0,
+                          cigar="*", rnext="*", pnext=0, tlen=0,
+                          seq="TTTT", qual="*"))
+    cols, raw = _roundtrip_columns(recs)
+    _assert_columns_match(cols, raw)
+    assert cols["n"] == len(recs)
+
+
+def test_file_parity_bench_fixture_layout():
+    """Paired-flag records like the bench fixture writes (detached mates
+    exercise the MF/NS/NP/TS interleave on the skipped-names path)."""
+    from hadoop_bam_tpu.formats.sam import SamRecord
+    recs = []
+    pos = 1
+    for i in range(200):
+        pos += 11
+        recs.append(SamRecord(
+            qname=f"p{i // 2}", flag=99 if i % 2 == 0 else 147,
+            rname="c1", pos=pos, mapq=60, cigar="12M", rnext="=",
+            pnext=pos + 50, tlen=62, seq="ACGTACGTACGT",
+            qual="JJJJJJJJJJJJ"))
+    cols, raw = _roundtrip_columns(recs)
+    _assert_columns_match(cols, raw)
