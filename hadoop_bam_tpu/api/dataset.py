@@ -122,6 +122,11 @@ class BamDataset:
         - ``prefix`` [n_dev, cap, 36] uint8 — fixed columns; decode with
           ops.unpack_bam.unpack_fixed_fields_tile
         - ``n_records`` [n_dev] int32 — valid rows per shard
+
+        ``cap`` is geometry.tile_records for every full batch; the FINAL
+        batch of a run may arrive with fewer rows (shrunk to the
+        smallest dispatch bucket that holds its records) — size consumer
+        buffers from the batch's own shape, not the geometry.
         """
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -120,7 +120,10 @@ class FastqDataset(_SpannedDataset):
         """Device-resident read batches sharded over the mesh's data axis:
         ``seq_packed`` uint8 [n_dev, cap, seq_stride] (BAM 4-bit nibble
         codes, same alphabet as BamDataset.tensor_batches), ``qual`` uint8,
-        ``lengths`` int32 [n_dev, cap], ``n_records`` int32 [n_dev]."""
+        ``lengths`` int32 [n_dev, cap], ``n_records`` int32 [n_dev].
+        The FINAL batch may arrive with fewer rows than
+        geometry.tile_records (shrunk to the smallest dispatch bucket) —
+        size consumer buffers from each batch's own shape."""
         from hadoop_bam_tpu.parallel.pipeline import (
             stream_read_tensor_batches,
         )

@@ -680,6 +680,24 @@ def _iter_tile_tuples(array_tuples, cap: int, specs: Sequence
         yield emit(have)
 
 
+def _bucket_cap(count: int, cap: int, block_n: int = 256) -> int:
+    """Rows to actually dispatch for a partial tile of ``count`` records.
+
+    Full tiles ship at ``cap``; the FINAL partial tile shrinks to the
+    smallest bucket (~cap/16, ~cap/4, cap) that holds it, so a small
+    file pays a kernel over ~its own rows instead of the full padded
+    tile (the small-input dispatch floor: a 10k-read file inside a
+    64k-row tile spent 6x its data in padding).  Buckets are rounded up
+    to the Pallas record-block height ``block_n`` (the kernel asserts
+    divisibility), and a fixed 3-step ladder bounds jit retraces at two
+    extra shapes per step function."""
+    for b in (cap // 16, cap // 4):
+        b = -(-b // block_n) * block_n       # round up to a block multiple
+        if b >= block_n and count <= b < cap:
+            return b
+    return cap
+
+
 def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                              geometry: PayloadGeometry, n_dev: int,
                              config: HBamConfig = DEFAULT_CONFIG,
@@ -688,7 +706,9 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                              ) -> Iterator[Tuple[List[np.ndarray],
                                                  np.ndarray]]:
     """Stream payload tile groups ready for a device mesh: yields
-    ([prefix, seq, qual] each [n_dev, cap, w] uint8, counts [n_dev] int32).
+    ([prefix, seq, qual] each [n_dev, rows, w] uint8, counts [n_dev]
+    int32), where rows == geometry.tile_records for every full group and
+    the FINAL partial group may shrink to a smaller bucket (_bucket_cap).
     The shared batching core of seq_stats_file and
     BamDataset.tensor_batches — host decode pool with a bounded window,
     cross-span tile repacking, zero-padded final group, span retry/skip
@@ -717,13 +737,14 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
         counts: List[int] = []
 
         def emit() -> Tuple[List[np.ndarray], np.ndarray]:
-            stacked = [np.stack([g[j] for g in group])
+            b = _bucket_cap(max(counts), cap, geometry.block_n)
+            stacked = [np.stack([g[j][:b] for g in group])
                        for j in range(len(widths))]
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts
             if stacked[0].shape[0] < n_dev:
                 for j, w in enumerate(widths):
-                    pad = np.zeros((n_dev - stacked[j].shape[0], cap, w),
+                    pad = np.zeros((n_dev - stacked[j].shape[0], b, w),
                                    dtype=np.uint8)
                     stacked[j] = np.concatenate([stacked[j], pad])
             group.clear()
@@ -878,11 +899,12 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
         counts: List[int] = []
 
         def emit() -> Dict:
+            b = _bucket_cap(max(counts), cap, geometry.block_n)
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts
             stacked = []
             for j in range(3):
-                arrs = [g[j] for g in group]
+                arrs = [g[j][:b] for g in group]
                 while len(arrs) < n_dev:
                     arrs.append(np.zeros_like(arrs[0]))
                 stacked.append(np.stack(arrs))
@@ -1029,14 +1051,15 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         counts: List[int] = []
 
         def dispatch():
-            seqs = np.stack([g[0] for g in group] + [
-                np.zeros((cap, geometry.seq_stride), np.uint8)
+            b = _bucket_cap(max(counts), cap, geometry.block_n)
+            seqs = np.stack([g[0][:b] for g in group] + [
+                np.zeros((b, geometry.seq_stride), np.uint8)
                 for _ in range(n_dev - len(group))])
-            quals = np.stack([g[1] for g in group] + [
-                np.zeros((cap, geometry.qual_stride), np.uint8)
+            quals = np.stack([g[1][:b] for g in group] + [
+                np.zeros((b, geometry.qual_stride), np.uint8)
                 for _ in range(n_dev - len(group))])
-            lens = np.stack([g[2] for g in group] + [
-                np.zeros((cap,), np.int32)
+            lens = np.stack([g[2][:b] for g in group] + [
+                np.zeros((b,), np.int32)
                 for _ in range(n_dev - len(group))])
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts

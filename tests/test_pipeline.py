@@ -329,3 +329,63 @@ def test_distributed_stats_two_process(bam, tmp_path):
         assert abs(g["mean_qual"] - whole_fq["mean_qual"]) < 1e-4
         assert g["base_hist"] == [int(v) for v in whole_fq["base_hist"]]
     assert whole["total"] == len(records)
+
+
+def test_bucketed_final_tile_matches_full_cap(tmp_path):
+    """The small-input dispatch ladder (_bucket_cap): a file far smaller
+    than tile_records dispatches a shrunk final tile, and every stats
+    answer is identical to the full-cap geometry's."""
+    import random as _random
+
+    from hadoop_bam_tpu.parallel.pipeline import (
+        PayloadGeometry, _bucket_cap, fastq_seq_stats_file,
+    )
+
+    # ladder arithmetic: block_n-aligned, monotone, capped
+    assert _bucket_cap(100, 1 << 16, 256) == 4096
+    assert _bucket_cap(5000, 1 << 16, 256) == 16384
+    assert _bucket_cap(40000, 1 << 16, 256) == 1 << 16
+    assert _bucket_cap(100, 1536, 256) == 256       # cap//16 rounded up
+    assert _bucket_cap(100, 256, 256) == 256        # no smaller bucket
+    for cap, bn in ((1 << 16, 256), (1536, 256), (32768, 8192)):
+        for c in (1, 200, cap // 4, cap):
+            b = _bucket_cap(c, cap, bn)
+            assert b % bn == 0 and c <= b <= cap
+
+    rng = _random.Random(21)
+    fq = str(tmp_path / "small.fastq")
+    with open(fq, "w") as f:
+        for i in range(700):
+            seq = "".join(rng.choice("ACGT") for _ in range(80))
+            qual = "".join(chr(33 + rng.randint(2, 40)) for _ in range(80))
+            f.write(f"@r{i}\n{seq}\n+\n{qual}\n")
+
+    big = PayloadGeometry(tile_records=4096, block_n=256)
+    small = PayloadGeometry(tile_records=256, block_n=256)
+    got = fastq_seq_stats_file(fq, geometry=big)        # shrink path
+    want = fastq_seq_stats_file(fq, geometry=small)     # full tiles only
+    assert got["n_reads"] == want["n_reads"] == 700
+    assert abs(got["mean_gc"] - want["mean_gc"]) < 1e-5
+    assert abs(got["mean_qual"] - want["mean_qual"]) < 1e-5
+    assert [int(v) for v in got["base_hist"]] == \
+        [int(v) for v in want["base_hist"]]
+
+
+def test_bucketed_tensor_batches_shapes(tmp_path):
+    """tensor_batches: full batches keep tile_records rows; the final
+    batch may shrink to a bucket, and totals are unchanged."""
+    import numpy as np
+
+    from hadoop_bam_tpu.api.read_datasets import open_fastq
+    from hadoop_bam_tpu.parallel.pipeline import PayloadGeometry
+
+    fq = str(tmp_path / "shapes.fastq")
+    with open(fq, "w") as f:
+        for i in range(600):
+            f.write(f"@r{i}\nACGTACGTAC\n+\nIIIIIIIIII\n")
+    geom = PayloadGeometry(tile_records=4096, block_n=256)
+    batches = list(open_fastq(fq).tensor_batches(geometry=geom))
+    total = sum(int(np.asarray(b["n_records"]).sum()) for b in batches)
+    assert total == 600
+    # the lone batch shrank to the smallest bucket that holds 600 rows
+    assert batches[-1]["qual"].shape[1] <= 1024
