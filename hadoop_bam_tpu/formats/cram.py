@@ -34,9 +34,9 @@ CRAM_MINOR = 0
 RAW, GZIP, BZIP2, LZMA, RANS4x8 = 0, 1, 2, 3, 4
 RANSNx16, ARITH, FQZCOMP, NAME_TOK = 5, 6, 7, 8
 
-# 3.1 methods still unimplemented (tok3: cram_name_tok3; fqzcomp:
-# cram_fqzcomp)
-_METHOD_31_NAMES = {ARITH: "adaptive arithmetic coder"}
+# every 3.1 block method decodes: 5 rANS Nx16 (cram_codecs_nx16),
+# 6 adaptive arithmetic (cram_arith), 7 fqzcomp (cram_fqzcomp),
+# 8 name tokenizer (cram_name_tok3)
 
 # Block content types [SPEC section 8.1]
 FILE_HEADER = 0
@@ -231,6 +231,11 @@ class Block:
                 )
                 method = RANSNx16
                 comp = rans_nx16_encode(raw, NX16_PACK | NX16_RLE)
+        elif method == ARITH:
+            from hadoop_bam_tpu.formats.cram_arith import (
+                ARITH_ORDER1, arith_encode,
+            )
+            comp = arith_encode(raw, ARITH_ORDER1)
         elif method == FQZCOMP:
             from hadoop_bam_tpu.formats.cram_fqzcomp import fqz_encode
             # no rANS fallback here: fqz_encode only raises when the
@@ -259,14 +264,24 @@ class Block:
                  data: Optional[bytes] = None) -> "Block":
         """Materialize from a parsed-but-compressed block; ``data``
         overrides decompression (the batched rANS path)."""
+        aux = None
         if data is None:
-            data = decompress_block_payload(raw.method, raw.payload,
-                                            raw.rsize)
+            if raw.method == FQZCOMP:
+                # capture the codec's own per-record lengths: the slice
+                # decoder cross-checks them against the RL series (the
+                # fqzcomp desync tripwire)
+                from hadoop_bam_tpu.formats.cram_fqzcomp import fqz_decode
+                aux = []
+                data = fqz_decode(raw.payload, raw.rsize, lens_out=aux)
+            else:
+                data = decompress_block_payload(raw.method, raw.payload,
+                                                raw.rsize)
         if len(data) != raw.rsize:
             raise CRAMError(
                 f"block inflated to {len(data)} bytes, expected "
                 f"{raw.rsize}")
-        return cls(raw.content_type, raw.content_id, data, raw.method)
+        return cls(raw.content_type, raw.content_id, data, raw.method,
+                   aux)
 
 
 @dataclass
@@ -322,12 +337,9 @@ def decompress_block_payload(method: int, payload: bytes, rsize: int) -> bytes:
     if method == FQZCOMP:
         from hadoop_bam_tpu.formats.cram_fqzcomp import fqz_decode
         return fqz_decode(payload, rsize)
-    if method in _METHOD_31_NAMES:
-        raise CRAMError(
-            f"CRAM 3.1 block method {method} "
-            f"({_METHOD_31_NAMES[method]}) is not supported yet — "
-            f"re-encode the file with rANS blocks (e.g. samtools view "
-            f"--output-fmt-option version=3.0)")
+    if method == ARITH:
+        from hadoop_bam_tpu.formats.cram_arith import arith_decode
+        return arith_decode(payload, rsize)
     raise CRAMError(f"unknown block compression method {method}")
 
 

@@ -1,0 +1,276 @@
+"""CRAM 3.1 adaptive arithmetic codec (block compression method 6).
+
+Rebuild of the "Adaptive arithmetic coding" codec from the CRAM 3.1
+compression-codecs spec (hts-specs CRAMcodecs; upstream analog
+htscodecs/arith_dynamic.c, reached from hb via htsjdk's CRAM 3.1 reader
+per SURVEY.md §2.3).  The frame shares the rANS Nx16 transform container
+— the same flag byte layout and PACK/RLE/STRIPE/CAT transforms — with
+two differences [SPEC]:
+
+* bit 0x04 means EXT (the payload is a bzip2 stream) instead of Nx16's
+  X32 interleave;
+* the entropy stage is the fqzcomp adaptive range coder + per-context
+  ``SimpleModel`` frequencies (cram_fqzcomp.py) instead of static-table
+  rANS: a ``max_sym`` byte (0 encodes 256), then order-0 (one model) or
+  order-1 (one model per previous symbol) symbol coding.
+
+Provenance, honestly labelled: the flag layout, EXT semantics and the
+order-0/order-1 adaptive model structure follow the public spec; the
+RLE run-model arrangement (runs through a 3-deep chain of 256-symbol
+models with 255-extension, literals through the normal models) and the
+PACK/STRIPE metadata bytes mirror this module's Nx16 sibling and are
+[SPEC-recalled] — pinned by same-module round-trips (no htslib in the
+image to cross-validate, SURVEY.md §0).  Decode is the supported
+direction; encode exists to exercise decode.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from hadoop_bam_tpu.formats.cram_codecs import normalize_truncation
+from hadoop_bam_tpu.formats.cram_codecs_nx16 import (
+    RansError, _pack_decode, _pack_encode, _packed_size, var_get_u32,
+    var_put_u32,
+)
+from hadoop_bam_tpu.formats.cram_fqzcomp import (
+    RangeDecoder, RangeEncoder, SimpleModel,
+)
+
+# flag bits [SPEC] — Nx16 layout with 0x04 repurposed as EXT
+ARITH_ORDER1 = 0x01
+ARITH_EXT = 0x04
+ARITH_STRIPE = 0x08
+ARITH_NOSZ = 0x10
+ARITH_CAT = 0x20
+ARITH_RLE = 0x40
+ARITH_PACK = 0x80
+
+_RUN_CTXS = 3        # run-length model chain depth [SPEC-recalled]
+
+
+class ArithError(RansError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# entropy stage
+# ---------------------------------------------------------------------------
+
+def _models(max_sym: int, order1: bool):
+    if order1:
+        return [SimpleModel(max_sym) for _ in range(max_sym)]
+    return [SimpleModel(max_sym)]
+
+
+def _decode_symbols(payload: bytes, pos: int, out_size: int,
+                    order1: bool) -> bytes:
+    max_sym = payload[pos]
+    pos += 1
+    if max_sym == 0:
+        max_sym = 256
+    models = _models(max_sym, order1)
+    rc = RangeDecoder(payload, pos)
+    out = bytearray(out_size)
+    prev = 0
+    for i in range(out_size):
+        sym = models[prev if order1 else 0].decode(rc)
+        out[i] = sym
+        prev = sym
+    return bytes(out)
+
+
+def _encode_symbols(data: bytes, order1: bool) -> bytes:
+    max_sym = (max(data) + 1) if data else 1
+    models = _models(max_sym, order1)
+    rc = RangeEncoder()
+    prev = 0
+    for b in data:
+        models[prev if order1 else 0].encode(rc, b)
+        prev = b
+    return bytes([max_sym & 0xFF]) + rc.finish()
+
+
+def _decode_rle(payload: bytes, pos: int, out_size: int,
+                order1: bool) -> bytes:
+    """Literals through the normal models, run lengths through a chain of
+    256-symbol models (255 extends the run) [SPEC-recalled]."""
+    max_sym = payload[pos]
+    pos += 1
+    if max_sym == 0:
+        max_sym = 256
+    lit_models = _models(max_sym, order1)
+    run_models = [SimpleModel(256) for _ in range(_RUN_CTXS)]
+    rc = RangeDecoder(payload, pos)
+    out = bytearray()
+    prev = 0
+    while len(out) < out_size:
+        sym = lit_models[prev if order1 else 0].decode(rc)
+        prev = sym
+        run = 0
+        ctx = 0
+        while True:
+            part = run_models[ctx].decode(rc)
+            run += part
+            if part != 255:
+                break
+            ctx = min(ctx + 1, _RUN_CTXS - 1)
+        out += bytes([sym]) * (run + 1)
+    if len(out) != out_size:
+        raise ArithError(
+            f"arith RLE expanded to {len(out)}, expected {out_size}")
+    return bytes(out)
+
+
+def _encode_rle(data: bytes, order1: bool) -> bytes:
+    max_sym = (max(data) + 1) if data else 1
+    lit_models = _models(max_sym, order1)
+    run_models = [SimpleModel(256) for _ in range(_RUN_CTXS)]
+    rc = RangeEncoder()
+    arr = np.frombuffer(data, np.uint8)
+    starts = np.concatenate([[0], np.nonzero(np.diff(arr))[0] + 1]) \
+        if arr.size else np.zeros(0, np.int64)
+    lens = np.diff(np.concatenate([starts, [arr.size]])) if arr.size \
+        else np.zeros(0, np.int64)
+    prev = 0
+    for s, ln in zip(arr[starts].tolist() if arr.size else [],
+                     lens.tolist()):
+        lit_models[prev if order1 else 0].encode(rc, s)
+        prev = s
+        run = ln - 1
+        ctx = 0
+        while True:
+            part = min(run, 255)
+            run_models[ctx].encode(rc, part)
+            run -= part
+            if part != 255:
+                break
+            ctx = min(ctx + 1, _RUN_CTXS - 1)
+    return bytes([max_sym & 0xFF]) + rc.finish()
+
+
+# ---------------------------------------------------------------------------
+# public stream API (frame layout mirrors rans_nx16_*)
+# ---------------------------------------------------------------------------
+
+def arith_encode(data: bytes, flags: int = 0) -> bytes:
+    """Encode with the requested flag set; PACK is dropped when it does
+    not apply, tiny payloads fall back to CAT, STRIPE recurses into
+    X=4 NOSZ sub-streams."""
+    n = len(data)
+
+    if flags & ARITH_STRIPE:
+        X = 4
+        out = bytearray([ARITH_STRIPE])
+        out += var_put_u32(n)
+        subs = [arith_encode(bytes(data[j::X]),
+                             (flags & ~ARITH_STRIPE) | ARITH_NOSZ)
+                for j in range(X)]
+        out.append(X)
+        for s in subs:
+            out += var_put_u32(len(s))
+        for s in subs:
+            out += s
+        return bytes(out)
+
+    payload = data
+    pack_meta = None
+    if flags & ARITH_PACK:
+        packed = _pack_encode(payload)
+        if packed is None:
+            flags &= ~ARITH_PACK
+        else:
+            pack_meta, payload = packed
+    if len(payload) < 16 and not flags & ARITH_EXT:
+        flags |= ARITH_CAT
+        flags &= ~(ARITH_ORDER1 | ARITH_RLE)
+
+    out = bytearray([flags])
+    if not (flags & ARITH_NOSZ):
+        out += var_put_u32(n)
+    if flags & ARITH_PACK:
+        out += pack_meta                     # nsym byte + symbol map
+    if flags & ARITH_CAT:
+        out += payload
+    elif flags & ARITH_EXT:
+        import bz2
+        out += bz2.compress(payload)
+    elif flags & ARITH_RLE:
+        out += _encode_rle(payload, bool(flags & ARITH_ORDER1))
+    else:
+        out += _encode_symbols(payload, bool(flags & ARITH_ORDER1))
+    return bytes(out)
+
+
+def arith_decode(payload: bytes, out_size: Optional[int] = None) -> bytes:
+    """Decode one adaptive-arithmetic stream.  ``out_size`` is required
+    when the stream carries the NOSZ flag (the CRAM block header
+    supplies it)."""
+    with normalize_truncation("arith"):
+        return _arith_decode(payload, out_size)
+
+
+def _arith_decode(payload: bytes, out_size: Optional[int] = None) -> bytes:
+    if not payload:
+        raise ArithError("empty arith stream")
+    pos = 0
+    flags = payload[pos]
+    pos += 1
+    if not (flags & ARITH_NOSZ):
+        out_size, pos = var_get_u32(payload, pos)
+    if out_size is None:
+        raise ArithError("NOSZ stream needs an external size")
+    if out_size == 0:
+        return b""
+
+    if flags & ARITH_STRIPE:
+        X = payload[pos]
+        pos += 1
+        clens = []
+        for _ in range(X):
+            c, pos = var_get_u32(payload, pos)
+            clens.append(c)
+        outs = []
+        for j in range(X):
+            sub_len = (out_size - j + X - 1) // X
+            outs.append(arith_decode(payload[pos:pos + clens[j]], sub_len))
+            pos += clens[j]
+        out = np.zeros(out_size, dtype=np.uint8)
+        for j in range(X):
+            out[j::X] = np.frombuffer(outs[j], dtype=np.uint8)
+        return out.tobytes()
+
+    pack_syms = None
+    if flags & ARITH_PACK:
+        nsym = payload[pos]
+        pos += 1
+        pack_syms = payload[pos:pos + nsym]
+        pos += nsym
+
+    stage_size = (_packed_size(out_size, len(pack_syms))
+                  if flags & ARITH_PACK else out_size)
+
+    if flags & ARITH_CAT:
+        stage = payload[pos:pos + stage_size]
+        if len(stage) != stage_size:
+            raise ArithError("truncated CAT payload")
+    elif flags & ARITH_EXT:
+        import bz2
+        try:
+            stage = bz2.decompress(payload[pos:])
+        except OSError as e:
+            raise ArithError(f"bad EXT (bzip2) payload: {e}")
+    elif flags & ARITH_RLE:
+        stage = _decode_rle(payload, pos, stage_size,
+                            bool(flags & ARITH_ORDER1))
+    else:
+        stage = _decode_symbols(payload, pos, stage_size,
+                                bool(flags & ARITH_ORDER1))
+
+    if flags & ARITH_PACK:
+        stage = _pack_decode(stage, pack_syms, out_size)
+    if len(stage) != out_size:
+        raise ArithError(
+            f"arith decoded {len(stage)} bytes, expected {out_size}")
+    return stage

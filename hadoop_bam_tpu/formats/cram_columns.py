@@ -241,7 +241,8 @@ def decode_slice_columns(comp: CompressionHeader, slice_hdr: SliceHeader,
                          core: bytes, external: Dict[int, bytes],
                          ref_names: List[str],
                          ref_source: Optional[ReferenceSource] = None,
-                         want_names: bool = False) -> Optional[dict]:
+                         want_names: bool = False,
+                         codec_rec_lens=None) -> Optional[dict]:
     """One slice as columns, or None when only the record path can decode it.
 
     Returns {n, bf, cf, ref_id, rl, pos, mapq, read_group, seq_cat,
@@ -253,13 +254,13 @@ def decode_slice_columns(comp: CompressionHeader, slice_hdr: SliceHeader,
     """
     try:
         return _decode_columns(comp, slice_hdr, core, external, ref_names,
-                               ref_source, want_names)
+                               ref_source, want_names, codec_rec_lens)
     except _Ineligible:
         return None
 
 
 def _decode_columns(comp, slice_hdr, core, external, ref_names, ref_source,
-                    want_names):
+                    want_names, codec_rec_lens=None):
     pre = _predecode_fixed(comp, slice_hdr, external)
     if pre is None:
         raise _Ineligible("fixed series not batch-decodable")
@@ -396,6 +397,14 @@ def _decode_columns(comp, slice_hdr, core, external, ref_names, ref_source,
     qs_total = int(qs_per_rec.sum())
     qs_stream = (bulk.stream("QS", qs_total) if qs_total
                  else np.zeros(0, np.uint8))
+
+    # fqzcomp desync tripwire — shared with the record path
+    if codec_rec_lens:
+        from hadoop_bam_tpu.formats.cram_decode import check_fqz_rec_lens
+        check_fqz_rec_lens(
+            comp, codec_rec_lens,
+            [int(v) for v in qs_per_rec[qs_per_rec > 0]],
+            qs_feat_bytes=int(qs_feat.sum()) if total_fn else 0)
 
     ba_feat = masks[0x42] | masks[0x69]              # 'B', 'i'
     ba_feat_per_rec = np.bincount(rec_of_feat[ba_feat], minlength=n)

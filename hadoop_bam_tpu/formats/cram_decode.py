@@ -111,6 +111,8 @@ class ByteCursor:
 class DecodeState:
     core: BitReader
     ext: Dict[int, ByteCursor]
+    qs_feat_bytes: int = 0     # QS bytes consumed by B/Q features (the
+                               # fqzcomp tripwire skips when nonzero)
 
     def cursor(self, cid: int) -> ByteCursor:
         try:
@@ -692,11 +694,55 @@ def _predecode_fixed(comp: CompressionHeader, slice_hdr: SliceHeader,
     return out
 
 
+def check_fqz_rec_lens(comp: CompressionHeader, codec_rec_lens,
+                       expected: List[int],
+                       qs_feat_bytes: int = 0) -> None:
+    """fqzcomp desync tripwire, shared by both decode paths: the codec's
+    own per-record lengths must match ``expected`` (each record's QS
+    consumption per the RL series, stored-qual records only, >0).  A
+    [SPEC-recalled] model constant mismatch desyncs the range coder into
+    silently wrong values — this cheap invariant catches most desyncs
+    loudly (ADVICE r4).  Skipped when B/Q feature bytes interleave into
+    QS, or when QS shares its external block with another series (both
+    make the per-record mapping ambiguous on a spec-valid file)."""
+    if not codec_rec_lens or qs_feat_bytes:
+        return
+    enc = comp.data_series.get("QS")
+    if not isinstance(enc, ExternalEncoding):
+        return
+    lens = codec_rec_lens.get(enc.content_id)
+    if lens is None:
+        return
+    users = 0
+    for e in list(comp.data_series.values()) \
+            + list(comp.tag_encodings.values()):
+        users += _encoding_cids(e).count(enc.content_id)
+    if users != 1:
+        return
+    codec = [l for l in lens if l > 0]
+    if codec != expected:
+        raise CRAMError(
+            "fqzcomp per-record quality lengths disagree with the "
+            f"slice's RL series ({len(codec)} codec records vs "
+            f"{len(expected)} stored-qual records) — desynced or "
+            "miscalibrated quality stream")
+
+
+def _check_codec_rec_lens(comp: CompressionHeader, codec_rec_lens,
+                          records: List["CramRecord"],
+                          st: DecodeState) -> None:
+    if not codec_rec_lens:
+        return
+    expected = [r.read_length for r in records
+                if r.cf & CF_QUAL_STORED and r.read_length > 0]
+    check_fqz_rec_lens(comp, codec_rec_lens, expected, st.qs_feat_bytes)
+
+
 def decode_slice_records(comp: CompressionHeader, slice_hdr: SliceHeader,
                          core: bytes, external: Dict[int, bytes],
                          ref_names: List[str],
-                         ref_source: Optional[ReferenceSource] = None
-                         ) -> List[CramRecord]:
+                         ref_source: Optional[ReferenceSource] = None,
+                         codec_rec_lens=None) -> List[CramRecord]:
     st = DecodeState(BitReader(core),
                      {cid: ByteCursor(d) for cid, d in external.items()})
     if slice_hdr.embedded_ref_id >= 0 and ref_source is None:
@@ -705,8 +751,10 @@ def decode_slice_records(comp: CompressionHeader, slice_hdr: SliceHeader,
 
     pre = _predecode_fixed(comp, slice_hdr, external)
     if pre is not None:
-        return _decode_slice_records_fast(comp, slice_hdr, st, pre,
-                                          ref_names, ref_source)
+        records = _decode_slice_records_fast(comp, slice_hdr, st, pre,
+                                             ref_names, ref_source)
+        _check_codec_rec_lens(comp, codec_rec_lens, records, st)
+        return records
 
     records: List[CramRecord] = []
     prev_pos = slice_hdr.start
@@ -754,6 +802,7 @@ def decode_slice_records(comp: CompressionHeader, slice_hdr: SliceHeader,
                 qs = comp.series("QS")
                 r.qual = qs.decode_bytes(st, r.read_length)
         records.append(r)
+    _check_codec_rec_lens(comp, codec_rec_lens, records, st)
     return records
 
 
@@ -772,7 +821,15 @@ def _decode_slice_records_fast(comp: CompressionHeader,
     mf, ns, np_, ts = (pre["MF"], pre["NS"], pre["NP"], pre["TS"])
     nf, mq, fn = pre["NF"], pre["MQ"], pre["FN"]
     names_inc = comp.read_names_included
+    # series("RN") (not .get) so a header lacking RN fails with the same
+    # CRAMError as the record-serial path, not an AttributeError on None
+    # (ADVICE r4); resolved lazily — a slice may legitimately never need
+    # names (names excluded, no detached records)
     rn = comp.data_series.get("RN")
+
+    def read_name() -> bytes:
+        return (rn if rn is not None else comp.series("RN")
+                ).decode_array(st)
     tag_dict, tag_encodings = comp.tag_dict, comp.tag_encodings
     fc_all, fp_all = pre.get("FC"), pre.get("FP")
     records: List[CramRecord] = []
@@ -786,11 +843,11 @@ def _decode_slice_records_fast(comp: CompressionHeader,
         r.pos = int(pos[i])
         r.read_group = int(rg[i])
         if names_inc:
-            r.name = rn.decode_array(st)
+            r.name = read_name()
         if r.cf & CF_DETACHED:
             r.mate_flags = int(mf[di])
             if not names_inc:
-                r.name = rn.decode_array(st)
+                r.name = read_name()
             r.mate_ref_id = int(ns[di])
             r.mate_pos = int(np_[di])
             r.template_size = int(ts[di])
@@ -866,10 +923,12 @@ def _decode_mapped(comp: CompressionHeader, st: DecodeState, r: CramRecord,
         elif code == "B":
             val = (comp.series("BA").decode_byte(st),
                    comp.series("QS").decode_byte(st))
+            st.qs_feat_bytes += 1
         elif code == "i":
             val = comp.series("BA").decode_byte(st)
         elif code == "Q":
             val = comp.series("QS").decode_byte(st)
+            st.qs_feat_bytes += 1
         else:
             raise CRAMError(f"unknown feature code {code!r}")
         features.append((fpos, code, val))

@@ -376,21 +376,28 @@ def _encode_length(models: _Models, rc: RangeEncoder, ln: int) -> None:
 # decode
 # ---------------------------------------------------------------------------
 
-def fqz_decode(buf: bytes, out_size: int) -> bytes:
+def fqz_decode(buf: bytes, out_size: int,
+               lens_out: Optional[list] = None) -> bytes:
     """Decode one fqzcomp quality stream into ``out_size`` bytes of
     concatenated per-record quality values (CRAM QS series).
 
     Returns raw quality values (no +33 offset), the series' own domain.
+    ``lens_out``, when given, receives the codec's own decoded
+    per-record lengths — the desync tripwire: the slice decoder compares
+    them against the RL series, because a [SPEC-recalled] constant
+    mismatch desyncs the range coder into silently wrong values with a
+    perfectly valid-looking stream (ADVICE r4).
     """
     try:
-        return _fqz_decode(buf, out_size)
+        return _fqz_decode(buf, out_size, lens_out)
     except (IndexError, struct.error) as e:
         # any out-of-range read/model index on a corrupt stream must
         # surface as the module's error type, not a bare IndexError
         raise FqzError(f"corrupt fqzcomp stream: {e}") from e
 
 
-def _fqz_decode(buf: bytes, out_size: int) -> bytes:
+def _fqz_decode(buf: bytes, out_size: int,
+                lens_out: Optional[list] = None) -> bytes:
     if len(buf) < 2:
         raise FqzError("fqzcomp stream too short")
     if buf[0] != FQZ_VERS:
@@ -437,6 +444,8 @@ def _fqz_decode(buf: bytes, out_size: int) -> bytes:
             last_len = _decode_length(models, rc)
         if last_len <= 0 or i + last_len > out_size:
             raise FqzError("fqzcomp: record length out of bounds")
+        if lens_out is not None:
+            lens_out.append(last_len)
         rec_start = i
         if gflags & GFLAG_DO_REV:
             if models.rev.decode(rc):

@@ -148,9 +148,11 @@ def read_cram_header(source) -> Tuple[SAMHeader, int]:
 
 
 def iter_container_slices(cont: Container):
-    """(comp, slice_hdr, core, external) for each slice of one data
-    container — the shared walk under both the record-object and the
-    columnar slice decoders."""
+    """(comp, slice_hdr, core, external, codec_rec_lens) for each slice
+    of one data container — the shared walk under both the record-object
+    and the columnar slice decoders.  ``codec_rec_lens`` maps content id
+    -> the block codec's own per-record lengths for codecs that model
+    record boundaries (fqzcomp), for the RL-series desync tripwire."""
     if cont.header.is_eof or not cont.blocks:
         return
     if cont.blocks[0].content_type != COMPRESSION_HEADER:
@@ -168,12 +170,15 @@ def iter_container_slices(cont: Container):
             raise CRAMError("slice block count overruns container")
         core = b""
         external: Dict[int, bytes] = {}
+        codec_rec_lens: Dict[int, list] = {}
         for b in body:
             if b.content_type == CORE_DATA:
                 core = b.data
             elif b.content_type == EXTERNAL_DATA:
                 external[b.content_id] = b.data
-        yield comp, slice_hdr, core, external
+                if b.aux:
+                    codec_rec_lens[b.content_id] = b.aux
+        yield comp, slice_hdr, core, external, codec_rec_lens
         i += 1 + slice_hdr.n_blocks
 
 
@@ -187,9 +192,11 @@ def decode_container_slices(cont: Container, header: SAMHeader,
     SamRecord materialization; decode_container builds on this for the
     full SAM view."""
     out: List[Tuple[int, List["CramRecord"]]] = []
-    for comp, slice_hdr, core, external in iter_container_slices(cont):
+    for comp, slice_hdr, core, external, codec_lens \
+            in iter_container_slices(cont):
         records = decode_slice_records(comp, slice_hdr, core, external,
-                                       header.ref_names, ref_source)
+                                       header.ref_names, ref_source,
+                                       codec_rec_lens=codec_lens)
         out.append((slice_hdr.record_counter, records))
     return out
 

@@ -43,10 +43,13 @@ class VariantGeometry:
 
     ``tile_records=None`` (the default) sizes the tile from the sample
     count: as many variants per step as keep the dosage tile within
-    ~8 MB, clamped to [4096, 65536].  Fewer, larger dispatches win on
+    ~8 MB, clamped to [64, 65536].  Fewer, larger dispatches win on
     high-latency links (~100 ms per step issue measured on the tunnel),
     but a fixed 64k tile would be gigabytes for cohort-scale VCFs —
     the device step materializes int32 casts of the whole dosage tile.
+    The floor is records-small on purpose: a 100k-sample cohort at the
+    old 4096-record floor was a ~1.6 GB int32 tile, the very blow-up
+    the byte budget exists to prevent (ADVICE r4).
     """
     tile_records: "Optional[int]" = None
     n_samples: int = 0             # from the header; padded to samples_pad
@@ -56,7 +59,7 @@ class VariantGeometry:
             budget = (8 << 20) // max(1, self.samples_pad)
             object.__setattr__(
                 self, "tile_records",
-                max(1 << 12, min(1 << 16, _round_up(budget, 8))))
+                max(64, min(1 << 16, _round_up(budget, 8))))
 
     @property
     def samples_pad(self) -> int:

@@ -129,14 +129,17 @@ def read_cram_span_columns(source, span: FileByteSpan, *,
 
     parts = []
     for cont in _iter_span_containers(source, span):
-        for comp, slice_hdr, core, external in iter_container_slices(cont):
+        for comp, slice_hdr, core, external, codec_lens \
+                in iter_container_slices(cont):
             cols = decode_slice_columns(comp, slice_hdr, core, external,
                                         header.ref_names, ref_source,
-                                        want_names=want_names)
+                                        want_names=want_names,
+                                        codec_rec_lens=codec_lens)
             if cols is None:
                 cols = records_to_columns(
                     decode_slice_records(comp, slice_hdr, core, external,
-                                         header.ref_names, ref_source),
+                                         header.ref_names, ref_source,
+                                         codec_rec_lens=codec_lens),
                     want_names=want_names)
             parts.append(cols)
     return concat_columns(parts)

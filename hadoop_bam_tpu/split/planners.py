@@ -194,12 +194,29 @@ def plan_spans_cached(path: str, header, config,
     (SURVEY.md section 3.1); repeated driver calls over an unchanged file
     should not re-run the split guessers, whose probe I/O and inflation
     are a measurable share of a whole-file stats pass on fast paths.
-    The key includes file size + mtime, so a rewritten file replans; the
-    config participates via its repr (intervals, guesser knobs)."""
+    The key includes file size + mtime of the BAM AND of every index
+    sidecar the planners may consult (.splitting-bai/.sbi/.bai/.csi —
+    a rebuilt sidecar must replan even when the BAM is unchanged,
+    ADVICE r4), plus a canonical serialization of the config (field
+    dict, not repr formatting)."""
+    import dataclasses
+
+    def _stat_sig(p):
+        try:
+            st = os.stat(p)
+            return (st.st_size, st.st_mtime_ns)
+        except OSError:
+            return None
     try:
         st = os.stat(path)
+        try:
+            cfg_sig = repr(sorted(dataclasses.asdict(config).items()))
+        except TypeError:
+            cfg_sig = repr(config)
         key = (os.path.abspath(path), st.st_size, st.st_mtime_ns,
-               num_spans, repr(config))
+               num_spans, cfg_sig,
+               tuple(_stat_sig(path + suf) for suf in
+                     (".splitting-bai", ".sbi", ".bai", ".csi")))
     except (OSError, TypeError):       # non-path sources: no caching
         return plan_spans_maybe_intervals(path, header, config,
                                           num_spans=num_spans)

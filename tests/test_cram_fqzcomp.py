@@ -300,9 +300,67 @@ def test_cram31_qual_knob_validates(monkeypatch):
             w.write_records(make_records(header, 5, seed=1))
 
 
-def test_arith_still_clear_error():
-    from hadoop_bam_tpu.formats.cram import (
-        ARITH, CRAMError, decompress_block_payload,
-    )
-    with pytest.raises(CRAMError, match="arith"):
+def test_arith_now_decodes_and_fails_loudly_on_garbage():
+    """Method 6 no longer raises 'not supported': valid streams decode
+    (tests/test_cram_arith.py) and garbage fails with the normalized
+    codec error instead of silently wrong bytes."""
+    from hadoop_bam_tpu.formats.cram import ARITH, decompress_block_payload
+    from hadoop_bam_tpu.formats.cram_arith import arith_encode
+    from hadoop_bam_tpu.formats.cram_codecs import RansError
+
+    assert decompress_block_payload(ARITH, arith_encode(b"hello"), 5) \
+        == b"hello"
+    with pytest.raises(RansError):
         decompress_block_payload(ARITH, b"\x00\x01", 4)
+
+
+def test_desync_tripwire_end_to_end(tmp_path, monkeypatch):
+    """fqzcomp blocks carry the codec's own per-record lengths up to the
+    slice decoder, which cross-checks them against the RL series: a
+    clean file reads silently; a mismatch raises CRAMError instead of
+    returning silently wrong qualities (ADVICE r4 medium)."""
+    import io
+
+    from hadoop_bam_tpu.formats.bam import SAMHeader
+    from hadoop_bam_tpu.formats.cram import CRAMError, read_container, FileDefinition
+    from hadoop_bam_tpu.formats.cramio import (
+        CramWriter, iter_container_slices, read_cram,
+    )
+    from hadoop_bam_tpu.formats.cram_columns import decode_slice_columns
+    from hadoop_bam_tpu.formats.cram_decode import decode_slice_records
+    from hadoop_bam_tpu.formats.sam import SamRecord
+
+    monkeypatch.setenv("HBAM_CRAM31_QUAL", "fqzcomp")
+    hdr = SAMHeader.from_sam_text(
+        "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:c1\tLN:100000\n")
+    recs = [SamRecord(qname=f"r{i}", flag=0, rname="c1", pos=1 + 3 * i,
+                      mapq=60, cigar="15M", rnext="*", pnext=0, tlen=0,
+                      seq="ACGTACGTACGTACG",
+                      qual="".join(chr(33 + (i + j) % 40)
+                                   for j in range(15)))
+            for i in range(120)]
+    sink = io.BytesIO()
+    with CramWriter(sink, hdr, version=(3, 1)) as w:
+        w.write_records(recs)
+    data = sink.getvalue()
+
+    # clean read: tripwire stays silent
+    _, got = read_cram(data)
+    assert [r.qual for r in got] == [r.qual for r in recs]
+
+    # a desynced codec (simulated: lengths disagreeing with RL) raises
+    # on BOTH decode paths; the first container is the header container
+    pos = FileDefinition.SIZE
+    cont, pos = read_container(data, pos)
+    cont, pos = read_container(data, pos)
+    slices = list(iter_container_slices(cont))
+    assert slices, "no data slices found"
+    comp, sh, core, ext, codec_lens = slices[0]
+    assert codec_lens, "fqzcomp block should carry rec lens"
+    bad = {cid: [l + 1 for l in lens] for cid, lens in codec_lens.items()}
+    with pytest.raises(CRAMError, match="desync"):
+        decode_slice_records(comp, sh, core, dict(ext), hdr.ref_names,
+                             None, codec_rec_lens=bad)
+    with pytest.raises(CRAMError, match="desync"):
+        decode_slice_columns(comp, sh, core, dict(ext), hdr.ref_names,
+                             None, codec_rec_lens=bad)

@@ -255,3 +255,17 @@ def test_text_tokenizer_vectorized_matches_scalar():
     got2 = pack_variant_tiles_from_text(text[:-1], header, geom)
     for k in want:
         assert (want[k] == got2[k]).all(), k
+
+
+def test_variant_geometry_byte_budget_large_cohorts():
+    """The auto tile sizing is byte-clamped, not record-floored: a
+    100k-sample cohort must stay near the ~8 MB dosage budget instead
+    of blowing up to a 4096-record (1.6 GB int32) tile (ADVICE r4)."""
+    from hadoop_bam_tpu.parallel.variant_pipeline import VariantGeometry
+
+    g = VariantGeometry(n_samples=100_000)
+    assert g.tile_records * g.samples_pad <= (16 << 20)   # ~2x budget max
+    assert g.tile_records >= 64
+    # small cohorts still get big tiles (dispatch amortization)
+    g_small = VariantGeometry(n_samples=3)
+    assert g_small.tile_records == 1 << 16
