@@ -321,6 +321,65 @@ def distributed_variant_stats(path: str, config=None, header=None):
             "sample_callrate": g[5:] / max(nv, 1)}
 
 
+def distributed_coverage(path: str, region, config=None, header=None,
+                         max_cigar: int = 64) -> np.ndarray:
+    """Multi-host coverage_file: each host piles up only its assigned
+    spans over the SAME window, and per-base depths sum exactly across
+    hosts (each record is decoded on exactly one host).
+
+    The combine allgathers one float64 row per host of ``window``
+    entries, so the per-call window is capped at 2^24 bases (128 MB/row)
+    — tile larger regions across calls exactly like the CLI does.
+    Single-process calls degrade to plain coverage_file."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.pipeline import coverage_file
+    from hadoop_bam_tpu.split.intervals import Interval, resolve_interval
+
+    config = DEFAULT_CONFIG if config is None else config
+    if header is None:
+        header, _ = read_bam_header(path)
+    if jax.process_count() == 1:
+        return coverage_file(path, region, config=config, header=header,
+                             max_cigar=max_cigar)
+    if not isinstance(region, Interval):
+        region = resolve_interval(region, header.ref_names)
+    if region.rname not in header.ref_names:
+        raise ValueError(f"region reference {region.rname!r} not in header")
+    ref_len = header.ref_lengths[header.ref_names.index(region.rname)]
+    end = min(region.end, ref_len)
+    window = end - region.start + 1
+    if window <= 0:
+        raise ValueError(f"empty region {region}")
+    if window > (1 << 24):
+        raise ValueError(f"distributed region spans {window} bases; the "
+                         "per-call cap is 2^24 — tile larger regions "
+                         "across calls")
+    region = Interval(region.rname, region.start, end)
+
+    def plan():
+        # the same plan coverage_file builds itself: .bai-trimmed chunks
+        # when a sidecar exists, whole-file pipeline-grain spans otherwise
+        from hadoop_bam_tpu.parallel.pipeline import pipeline_span_count
+        from hadoop_bam_tpu.split.bai import plan_interval_spans
+        from hadoop_bam_tpu.split.planners import plan_spans_cached
+
+        spans = plan_interval_spans(path, [region], header)
+        if spans is None:
+            n = pipeline_span_count(path, jax.device_count(), config)
+            spans = plan_spans_cached(path, header, config, num_spans=n)
+        return spans
+
+    def local(mine):
+        depth = coverage_file(path, region, mesh=_local_mesh(),
+                              config=config, header=header, spans=mine,
+                              max_cigar=max_cigar)
+        return np.asarray(depth, np.float64)
+
+    g = _multihost_reduce(plan, local, window).sum(axis=0)
+    return g.astype(np.int32)
+
+
 def retry_span(decode_fn, span: FileVirtualSpan, attempts: int = 3):
     """Span-level retry — the framework's failure-recovery unit."""
     last: Exception

@@ -223,7 +223,6 @@ def cmd_coverage(args) -> int:
     import numpy as np
 
     from hadoop_bam_tpu.formats.bamio import read_bam_header
-    from hadoop_bam_tpu.parallel.pipeline import coverage_file
     from hadoop_bam_tpu.split.intervals import Interval, resolve_interval
 
     header, _ = read_bam_header(args.input)
@@ -237,11 +236,10 @@ def cmd_coverage(args) -> int:
 
     # a bare contig name means the whole reference — tile it through
     # fixed-size windows so device memory stays bounded and the jit
-    # caches one window shape.  The mesh is built once; without a .bai
-    # sidecar every tile must stream the whole file, so say so.
-    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    # caches one window shape.  Without a .bai sidecar every tile must
+    # stream the whole file, so say so.
+    from hadoop_bam_tpu.parallel.distributed import distributed_coverage
     from hadoop_bam_tpu.split.bai import load_bai_for
-    mesh = make_mesh()
     n_tiles = (end - start) // _COVERAGE_TILE + 1
     if n_tiles > 1 and load_bai_for(args.input) is None:
         print(f"note: {n_tiles} tiles with no genomic index sidecar — "
@@ -257,10 +255,13 @@ def cmd_coverage(args) -> int:
             pending = None               # (start0, end0, depth) run buffer
             for lo in range(start, end + 1, _COVERAGE_TILE):
                 hi = min(lo + _COVERAGE_TILE - 1, end)
-                depth = coverage_file(args.input,
-                                      Interval(region.rname, lo, hi),
-                                      mesh=mesh, header=header,
-                                      max_cigar=args.max_cigar)
+                # plan-once/per-host-shares/one-allgather under
+                # jax.distributed; plain single-process coverage_file
+                # otherwise
+                depth = distributed_coverage(args.input,
+                                             Interval(region.rname, lo, hi),
+                                             header=header,
+                                             max_cigar=args.max_cigar)
                 total += depth.size
                 covered += int((depth > 0).sum())
                 depth_sum += int(depth.sum(dtype=np.int64))

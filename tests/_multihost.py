@@ -12,7 +12,7 @@ import sys
 
 
 def run_two_process(tmp_path, child_source: str, child_args,
-                    timeout: float = 240.0):
+                    timeout: float = 360.0):
     """Run ``child_source`` in two coordinated subprocesses.
 
     Each child gets argv ``(index, coordinator_port, *child_args)``.

@@ -247,10 +247,12 @@ jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(f"localhost:{port}", num_processes=2,
                            process_id=idx)
 from hadoop_bam_tpu.parallel.distributed import (
-    distributed_fastq_seq_stats, distributed_flagstat, distributed_seq_stats,
-    distributed_variant_stats,
+    distributed_coverage, distributed_fastq_seq_stats, distributed_flagstat,
+    distributed_seq_stats, distributed_variant_stats,
 )
 print("FLAGSTAT", json.dumps(distributed_flagstat(bam_src)), flush=True)
+cov = distributed_coverage(bam_src, "chr1:1-16384")
+print("COV", json.dumps([int(x) for x in cov]), flush=True)
 s = distributed_seq_stats(bam_src)
 s["base_hist"] = [int(v) for v in s["base_hist"]]
 print("SEQ", json.dumps(s), flush=True)
@@ -303,7 +305,11 @@ def test_distributed_stats_two_process(bam, tmp_path):
             f.write(f"@r{i}\n{seq}\n+\n{qual}\n")
     whole_fq = fastq_seq_stats_file(fq_path)
 
-    got = {"FLAGSTAT": [], "SEQ": [], "VAR": [], "FQ": []}
+    from hadoop_bam_tpu.parallel.pipeline import coverage_file
+    whole_cov = [int(x) for x in coverage_file(path, "chr1:1-16384",
+                                               header=header)]
+
+    got = {"FLAGSTAT": [], "SEQ": [], "VAR": [], "FQ": [], "COV": []}
     for rc, so, se in run_two_process(tmp_path, _DIST_STATS_CHILD,
                                       [path, vcf_path, fq_path]):
         assert rc == 0, f"child failed:\n{so}\n{se[-2000:]}"
@@ -312,6 +318,9 @@ def test_distributed_stats_two_process(bam, tmp_path):
                         if ln.startswith(key + " "))
             got[key].append(json.loads(line[len(key) + 1:]))
     assert got["FLAGSTAT"][0] == got["FLAGSTAT"][1] == whole
+    # per-base depths sum exactly across hosts: integer equality
+    assert got["COV"][0] == got["COV"][1] == whole_cov
+    assert sum(whole_cov) > 0
     for g in got["SEQ"]:
         assert g["n_reads"] == whole_seq["n_reads"]
         # f32 partial sums regroup across hosts: tolerance is f32-scale
