@@ -101,6 +101,11 @@ def assign_spans(spans: Sequence[FileVirtualSpan],
     """Contiguous per-host slice, balanced by compressed size."""
     index = jax.process_index() if index is None else index
     count = jax.process_count() if count is None else count
+    if not spans:
+        # a legitimately empty plan (e.g. a .bai-pruned region with no
+        # aligned reads) assigns nothing everywhere — cum[-1] below
+        # would IndexError on the empty array
+        return []
     if count == 1:
         return list(spans)
 

@@ -398,3 +398,12 @@ def test_bucketed_tensor_batches_shapes(tmp_path):
     assert total == 600
     # the lone batch shrank to the smallest bucket that holds 600 rows
     assert batches[-1]["qual"].shape[1] <= 1024
+
+
+def test_assign_spans_empty_plan():
+    """A .bai-pruned region with zero aligned reads yields an empty
+    plan; every host must receive an empty assignment (not IndexError)
+    so distributed coverage of read-free tiles returns zeros."""
+    assert assign_spans([], index=0, count=2) == []
+    assert assign_spans([], index=1, count=2) == []
+    assert assign_spans([], index=0, count=1) == []
