@@ -55,7 +55,7 @@ _HDR_TEXT = ("@HD\tVN:1.6\tSO:coordinate\n"
 #     exits 0 if the whole run would blow its deadline.
 # ---------------------------------------------------------------------------
 
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "45"))
 PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
 # r3 and r4 were both lost to the driver's *external* timeout (rc=124)
 # killing a run whose single JSON line only appeared at the very end.
@@ -138,14 +138,20 @@ def _watchdog() -> None:
         time.sleep(min(5.0, max(0.5, _remaining())))
 
 
-def _enable_compile_cache() -> None:
+def _enable_compile_cache(role: str = "main") -> None:
     """Persistent XLA compile cache under bench_data/: rounds after the
     first hit the cache instead of re-paying every jit/scan compile
-    (tens of seconds each on the tunneled chip) inside the budget."""
+    (tens of seconds each on the tunneled chip) inside the budget.
+
+    Separate cache dirs per process ROLE: the axon-plugin main process
+    and the pure-CPU scaling children compile CPU executables with
+    different target-feature sets, and loading the other role's AOT
+    entries makes XLA warn about possible SIGILL (observed on the r5
+    full-size run) — each role only ever reads entries it wrote."""
     import jax
 
     try:
-        cache_dir = os.path.join(BENCH_DIR, "jax_cache")
+        cache_dir = os.path.join(BENCH_DIR, "jax_cache", role)
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
@@ -238,15 +244,19 @@ def _run_component(fn, label: str, est_s: float = 30.0) -> None:
     _emit_progress()
 
 
-def _median_time(fn, reps: int = 3):
-    """Median wall time of fn() over reps runs (first result returned)."""
+def _median_time(fn, reps: int = 2):
+    """Lower-median wall time of fn() over reps runs (first result
+    returned): best-of for reps=2, true median for odd reps — never the
+    max, so one GC/IO hiccup can't define a row.  reps default dropped
+    3 -> 2 to fit the full matrix plus scaling inside the 420s budget
+    (the r5 full-size run skipped scaling + kernels at reps=3)."""
     out = fn()  # warmup (jit compile, file cache)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         out = fn()
         times.append(time.perf_counter() - t0)
-    return out, sorted(times)[len(times) // 2]
+    return out, sorted(times)[(len(times) - 1) // 2]
 
 
 # ---------------------------------------------------------------------------
@@ -404,8 +414,10 @@ def measured_pipeline(path: str) -> float:
         return flagstat_file(path, mesh=mesh, geometry=geometry,
                              header=header)
 
-    # median-of-5: tunneled TPU links are jittery
-    stats, dt = _median_time(run, reps=5)
+    # lower-median-of-3 for the HEADLINE (one extra rep vs the matrix
+    # default: the tunneled link is jittery and this is the row the
+    # round is judged on)
+    stats, dt = _median_time(run, reps=3)
     return stats["total"] / dt / n_dev
 
 
@@ -426,7 +438,7 @@ def bench_bgzf_inflate(path: str):
         data, _ = inflate_ops.inflate_span(raw_b, table)
         return data.size
 
-    isize, dt = _median_time(native_run, reps=3)
+    isize, dt = _median_time(native_run)
 
     # single-thread zlib baseline, one timed pass
     t0 = time.perf_counter()
@@ -459,13 +471,13 @@ def bench_cram(path: str):
             total += int(np.asarray(batch["n_records"]).sum())
         return total
 
-    n, dt = _median_time(run, reps=3)
+    n, dt = _median_time(run)
 
     def base_run():
         ds = open_cram(path)
         return sum(1 for _ in ds.records())
 
-    bn, bdt = _median_time(base_run, reps=3)
+    bn, bdt = _median_time(base_run)
     meas, base = n / dt, bn / bdt
     return {"metric": "cram_tensor_records_per_sec",
             "value": round(meas, 1), "unit": "records/s",
@@ -491,7 +503,7 @@ def bench_vcf(path: str):
     def run():
         return variant_stats_file(path)
 
-    stats, dt = _median_time(run, reps=3)
+    stats, dt = _median_time(run)
 
     def base_run():
         n = 0
@@ -502,7 +514,7 @@ def bench_vcf(path: str):
                     n += 1
         return n
 
-    bn, bdt = _median_time(base_run, reps=3)
+    bn, bdt = _median_time(base_run)
     meas, base = stats["n_variants"] / dt, bn / bdt
     return {"metric": "vcf_variants_per_sec",
             "value": round(meas, 1), "unit": "variants/s",
@@ -524,7 +536,7 @@ def bench_fastq(path: str):
     def run():
         return fastq_seq_stats_file(path)
 
-    stats, dt = _median_time(run, reps=3)
+    stats, dt = _median_time(run)
 
     from hadoop_bam_tpu.parallel.pipeline import PayloadGeometry
     geom = PayloadGeometry()
@@ -539,7 +551,7 @@ def bench_fastq(path: str):
             n += tiles[2].size
         return n
 
-    bn, bdt = _median_time(base_run, reps=3)
+    bn, bdt = _median_time(base_run)
     meas, base = stats["n_reads"] / dt, bn / bdt
     return {"metric": "fastq_reads_per_sec",
             "value": round(meas, 1), "unit": "reads/s",
@@ -564,7 +576,7 @@ def bench_split_guess(path: str):
     def run():
         return plan_bam_spans(path, num_spans=n_spans, header=header)
 
-    spans, dt = _median_time(run, reps=3)
+    spans, dt = _median_time(run)
     boundaries = max(len(spans) - 1, 1)  # first boundary is free (header)
     ms = dt / boundaries * 1e3
     out = {"metric": "split_guess_p50_ms_per_boundary",
@@ -623,12 +635,12 @@ def bench_sort(path: str):
         def run():
             return sort_bam_mesh(src, os.path.join(tmp, "mesh.bam"))
 
-        n, dt = _median_time(run, reps=3)
+        n, dt = _median_time(run)
 
         def base_run():
             return sort_bam(src, os.path.join(tmp, "single.bam"))
 
-        bn, bdt = _median_time(base_run, reps=3)
+        bn, bdt = _median_time(base_run)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     meas, base = n / dt, bn / bdt
@@ -673,8 +685,8 @@ def bench_bam_write(path: str):
         finally:
             nat._lib, nat._tried = saved
 
-    _, dt = _median_time(lambda: write_with(True), reps=3)
-    _, bdt = _median_time(lambda: write_with(False), reps=3)
+    _, dt = _median_time(lambda: write_with(True))
+    _, bdt = _median_time(lambda: write_with(False))
     meas = len(recs) / dt
     base = len(recs) / bdt
     return {"metric": "bam_write_records_per_sec",
@@ -695,7 +707,7 @@ def bench_coverage(path: str):
     def run():
         return coverage_file(path, region)
 
-    depth, dt = _median_time(run, reps=3)
+    depth, dt = _median_time(run)
 
     def base_run():
         # host oracle: same diff-scatter pileup, NumPy single-thread
@@ -732,7 +744,7 @@ def bench_coverage(path: str):
         np.cumsum(diff[:window])
         return total
 
-    n_records, bdt = _median_time(base_run, reps=3)
+    n_records, bdt = _median_time(base_run)
     meas = n_records / dt
     base = n_records / bdt
     return {"metric": "coverage_records_per_sec",
@@ -769,13 +781,13 @@ def bench_deflate_tokenize(path: str):
         return nat.deflate_tokenize_batch(
             src, table["cdata_off"], table["cdata_len"], stride, 1)
 
-    _, dt = _median_time(run, reps=3)
+    _, dt = _median_time(run)
 
     def base_run():
         return inflate_ops.inflate_span(raw_b, table, backend="native",
                                         n_threads=1)
 
-    _, bdt = _median_time(base_run, reps=3)
+    _, bdt = _median_time(base_run)
     return {"metric": "deflate_tokenize_gbps",
             "value": round(total / dt / 1e9, 3), "unit": "GB/s",
             "vs_baseline": round(bdt / dt, 3)}
@@ -999,7 +1011,7 @@ def _scaling_child(n_dev: int) -> None:
     jax.config.update("jax_platforms", "cpu")
     # persistent compile cache: the children re-trace the same programs
     # every round — cached, a child's cost is runs, not compiles
-    _enable_compile_cache()
+    _enable_compile_cache("child")
 
     from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.parallel.mesh import make_mesh

@@ -40,8 +40,17 @@ records_cap] u32 bucket matrices per device.  Bytes mode: two
 [n_dev, records_cap, stride] u8 row matrices per device (send + recv)
 — the shuffle's traffic, resident on device instead of host.  Host
 memory bound, index mode: the inflated input; bytes mode: only the
-process's own spans.  For inputs larger than either bound use
-utils/sort.py, whose spill-merge bound is independent of file size.
+process's own spans.
+
+``round_records`` engages the MULTI-ROUND spill exchange (the MR
+shuffle's spill-to-disk, _sort_bam_mesh_bytes_spill): the plan is cut
+into ~round_records-record spans, each round ships one span per device
+through the same all_to_all step, bucket-sorted rows spill to framed
+run files, and a final per-bucket k-way merge reconstructs the exact
+single-round order — device memory is then bounded by the ROUND tile,
+not the file.  The int32 global-index layout still caps the total at
+2^31-2 records (~a 150+ GB BAM); beyond that the sort fails over
+cleanly to utils/sort.py with a clear error.
 """
 from __future__ import annotations
 
@@ -275,6 +284,383 @@ def _make_bytes_sort_step(mesh, records_cap: int, stride: int):
         per_device, mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P("data"), P(), P()),
         out_specs=(P("data"), P("data"), P("data")), check_vma=False))
+
+
+def _frame_run(rows: np.ndarray, lens: np.ndarray, six: np.ndarray,
+               hi: np.ndarray, lo: np.ndarray) -> bytes:
+    """Serialize one bucket-round's sorted records as framed bytes:
+    per record <u32 hi><u32 lo><i32 gidx><i32 len><len payload bytes>.
+    The frame carries the full sort key so the cross-round merge never
+    re-derives anything from payload bytes."""
+    k = int(lens.size)
+    if not k:
+        return b""
+    hdr = np.empty((k, 16), np.uint8)
+    hdr[:, 0:4] = hi.astype("<u4")[:, None].view(np.uint8)
+    hdr[:, 4:8] = lo.astype("<u4")[:, None].view(np.uint8)
+    hdr[:, 8:12] = six.astype("<i4")[:, None].view(np.uint8)
+    hdr[:, 12:16] = lens.astype("<i4")[:, None].view(np.uint8)
+    lens64 = lens.astype(np.int64)
+    total = int(lens64.sum()) + 16 * k
+    out = np.empty(total, np.uint8)
+    # frame start offsets
+    starts = np.cumsum(lens64 + 16) - (lens64 + 16)
+    out[(starts[:, None] + np.arange(16)).ravel()] = hdr.ravel()
+    body = _ragged_positions(starts + 16, lens64)
+    src = _ragged_positions(np.zeros(k, np.int64) + np.arange(k)
+                            * rows.shape[1], lens64)
+    out[body] = rows.ravel()[src]
+    return out.tobytes()
+
+
+def _ragged_positions(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    total = int(lens.sum())
+    if not total:
+        return np.empty(0, np.int64)
+    firsts = np.cumsum(lens) - lens
+    flat = np.arange(total, dtype=np.int64) - np.repeat(firsts, lens)
+    return np.repeat(starts, lens) + flat
+
+
+def _iter_run_frames(path: str):
+    """Yield ((hi, lo, gidx), payload) frames of one spilled run file."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        hi = int.from_bytes(buf[pos:pos + 4], "little")
+        lo = int.from_bytes(buf[pos + 4:pos + 8], "little")
+        gidx = int.from_bytes(buf[pos + 8:pos + 12], "little", signed=True)
+        ln = int.from_bytes(buf[pos + 12:pos + 16], "little", signed=True)
+        pos += 16
+        yield (hi, lo, gidx), buf[pos:pos + ln]
+        pos += ln
+
+
+def _merge_bucket_runs(run_paths: List[str]) -> Tuple[bytes, int]:
+    """k-way merge of one bucket's per-round sorted runs by the framed
+    (hi, lo, gidx) key — the external-merge half of the MR shuffle."""
+    import heapq
+
+    chunks: List[bytes] = []
+    k = 0
+    for _key, payload in heapq.merge(
+            *(_iter_run_frames(p) for p in run_paths),
+            key=lambda kv: kv[0]):
+        chunks.append(payload)
+        k += 1
+    return b"".join(chunks), k
+
+
+def _sort_bam_mesh_bytes_spill(input_path: str, output_path: str, *, mesh,
+                               config: HBamConfig,
+                               header: Optional[SAMHeader],
+                               round_records: int) -> int:
+    """Multi-round byte exchange (VERDICT r4 #6): device memory bounded
+    by the ROUND tile, not the file.
+
+    The plan is cut so each span holds ~``round_records`` records; round
+    t ships spans [t*n_dev, (t+1)*n_dev) through the same all_to_all
+    bucket step as the single-round path, each host appends its devices'
+    bucket-sorted rows to per-(bucket, round) spill runs, and a final
+    per-bucket k-way merge of the framed runs (sorted by the full
+    (hi, lo, gidx) key) reconstructs exactly the single-round order —
+    byte-identical to sort_bam.
+
+    Bucket boundaries are sampled from ROUND 0's keys only (they affect
+    balance, never order); a key-skewed first round costs balance, not
+    correctness.  HBM per device: two [n_dev, R, stride] tiles with
+    R ≈ round_records; host per merge: one bucket's frames."""
+    import os
+    import shutil
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hadoop_bam_tpu.formats.bamio import BamWriter, read_bam_header
+    from hadoop_bam_tpu.parallel.distributed import broadcast_plan
+    from hadoop_bam_tpu.parallel.pipeline import _decode_span_core
+    from hadoop_bam_tpu.split.planners import plan_bam_spans_balanced
+    from hadoop_bam_tpu.utils.sort import _sorted_header
+
+    mesh_devs = list(mesh.devices.ravel())
+    n_dev = len(mesh_devs)
+    pid = jax.process_index()
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
+    if header is None:
+        header, _ = read_bam_header(input_path)
+
+    def plan():
+        from hadoop_bam_tpu.split.splitting_index import (
+            SplittingIndex, build_splitting_index,
+        )
+        index = SplittingIndex.load_for(input_path)
+        # a sidecar coarser than ~round_records/8 cannot cut spans small
+        # enough to honor the round memory bound (num_spans is capped at
+        # the sample count) — rebuild fine enough for ~8 samples/span
+        fine = max(1, round_records // 8)
+        if index is None or (index.granularity or 1) > fine:
+            index = build_splitting_index(input_path, granularity=fine)
+        # a sidecar index samples one voffset per GRANULARITY records:
+        # estimate records from total_records (when stored) or samples x
+        # granularity — len(voffsets) alone would undercount ~4096x on a
+        # standard .sbi and balloon the round tile past the memory bound
+        n_samples = max(1, len(index.voffsets) - 1)
+        if index.total_records > 0:
+            total_est = index.total_records
+        else:
+            total_est = n_samples * max(1, index.granularity)
+        want = -(-total_est // max(1, round_records))
+        want = _round_up(want, n_dev)          # whole rounds of n_dev
+        return plan_bam_spans_balanced(input_path, want, header=header,
+                                       index=index)
+
+    spans = broadcast_plan(plan() if pid == 0 else None)
+    n_rounds = max(1, -(-len(spans) // n_dev))
+    local_pos = [d for d, dev in enumerate(mesh_devs)
+                 if dev.process_index == pid]
+    local_set = set(local_pos)
+
+    shard_dir = output_path + ".mesh-spill"
+    if pid == 0:
+        shutil.rmtree(shard_dir, ignore_errors=True)
+    if n_proc > 1:
+        multihost_utils.process_allgather(np.zeros(1, np.int32))
+    os.makedirs(shard_dir, exist_ok=True)
+
+    sharding = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    step_cache = {}
+    bhi = blo = None
+    prefix_total = 0
+    run_files: dict = {}               # bucket -> [run paths]
+    err: Optional[BaseException] = None
+
+    def sharded(shape, dtype, of_d):
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding,
+            [jax.device_put(of_d(d), mesh_devs[d]) for d in local_pos],
+            dtype=dtype)
+
+    def replicated(arr, dtype):
+        return jax.make_array_from_single_device_arrays(
+            arr.shape, rep,
+            [jax.device_put(arr, mesh_devs[d]) for d in local_pos],
+            dtype=dtype)
+
+    for t in range(n_rounds):
+        # --- decode this round's local spans (streaming: only one
+        # round's rows are ever resident) ---
+        decoded = {}
+        counts_vec = np.zeros(n_dev, np.int64)
+        max_len = 0
+        his: List[np.ndarray] = []
+        los: List[np.ndarray] = []
+        try:
+            for d in local_pos:
+                s = t * n_dev + d
+                if s >= len(spans):
+                    continue
+                data, offs, _v, _ = _decode_span_core(
+                    input_path, spans[s], False, "auto", want_voffs=False)
+                lens_ = _record_lens(data, offs)
+                decoded[d] = (data, offs, lens_)
+                counts_vec[d] = offs.size
+                if offs.size:
+                    max_len = max(max_len, int(lens_.max()))
+                if t == 0:
+                    h, l = _keys_of(data, offs)
+                    his.append(h)
+                    los.append(l)
+        except Exception as e:  # noqa: BLE001 — must reach the collective
+            err = e
+
+        # --- agree on round geometry (and boundaries, round 0) ---
+        if n_proc > 1:
+            SAMPLE = 4096
+            hi_s = np.concatenate(his) if his else np.zeros(0, np.uint32)
+            lo_s = np.concatenate(los) if los else np.zeros(0, np.uint32)
+            if hi_s.size > SAMPLE:
+                st_ = -(-hi_s.size // SAMPLE)
+                hi_s, lo_s = hi_s[::st_], lo_s[::st_]
+            meta = np.zeros(n_dev + 3, np.int64)
+            meta[:n_dev] = counts_vec
+            meta[n_dev] = max_len
+            meta[n_dev + 1] = hi_s.size
+            meta[n_dev + 2] = 0 if err is None else 1
+            g_meta = np.asarray(multihost_utils.process_allgather(meta))
+            if err is not None:
+                raise err
+            if int(g_meta[:, n_dev + 2].max()) > 0:
+                raise RuntimeError("mesh spill sort: decode failed on "
+                                   "another host")
+            counts_vec = g_meta[:, :n_dev].sum(axis=0)
+            max_len = int(g_meta[:, n_dev].max())
+            if t == 0:
+                sample = np.full((SAMPLE, 2), 0xFFFFFFFF, np.uint32)
+                sample[:hi_s.size, 0] = hi_s
+                sample[:hi_s.size, 1] = lo_s
+                g_sample = np.asarray(
+                    multihost_utils.process_allgather(sample))
+                shis = [g_sample[p, :int(g_meta[p, n_dev + 1]), 0]
+                        .astype(np.uint32) for p in range(n_proc)]
+                slos = [g_sample[p, :int(g_meta[p, n_dev + 1]), 1]
+                        .astype(np.uint32) for p in range(n_proc)]
+                bhi, blo = _sample_bounds(shis, slos, n_dev)
+        else:
+            if err is not None:
+                raise err
+            if t == 0:
+                bhi, blo = _sample_bounds(his, los, n_dev)
+        if t == 0:
+            # boundaries are fixed after round 0: ship them once
+            bhi_g = replicated(bhi, jnp.uint32)
+            blo_g = replicated(blo, jnp.uint32)
+
+        round_total = int(counts_vec.sum())
+        if prefix_total + round_total > 2**31 - 2:
+            raise ValueError(
+                f"{prefix_total + round_total} records exceed the int32 "
+                f"global-index layout; use utils.sort.sort_bam")
+        base_vec = prefix_total + np.concatenate(
+            [[0], np.cumsum(counts_vec[:-1])])
+        prefix_total += round_total
+
+        records_cap = _round_up(max(int(counts_vec.max()), 1), 1024)
+        stride = 1 << max(6, int(max(max_len, 36) - 1).bit_length())
+        key = (records_cap, stride)
+        if key not in step_cache:
+            step_cache[key] = _make_bytes_sort_step(mesh, records_cap,
+                                                    stride)
+        step = step_cache[key]
+
+        _empty = (np.zeros(0, np.uint8), np.zeros(0, np.int64),
+                  np.zeros(0, np.int64))
+        packed = {}
+        for d in local_pos:
+            data, offs, lens_ = decoded.pop(d, _empty)
+            packed[d] = _pack_record_rows(data, offs, lens_, records_cap,
+                                          stride)
+        del decoded
+
+        rows_g = sharded((n_dev, records_cap, stride), jnp.uint8,
+                         lambda d: packed[d][0][None])
+        lens_g = sharded((n_dev, records_cap), jnp.int32,
+                         lambda d: packed[d][1][None])
+        count_g = sharded((n_dev,), jnp.int32,
+                          lambda d: np.asarray([counts_vec[d]], np.int32))
+        base_g = sharded((n_dev,), jnp.int32,
+                         lambda d: np.asarray([base_vec[d]], np.int32))
+        rows_s, lens_s, six_s = step(rows_g, lens_g, count_g, base_g,
+                                     bhi_g, blo_g)
+
+        # --- spill this round's local buckets as framed sorted runs ---
+        def buckets(garr):
+            return {sh.index[0].start: np.asarray(sh.data)[0]
+                    for sh in garr.addressable_shards}
+
+        b_rows, b_lens, b_six = (buckets(rows_s), buckets(lens_s),
+                                 buckets(six_s))
+        try:
+            for b in sorted(b_rows):
+                keep = b_six[b] != _I32_SENTINEL
+                if not bool(keep.any()):
+                    continue
+                rows_k = b_rows[b][keep]
+                lens_k = b_lens[b][keep]
+                six_k = b_six[b][keep]
+                # the ONE key-convention definition (_keys_of) — packed
+                # rows are fixed-stride records, so row starts are the
+                # offsets
+                hi_k, lo_k = _keys_of(
+                    np.ascontiguousarray(rows_k).ravel(),
+                    np.arange(rows_k.shape[0], dtype=np.int64)
+                    * rows_k.shape[1])
+                path = os.path.join(shard_dir, f"b{b:05d}-r{t:05d}.run")
+                with open(path, "wb") as f:
+                    f.write(_frame_run(rows_k, lens_k, six_k, hi_k, lo_k))
+                run_files.setdefault(b, []).append(path)
+        except Exception as e:  # noqa: BLE001 — flagged below
+            err = e
+        if n_proc > 1:
+            ok = np.asarray([0 if err is not None else 1], np.int32)
+            g_ok = np.asarray(multihost_utils.process_allgather(ok))
+            if err is not None:
+                raise err
+            if int(g_ok.min()) == 0:
+                raise RuntimeError("mesh spill sort: run write failed on "
+                                   "another host")
+        elif err is not None:
+            raise err
+
+    # --- final per-bucket merge ---
+    total = prefix_total
+    out_header = _sorted_header(header, by_name=False)
+    written = 0
+    merge_err: Optional[BaseException] = None
+    if n_proc == 1:
+        with BamWriter(output_path, out_header) as w:
+            for b in range(n_dev):
+                payload, k = _merge_bucket_runs(run_files.get(b, []))
+                w.write_raw(payload, n_records=k)
+                written += k
+        shutil.rmtree(shard_dir, ignore_errors=True)
+    else:
+        try:
+            for b in sorted(local_pos):
+                payload, k = _merge_bucket_runs(run_files.get(b, []))
+                part = os.path.join(shard_dir, f"part-{b:05d}")
+                with BamWriter(part, out_header, write_header=False,
+                               write_eof=False) as w:
+                    w.write_raw(payload, n_records=k)
+                written += k
+        except Exception as e:  # noqa: BLE001 — flagged below
+            merge_err = e
+        g_written = np.asarray(multihost_utils.process_allgather(
+            np.asarray([written if merge_err is None else -1], np.int64)))
+        if merge_err is not None:
+            raise merge_err
+        if (g_written < 0).any():
+            raise RuntimeError("mesh spill sort: bucket merge failed on "
+                               "another host; output is invalid")
+        written = int(g_written.sum())
+        if written != total:
+            raise RuntimeError(
+                f"mesh spill sort wrote {written} of {total} records — "
+                f"output is invalid")
+        from hadoop_bam_tpu.utils.mergers import merge_bam_shards_reblocked
+        final_err = None
+        if pid == 0:
+            try:
+                parts = [os.path.join(shard_dir, f"part-{b:05d}")
+                         for b in range(n_dev)]
+                missing = [p for p in parts if not os.path.exists(p)]
+                if missing:
+                    raise RuntimeError(
+                        f"mesh spill sort shard(s) missing at merge "
+                        f"time: {missing[:3]} — is {shard_dir} on a "
+                        f"filesystem shared by all hosts?")
+                merge_bam_shards_reblocked(parts, output_path, out_header)
+                shutil.rmtree(shard_dir, ignore_errors=True)
+            except Exception as e:  # noqa: BLE001 — must reach the barrier
+                final_err = e
+        ok = np.asarray([0 if final_err is not None else 1], np.int32)
+        g_ok = np.asarray(multihost_utils.process_allgather(ok))
+        if final_err is not None:
+            raise final_err
+        if int(g_ok.min()) == 0:
+            raise RuntimeError("mesh spill sort merge failed on host 0; "
+                               "output is invalid")
+        return total
+    if written != total:
+        raise RuntimeError(
+            f"mesh spill sort wrote {written} of {total} records — "
+            f"output is invalid")
+    return total
 
 
 def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
@@ -513,13 +899,22 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
 def sort_bam_mesh(input_path: str, output_path: str, *,
                   mesh=None, config: HBamConfig = DEFAULT_CONFIG,
                   header: Optional[SAMHeader] = None,
-                  exchange: Optional[str] = None) -> int:
+                  exchange: Optional[str] = None,
+                  round_records: Optional[int] = None) -> int:
     """Coordinate-sort a BAM over the mesh; byte-identical to
     utils/sort.py::sort_bam(by_name=False).  Returns the record count.
 
     ``exchange`` picks the shuffle flavor (module docstring): "index"
     (default single-host) or "bytes" (default — and required — when
     ``jax.process_count() > 1``).
+
+    ``round_records`` engages the multi-round spill exchange
+    (bytes-mode only): the shuffle streams ~that many records per
+    device per round through the all_to_all, appending bucket-sorted
+    runs to disk and k-way-merging per bucket at the end — device
+    memory is then bounded by the round tile, not the file (the MR
+    shuffle's spill, VERDICT r4 #6).  None keeps the single-round
+    resident exchange.
 
     Queryname sort keys are variable-length byte strings with no fixed-
     width device representation; use sort_bam for those.
@@ -533,14 +928,23 @@ def sort_bam_mesh(input_path: str, output_path: str, *,
     from hadoop_bam_tpu.split.planners import plan_bam_spans_balanced
     from hadoop_bam_tpu.utils.sort import _sorted_header
 
+    if round_records is not None and exchange is None:
+        exchange = "bytes"
     if exchange is None:
         exchange = "bytes" if jax.process_count() > 1 else "index"
     if exchange not in ("index", "bytes"):
         raise ValueError(f"unknown exchange mode {exchange!r}; "
                          f"expected 'index' or 'bytes'")
+    if round_records is not None and exchange != "bytes":
+        raise ValueError("round_records (the spill exchange) requires "
+                         "exchange='bytes'")
     if mesh is None:
         mesh = make_mesh()
     if exchange == "bytes":
+        if round_records is not None:
+            return _sort_bam_mesh_bytes_spill(
+                input_path, output_path, mesh=mesh, config=config,
+                header=header, round_records=int(round_records))
         return _sort_bam_mesh_bytes(input_path, output_path, mesh=mesh,
                                     config=config, header=header)
     if jax.process_count() > 1:

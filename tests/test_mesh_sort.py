@@ -127,6 +127,8 @@ assert jax.process_count() == 2 and len(jax.devices()) == 4
 from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
 n = sort_bam_mesh(src, out)      # multi-host default: exchange="bytes"
 print("SORTED", n, flush=True)
+n2 = sort_bam_mesh(src, out + ".spill", round_records=150)
+print("SPILLED", n2, flush=True)
 """
 
 
@@ -144,9 +146,13 @@ def test_mesh_sort_two_process_distributed(tmp_path):
                                       [path, out]):
         assert rc == 0, f"child failed:\n{so}\n{se[-2000:]}"
         assert "SORTED 1200" in so
+        assert "SPILLED 1200" in so
     ref = str(tmp_path / "ref.bam")
     sort_bam(path, ref)
     assert open(out, "rb").read() == open(ref, "rb").read()
+    # the multi-round spill exchange (1200 records through 150-record
+    # rounds = 2+ rounds of 4 devices) is byte-identical too
+    assert open(out + ".spill", "rb").read() == open(ref, "rb").read()
 
 
 def test_mesh_sort_cli(tmp_path):
@@ -162,3 +168,71 @@ def test_mesh_sort_cli(tmp_path):
     # --mesh with -n is a loud error, not a silent wrong sort
     with pytest.raises(SystemExit):
         main(["sort", "--mesh", "-n", path, str(tmp_path / "x.bam")])
+
+
+# ---------------------------------------------------------------------------
+# multi-round spill exchange (VERDICT r4 #6)
+# ---------------------------------------------------------------------------
+
+def _assert_spill_identical(tmp_path, path, round_records):
+    a = str(tmp_path / "single_sp.bam")
+    b = str(tmp_path / "mesh_sp.bam")
+    n1 = sort_bam(path, a)
+    n2 = sort_bam_mesh(path, b, round_records=round_records)
+    assert n1 == n2
+    assert open(a, "rb").read() == open(b, "rb").read()
+    return n1
+
+
+def test_spill_sort_byte_identical_many_rounds(tmp_path):
+    """round_records far below the file size forces several all_to_all
+    rounds + per-bucket run merges; output must still be byte-identical
+    to the single-process sort (file >> per-round capacity — the r4
+    verdict's acceptance case)."""
+    header = make_header()
+    recs = make_records(header, 4000, seed=77)
+    path = _write_shuffled(tmp_path, recs, header, seed=5)
+    # ~4000 records / 200 per span -> 20 spans -> 3 rounds on 8 devices
+    assert _assert_spill_identical(tmp_path, path, round_records=200) \
+        == 4000
+
+
+def test_spill_sort_single_round_degenerate(tmp_path):
+    """round_records >= the file: one round, still identical."""
+    header = make_header()
+    recs = make_records(header, 600, seed=9)
+    path = _write_shuffled(tmp_path, recs, header, seed=6)
+    assert _assert_spill_identical(tmp_path, path, round_records=10_000) \
+        == 600
+
+
+def test_spill_sort_skew_and_ties(tmp_path):
+    """All records on one key: every round dumps its whole tile into one
+    bucket, and the cross-round merge must still reproduce input order
+    (gidx ties) exactly."""
+    from hadoop_bam_tpu.formats.sam import SamRecord
+    header = make_header()
+    recs = [SamRecord(qname=f"r{i}", flag=0, rname=header.ref_names[0],
+                      pos=500, mapq=9, cigar="10M", rnext="*", pnext=0,
+                      tlen=0, seq="ACGTACGTAC", qual="IIIIIIIIII")
+            for i in range(900)]
+    path = _write_shuffled(tmp_path, recs, header, seed=11)
+    _assert_spill_identical(tmp_path, path, round_records=100)
+
+
+def test_spill_sort_unmapped_mix(tmp_path):
+    """Unmapped records (refid -1, in make_records' random flag mix)
+    sort last across rounds too."""
+    header = make_header()
+    recs = make_records(header, 1200, seed=13)
+    path = _write_shuffled(tmp_path, recs, header, seed=7)
+    _assert_spill_identical(tmp_path, path, round_records=150)
+
+
+def test_spill_requires_bytes_exchange(tmp_path):
+    header = make_header()
+    recs = make_records(header, 50, seed=1)
+    path = _write_shuffled(tmp_path, recs, header)
+    with pytest.raises(ValueError, match="bytes"):
+        sort_bam_mesh(path, str(tmp_path / "o.bam"), exchange="index",
+                      round_records=10)
