@@ -32,7 +32,19 @@ _GC_CODES = (2, 4, 6)
 
 
 def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
+    # any non-TPU target takes the non-Mosaic path (plain-XLA twin, or
+    # the Pallas interpreter under force_pallas)
+    return jax.default_backend() != "tpu"
+
+
+def _is_gc(c):
+    """GC membership as an explicit compare-or chain — shared by the
+    kernel and its plain-XLA twin so the code set cannot drift
+    (jnp.isin does not lower inside Pallas)."""
+    m = c == _GC_CODES[0]
+    for code in _GC_CODES[1:]:
+        m = m | (c == code)
+    return m
 
 
 def _seq_stats_kernel(seq_ref, qual_ref, len_ref,
@@ -50,16 +62,9 @@ def _seq_stats_kernel(seq_ref, qual_ref, len_ref,
     hi_valid = (2 * jidx) < ln
     lo_valid = (2 * jidx + 1) < ln
 
-    def is_gc(c):
-        # explicit compare-or chain (jnp.isin does not lower inside Pallas)
-        m = c == _GC_CODES[0]
-        for code in _GC_CODES[1:]:
-            m = m | (c == code)
-        return m
-
     denom = jnp.maximum(ln[:, 0], 1).astype(jnp.float32)
-    gc_hi = is_gc(hi) & hi_valid
-    gc_lo = is_gc(lo) & lo_valid
+    gc_hi = _is_gc(hi) & hi_valid
+    gc_lo = _is_gc(lo) & lo_valid
     gc = (gc_hi.sum(axis=1) + gc_lo.sum(axis=1)).astype(jnp.float32)
     gc_ref[:] = (gc / denom)[:, None]
 
@@ -84,10 +89,45 @@ def _seq_stats_kernel(seq_ref, qual_ref, len_ref,
     hist_ref[:] += hist
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _seq_stats_jnp(seq_tile: jnp.ndarray, qual_tile: jnp.ndarray,
+                   lengths: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Plain-XLA twin of _seq_stats_kernel — same math, no Pallas.
+
+    On non-TPU platforms the Pallas interpreter executes the kernel
+    block-by-block in Python (~2 s per 64k-read tile on one CPU core,
+    the dominant cost of the CPU FASTQ/seq-stats rows and the bench
+    scaling children); XLA:CPU compiles this version natively.  The TPU
+    path keeps the fused kernel (bases never materialize in HBM)."""
+    seq = seq_tile.astype(jnp.int32)
+    ln = lengths[:, None]
+    hi = seq >> 4
+    lo = seq & 0xF
+    jidx = jnp.arange(seq.shape[1], dtype=jnp.int32)[None, :]
+    hi_valid = (2 * jidx) < ln
+    lo_valid = (2 * jidx + 1) < ln
+
+    denom = jnp.maximum(lengths, 1).astype(jnp.float32)
+    gc = ((_is_gc(hi) & hi_valid).sum(axis=1)
+          + (_is_gc(lo) & lo_valid).sum(axis=1)).astype(jnp.float32)
+    qual = qual_tile.astype(jnp.int32).astype(jnp.float32)
+    qidx = jnp.arange(qual_tile.shape[1], dtype=jnp.int32)[None, :]
+    qmask = (qidx < ln).astype(jnp.float32)
+    mq = (qual * qmask).sum(axis=1) / denom
+    # scatter-add histogram: two passes over the tile instead of the
+    # kernel's 16 per-code masked sums (XLA:CPU doesn't fuse those away)
+    hist = (jnp.zeros(N_CODES, jnp.int32)
+            .at[hi.ravel()].add(hi_valid.ravel().astype(jnp.int32))
+            .at[lo.ravel()].add(lo_valid.ravel().astype(jnp.int32)))
+    return {"gc": gc / denom, "mean_qual": mq, "base_hist": hist}
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_n", "interpret",
+                                    "force_pallas"))
 def seq_qual_stats(seq_tile: jnp.ndarray, qual_tile: jnp.ndarray,
                    lengths: jnp.ndarray, block_n: int = 256,
-                   interpret: bool | None = None
+                   interpret: bool | None = None,
+                   force_pallas: bool = False
                    ) -> Dict[str, jnp.ndarray]:
     """Fused per-read stats over packed payload tiles.
 
@@ -96,16 +136,21 @@ def seq_qual_stats(seq_tile: jnp.ndarray, qual_tile: jnp.ndarray,
     N must be a multiple of block_n.  Returns {"gc": [N] f32,
     "mean_qual": [N] f32, "base_hist": [16] i32}.
 
-    ``interpret``: run the kernel in interpreter mode (required on CPU
-    devices).  None = infer from the default backend — pass it explicitly
-    when placing the computation on devices that are not the default
-    backend (e.g. a virtual CPU mesh under a TPU-default process).
+    ``interpret``: the computation targets a non-TPU device.  None =
+    infer from the default backend — pass it explicitly when placing
+    the computation on devices that are not the default backend (e.g. a
+    virtual CPU mesh under a TPU-default process).  Non-TPU targets use
+    the plain-XLA twin (_seq_stats_jnp) instead of the Pallas
+    interpreter; ``force_pallas`` keeps the kernel itself testable on
+    CPU via the interpreter.
     """
     n = seq_tile.shape[0]
     assert n % block_n == 0, (n, block_n)
     grid = n // block_n
     if interpret is None:
         interpret = _interpret()
+    if interpret and not force_pallas:
+        return _seq_stats_jnp(seq_tile, qual_tile, lengths)
     gc, mq, hist = pl.pallas_call(
         _seq_stats_kernel,
         grid=(grid,),

@@ -75,7 +75,8 @@ def test_payload_pack_content(bam):
         assert got_q == recs[i].qual[:n]
 
 
-def test_kernel_matches_host_oracle(bam):
+@pytest.mark.parametrize("force_pallas", [False, True])
+def test_kernel_matches_host_oracle(bam, force_pallas):
     path, header, recs = bam
     spans = plan_bam_spans(path, num_spans=1, header=header)
     prefix, seq, qual, _ = decode_span_payload_host(path, spans[0], GEOM)
@@ -86,7 +87,8 @@ def test_kernel_matches_host_oracle(bam):
     l_seq = prefix[:, 20:24].copy().view("<i4")[:, 0]
     lens = np.concatenate([np.minimum(l_seq, GEOM.max_len).astype(np.int32),
                            np.zeros(pad, np.int32)])
-    out = seq_qual_stats(seq, qual, lens, block_n=GEOM.block_n)
+    out = seq_qual_stats(seq, qual, lens, block_n=GEOM.block_n,
+                         force_pallas=force_pallas)
     ref = seq_qual_stats_host(seq, qual, lens)
     np.testing.assert_allclose(np.asarray(out["gc"]), ref["gc"], rtol=1e-5)
     np.testing.assert_allclose(np.asarray(out["mean_qual"]),
@@ -95,7 +97,8 @@ def test_kernel_matches_host_oracle(bam):
                                ref["base_hist"])
 
 
-def test_base_hist_exact_past_2_24():
+@pytest.mark.parametrize("force_pallas", [False, True])
+def test_base_hist_exact_past_2_24(force_pallas):
     """Histogram counts stay exact past 2^24 total bases — the f32
     accumulator this replaced loses integer precision there (and cannot
     represent the odd total at all)."""
@@ -105,7 +108,8 @@ def test_base_hist_exact_past_2_24():
     qual = np.full((n, L), 40, np.uint8)
     lengths = np.full(n, L, np.int32)
     lengths[0] = L - 1                                  # odd total
-    out = seq_qual_stats(seq, qual, lengths, block_n=block_n)
+    out = seq_qual_stats(seq, qual, lengths, block_n=block_n,
+                         force_pallas=force_pallas)
     hist = np.asarray(out["base_hist"])
     assert hist.dtype.kind == "i"
     total = int(lengths.astype(np.int64).sum())
@@ -245,3 +249,29 @@ def test_qseq_stats_driver(tmp_path):
            / len(f.quality[:GEOM.max_len]) for f in frags]
     assert abs(stats["mean_gc"] - float(np.mean(gcs))) < 1e-6
     assert abs(stats["mean_qual"] - float(np.mean(mqs))) < 1e-4
+
+
+def test_jnp_fallback_matches_pallas_interpreter_and_host():
+    """The plain-XLA twin (non-TPU fast path) must agree with BOTH the
+    Pallas kernel (run via the interpreter, force_pallas=True) and the
+    NumPy host oracle."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    N, L = 512, 151
+    seq = rng.integers(0, 256, (N, (L + 1) // 2), dtype=np.uint8)
+    qual = rng.integers(0, 42, (N, L), dtype=np.uint8)
+    lens = rng.integers(0, L + 1, N).astype(np.int32)
+    a = seq_qual_stats(jnp.asarray(seq), jnp.asarray(qual),
+                       jnp.asarray(lens), interpret=True)
+    b = seq_qual_stats(jnp.asarray(seq), jnp.asarray(qual),
+                       jnp.asarray(lens), interpret=True,
+                       force_pallas=True)
+    h = seq_qual_stats_host(seq, qual, lens)
+    for got in (a, b):
+        np.testing.assert_allclose(np.asarray(got["gc"]),
+                                   np.asarray(h["gc"]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got["mean_qual"]),
+                                   np.asarray(h["mean_qual"]), atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(got["base_hist"]),
+                                      np.asarray(h["base_hist"]))
