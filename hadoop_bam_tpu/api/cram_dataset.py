@@ -57,7 +57,9 @@ class CramDataset:
             yield from recs
 
     def tensor_batches(self, mesh=None, geometry=None,
-                       num_spans: Optional[int] = None) -> Iterator[Dict]:
+                       num_spans: Optional[int] = None,
+                       spans: Optional[List[FileByteSpan]] = None
+                       ) -> Iterator[Dict]:
         """Device-resident read batches (same layout as
         FastqDataset.tensor_batches) decoded from CRAM containers.
 
@@ -88,8 +90,8 @@ class CramDataset:
                 geom.max_len, qual_offset=0)
 
         yield from stream_read_tensor_batches(
-            self.spans(num_spans), None, self.config, mesh, geometry,
-            tiles_fn=tiles)
+            self.spans(num_spans) if spans is None else spans, None,
+            self.config, mesh, geometry, tiles_fn=tiles)
 
     def flagstat(self, mesh=None) -> Dict[str, int]:
         """Host-side flagstat over decoded CRAM records (same counters as

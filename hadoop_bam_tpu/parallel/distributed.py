@@ -288,6 +288,33 @@ def distributed_fastq_seq_stats(path: str, config=None, geometry=None):
         _multihost_reduce(plan, local, 3 + N_CODES))
 
 
+def distributed_cram_seq_stats(path: str, config=None, geometry=None):
+    """Multi-host cram_seq_stats_file: same weighted combine as the
+    other seq-stats drivers, over container-aligned byte-span plans."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.ops.seq_pallas import N_CODES
+    from hadoop_bam_tpu.parallel.pipeline import (
+        cram_seq_stats_file, pipeline_span_count,
+    )
+
+    config = DEFAULT_CONFIG if config is None else config
+    if jax.process_count() == 1:
+        return cram_seq_stats_file(path, config=config, geometry=geometry)
+
+    def plan():   # runs on host 0 only
+        from hadoop_bam_tpu.api.cram_dataset import open_cram
+        n = pipeline_span_count(path, jax.device_count(), config)
+        return open_cram(path, config).spans(num_spans=n)
+
+    def local(mine):
+        return _pack_seq_stats(cram_seq_stats_file(
+            path, mesh=_local_mesh(), config=config, geometry=geometry,
+            spans=mine))
+
+    return _combine_seq_stats(
+        _multihost_reduce(plan, local, 3 + N_CODES))
+
+
 def distributed_variant_stats(path: str, config=None, header=None):
     """Multi-host variant_stats_file: counts sum; mean_af combines
     weighted by n_af; per-sample call rates by n_variants."""

@@ -985,6 +985,37 @@ def pipeline_span_count(path, n_dev: int,
 FASTQ_EXTS = (".fastq", ".fq", ".fastq.gz", ".fq.gz")
 QSEQ_EXTS = (".qseq", ".qseq.gz")
 TEXT_READ_EXTS = FASTQ_EXTS + QSEQ_EXTS
+CRAM_EXTS = (".cram",)
+
+
+def cram_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
+                        config: HBamConfig = DEFAULT_CONFIG,
+                        geometry: Optional[PayloadGeometry] = None,
+                        spans=None) -> Dict[str, object]:
+    """GC / quality / base stats over a CRAM — the CRAM member of the
+    seq-stats driver family, fed by the columnar slice decoder
+    (CramDataset.tensor_batches) through the same fused stats step as
+    the BAM/FASTQ drivers."""
+    from hadoop_bam_tpu.api.cram_dataset import open_cram
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    if geometry is None:
+        geometry = PayloadGeometry()
+    ds = open_cram(path, config)
+    if spans is None:
+        # pipeline-grain spans so container decode overlaps dispatch
+        # (the 128 MiB job grain would serialize them)
+        n_dev = int(np.prod(mesh.devices.shape))
+        spans = ds.spans(num_spans=pipeline_span_count(path, n_dev,
+                                                       config))
+    step = make_read_stats_step(mesh, geometry)
+    totals = _StatTotals()
+    for b in ds.tensor_batches(mesh=mesh, geometry=geometry, spans=spans):
+        totals.add(*step(b["seq_packed"], b["qual"], b["lengths"],
+                         b["n_records"]))
+    return _payload_stats_result(totals)
 
 
 def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,

@@ -77,14 +77,21 @@ def plan_cram_spans(path: str, *, num_spans: Optional[int] = None,
 
 def _iter_span_containers(source, span: FileByteSpan):
     """Containers whose start lies in [span.start, span.end) — the shared
-    walk behind both the SAM and the pre-SAM span readers."""
+    walk behind both the SAM and the pre-SAM span readers.
+
+    Spans are container-aligned (plan_cram_spans ends every span exactly
+    on a container boundary), so only the span's own byte range is read
+    — a whole-file read per span would make total I/O quadratic in file
+    size once a file is planned into many pipeline-grain spans."""
     if isinstance(source, (bytes, bytearray)):
-        buf = bytes(source)
+        buf = bytes(source)[span.start:span.end]
     else:
         with open(source, "rb") as f:
-            buf = f.read()
-    pos = span.start
-    while pos < min(span.end, len(buf)):
+            f.seek(span.start)
+            buf = f.read(max(0, span.end - span.start))
+    pos = 0
+    n = len(buf)
+    while pos < n:
         cont, pos = read_container(buf, pos)
         if cont.header.is_eof:
             break

@@ -309,14 +309,25 @@ def cmd_coverage(args) -> int:
 
 def cmd_seq_stats(args) -> int:
     from hadoop_bam_tpu.parallel.distributed import (
-        distributed_fastq_seq_stats, distributed_seq_stats,
+        distributed_cram_seq_stats, distributed_fastq_seq_stats,
+        distributed_seq_stats,
     )
     from hadoop_bam_tpu.parallel.pipeline import (
-        TEXT_READ_EXTS, PayloadGeometry,
+        CRAM_EXTS, TEXT_READ_EXTS, PayloadGeometry,
     )
     geometry = PayloadGeometry(max_len=args.max_len)
     if args.path.lower().endswith(TEXT_READ_EXTS):
         stats = distributed_fastq_seq_stats(args.path, geometry=geometry)
+    elif args.path.lower().endswith(CRAM_EXTS):
+        import dataclasses
+
+        from hadoop_bam_tpu.config import DEFAULT_CONFIG
+        cfg = DEFAULT_CONFIG
+        if getattr(args, "reference", None):
+            cfg = dataclasses.replace(
+                cfg, cram_reference_source_path=args.reference)
+        stats = distributed_cram_seq_stats(args.path, config=cfg,
+                                           geometry=geometry)
     else:
         stats = distributed_seq_stats(args.path, geometry=geometry)
     print(f"reads\t{stats['n_reads']}")
@@ -463,6 +474,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "payload kernel")
     sq.add_argument("path")
     sq.add_argument("--max-len", type=int, default=160)
+    sq.add_argument("--reference",
+                    help="FASTA reference for reference-compressed CRAM "
+                         "(the hadoopbam.cram.reference-source-path "
+                         "analog)")
     sq.set_defaults(fn=cmd_seq_stats, uses_device=True)
 
     vst = sub.add_parser("vcf-stats",

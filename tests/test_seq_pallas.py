@@ -275,3 +275,58 @@ def test_jnp_fallback_matches_pallas_interpreter_and_host():
                                    np.asarray(h["mean_qual"]), atol=1e-4)
         np.testing.assert_array_equal(np.asarray(got["base_hist"]),
                                       np.asarray(h["base_hist"]))
+
+
+def test_cram_seq_stats_driver(tmp_path):
+    """CRAM member of the seq-stats family: driver answers must match
+    the host oracle computed from the decoded records."""
+    from hadoop_bam_tpu.formats.bam import SAMHeader
+    from hadoop_bam_tpu.formats.cramio import write_cram
+    from hadoop_bam_tpu.formats.sam import SamRecord as SR
+    from hadoop_bam_tpu.parallel.pipeline import cram_seq_stats_file
+
+    rng = random.Random(19)
+    hdr = SAMHeader.from_sam_text(
+        "@HD\tVN:1.6\n@SQ\tSN:c1\tLN:100000\n")
+    recs = []
+    pos = 1
+    for i in range(700):
+        l = rng.randint(20, 100)
+        seq = "".join(rng.choice("ACGT") for _ in range(l))
+        qual = "".join(chr(33 + rng.randint(2, 40)) for _ in range(l))
+        pos += rng.randint(1, 9)
+        recs.append(SR(qname=f"r{i}", flag=0, rname="c1", pos=pos,
+                       mapq=60, cigar=f"{l}M", rnext="*", pnext=0,
+                       tlen=0, seq=seq, qual=qual))
+    path = str(tmp_path / "s.cram")
+    with open(path, "wb") as f:
+        write_cram(f, hdr, recs)
+
+    stats = cram_seq_stats_file(path)
+    assert stats["n_reads"] == 700
+    gc_ref = np.mean([sum(c in "GC" for c in r.seq) / len(r.seq)
+                      for r in recs])
+    mq_ref = np.mean([np.mean([ord(c) - 33 for c in r.qual])
+                      for r in recs])
+    assert abs(stats["mean_gc"] - gc_ref) < 1e-3
+    assert abs(stats["mean_qual"] - mq_ref) < 1e-2
+    total_bases = sum(len(r.seq) for r in recs)
+    assert int(np.asarray(stats["base_hist"]).sum()) == total_bases
+
+
+def test_cli_seq_stats_cram(tmp_path, capsys):
+    from hadoop_bam_tpu.formats.bam import SAMHeader
+    from hadoop_bam_tpu.formats.cramio import write_cram
+    from hadoop_bam_tpu.formats.sam import SamRecord as SR
+    from hadoop_bam_tpu.tools.cli import main
+
+    hdr = SAMHeader.from_sam_text("@HD\tVN:1.6\n@SQ\tSN:c1\tLN:9999\n")
+    recs = [SR(qname=f"r{i}", flag=0, rname="c1", pos=1 + i, mapq=60,
+               cigar="10M", rnext="*", pnext=0, tlen=0,
+               seq="ACGTACGTAC", qual="IIIIIIIIII") for i in range(200)]
+    path = str(tmp_path / "cli.cram")
+    with open(path, "wb") as f:
+        write_cram(f, hdr, recs)
+    assert main(["seq-stats", path]) == 0
+    out = capsys.readouterr().out
+    assert "reads\t200" in out
