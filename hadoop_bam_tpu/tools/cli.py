@@ -85,6 +85,14 @@ def _view_sam(args, fmt) -> int:
             if args.count:
                 print(n)
             return 0
+    from hadoop_bam_tpu.api.cram_dataset import CramDataset
+    if isinstance(ds, CramDataset) and args.count and not region:
+        # container headers carry record counts: whole-file -c needs a
+        # header scan, zero block decompression (samtools-style fast
+        # count)
+        from hadoop_bam_tpu.split.cram_planner import scan_cram_containers
+        print(sum(nr for _off, _size, nr in scan_cram_containers(args.path)))
+        return 0
     if isinstance(ds, BamDataset):
         for batch in ds.batches():
             import numpy as np
@@ -362,26 +370,26 @@ def cmd_vcf_stats(args) -> int:
 # ---------------------------------------------------------------------------
 
 def cmd_sort(args) -> int:
+    if args.run_records is not None and args.run_records <= 0:
+        raise SystemExit("--run-records must be positive")
     if args.mesh:
         if args.by_name:
             raise SystemExit(
                 "--mesh supports coordinate sort only (queryname keys "
                 "have no fixed-width device representation); drop -n")
-        if args.run_records is not None:
-            raise SystemExit(
-                "--run-records is the spill-merge memory bound; the mesh "
-                "sort holds the inflated input in host memory instead — "
-                "drop --run-records or drop --mesh")
         from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
-        n = sort_bam_mesh(args.input, args.output, exchange=args.exchange)
-        print(f"wrote {args.output} ({n} records, coordinate, mesh)")
+        # --run-records under --mesh selects the multi-round SPILL
+        # exchange: device memory bounded by ~that many records per
+        # device per round (the MR shuffle's spill)
+        n = sort_bam_mesh(args.input, args.output, exchange=args.exchange,
+                          round_records=args.run_records)
+        mode = "mesh spill" if args.run_records is not None else "mesh"
+        print(f"wrote {args.output} ({n} records, coordinate, {mode})")
         return 0
     if args.exchange is not None:
         raise SystemExit("--exchange only applies to --mesh")
     from hadoop_bam_tpu.utils.sort import sort_bam
 
-    if args.run_records is not None and args.run_records <= 0:
-        raise SystemExit("--run-records must be positive")
     n = sort_bam(args.input, args.output, by_name=args.by_name,
                  run_records=args.run_records
                  if args.run_records is not None else 1_000_000)
@@ -491,12 +499,15 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("output")
     so.add_argument("-n", "--by-name", action="store_true")
     so.add_argument("--run-records", type=int, default=None,
-                    help="records per in-memory sort run (memory bound; "
-                         "default 1000000, spill-merge mode only)")
+                    help="memory bound in records: per in-memory sort run "
+                         "(spill-merge mode, default 1000000), or per "
+                         "device per exchange round (--mesh: engages the "
+                         "multi-round spill shuffle)")
     so.add_argument("--mesh", action="store_true",
                     help="bucketed sort over the device mesh (device key "
                          "extraction + all_to_all exchange; coordinate "
-                         "order only, input must fit host memory)")
+                         "order only; without --run-records the input "
+                         "must fit host/device memory)")
     so.add_argument("--exchange", choices=("index", "bytes"), default=None,
                     help="mesh shuffle flavor: 'index' (keys only ride the "
                          "all_to_all; single-host) or 'bytes' (record bytes "

@@ -347,3 +347,47 @@ def test_coverage_failure_leaves_no_bedgraph(bam_file, tmp_path):
     assert rc == 1                      # max_cigar=0 always overflows
     assert not os.path.exists(bg)
     assert not os.path.exists(bg + ".tmp")
+
+
+def test_view_count_cram_header_scan(tmp_path, capsys):
+    """view -c on CRAM counts from container headers without decoding."""
+    from hadoop_bam_tpu.formats.bam import SAMHeader
+    from hadoop_bam_tpu.formats.cramio import write_cram
+    from hadoop_bam_tpu.formats.sam import SamRecord as SR
+    from hadoop_bam_tpu.tools.cli import main
+
+    hdr = SAMHeader.from_sam_text("@HD\tVN:1.6\n@SQ\tSN:c1\tLN:9999\n")
+    recs = [SR(qname=f"r{i}", flag=0, rname="c1", pos=1 + i, mapq=60,
+               cigar="5M", rnext="*", pnext=0, tlen=0,
+               seq="ACGTA", qual="IIIII") for i in range(321)]
+    path = str(tmp_path / "c.cram")
+    with open(path, "wb") as f:
+        write_cram(f, hdr, recs)
+    assert main(["view", "-c", path]) == 0
+    assert capsys.readouterr().out.strip() == "321"
+
+
+def test_cli_sort_mesh_spill(tmp_path, capsys):
+    """hbam sort --mesh --run-records engages the spill exchange and the
+    output matches the plain spill-merge sort byte for byte."""
+    import random
+
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.tools.cli import main
+    from hadoop_bam_tpu.utils.sort import sort_bam
+
+    from fixtures import make_header, make_records
+
+    header = make_header()
+    recs = make_records(header, 900, seed=31)
+    random.Random(2).shuffle(recs)
+    src = str(tmp_path / "in.bam")
+    with BamWriter(src, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    out = str(tmp_path / "out.bam")
+    assert main(["sort", src, out, "--mesh", "--run-records", "120"]) == 0
+    assert "mesh spill" in capsys.readouterr().out
+    ref = str(tmp_path / "ref.bam")
+    sort_bam(src, ref)
+    assert open(out, "rb").read() == open(ref, "rb").read()
