@@ -10,12 +10,13 @@ compressed size.
 """
 from __future__ import annotations
 
+import struct
 from typing import List, Optional, Tuple
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.bam import SAMHeader
 from hadoop_bam_tpu.formats.cram import (
-    FileDefinition, read_container, scan_container_offsets,
+    CRAMError, FileDefinition, read_container, scan_container_offsets,
 )
 from hadoop_bam_tpu.formats.cramio import decode_container, read_cram_header
 from hadoop_bam_tpu.split.spans import FileByteSpan
@@ -49,19 +50,23 @@ def scan_cram_containers(source) -> List[Tuple[int, int, int]]:
         FileDefinition.from_bytes(f.read(FileDefinition.SIZE))
         fsize = os.fstat(f.fileno()).st_size
         pos = FileDefinition.SIZE
-        chunk_size = 1 << 16
         while pos < fsize:
             f.seek(pos)
-            while True:
+            chunk_size = 1 << 16      # per container: one oversized
+            while True:               # header must not tax the rest
                 chunk = f.read(chunk_size)
                 try:
                     hdr, after = ContainerHeader.from_buffer(chunk, 0)
                     break
-                except (IndexError, ValueError):
+                except (IndexError, ValueError, struct.error) as e:
                     # header longer than the probe (huge landmark array):
-                    # widen, bounded so garbage can't loop forever
+                    # widen, bounded so garbage can't loop forever; a
+                    # truncated tail surfaces as CRAMError so callers
+                    # (and the CLI) see the normal error type
                     if chunk_size >= (1 << 24) or len(chunk) < chunk_size:
-                        raise
+                        raise CRAMError(
+                            f"truncated or corrupt container header at "
+                            f"offset {pos}: {e}") from e
                     chunk_size <<= 2
                     f.seek(pos)
             if hdr.is_eof:
