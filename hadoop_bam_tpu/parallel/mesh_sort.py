@@ -286,6 +286,68 @@ def _make_bytes_sort_step(mesh, records_cap: int, stride: int):
         out_specs=(P("data"), P("data"), P("data")), check_vma=False))
 
 
+def _agree_round_geometry(counts_vec: np.ndarray, max_len: int,
+                          his: List[np.ndarray], los: List[np.ndarray],
+                          *, err: Optional[BaseException] = None,
+                          want_sample: bool = True,
+                          sample_cap: int = 4096):
+    """Multi-host agreement on (counts, max record length[, key sample])
+    with a decode-failure flag — the ONE collective protocol shared by
+    the single-round bytes exchange and every round of the spill
+    exchange, so the two paths cannot drift.  A raise on one host
+    before the collective would strand the others in it, so a local
+    ``err`` ships as a flag and re-raises only after every process has
+    reached the allgather.  Single-process calls are a local
+    passthrough.  Returns (counts_vec, max_len, shis, slos); the sample
+    lists are None when ``want_sample`` is False (``want_sample`` must
+    agree across processes — it changes the collective sequence)."""
+    import jax
+
+    if jax.process_count() == 1:
+        # pure local passthrough, UNSAMPLED: _sample_bounds applies its
+        # own (larger) cap, so pre-truncating here would silently
+        # coarsen single-host bucket boundaries
+        if err is not None:
+            raise err
+        return (counts_vec, max_len,
+                list(his) if want_sample else None,
+                list(los) if want_sample else None)
+
+    hi_s = np.concatenate(his) if his else np.zeros(0, np.uint32)
+    lo_s = np.concatenate(los) if los else np.zeros(0, np.uint32)
+    if hi_s.size > sample_cap:
+        step_ = -(-hi_s.size // sample_cap)
+        hi_s, lo_s = hi_s[::step_], lo_s[::step_]
+
+    from jax.experimental import multihost_utils
+
+    n_proc = jax.process_count()
+    n_dev = counts_vec.size
+    meta = np.zeros(n_dev + 3, np.int64)
+    meta[:n_dev] = counts_vec
+    meta[n_dev] = max_len
+    meta[n_dev + 1] = hi_s.size
+    meta[n_dev + 2] = 0 if err is None else 1
+    g_meta = np.asarray(multihost_utils.process_allgather(meta))
+    if err is not None:
+        raise err
+    if int(g_meta[:, n_dev + 2].max()) > 0:
+        raise RuntimeError("mesh sort: decode failed on another host")
+    counts_out = g_meta[:, :n_dev].sum(axis=0)
+    max_out = int(g_meta[:, n_dev].max())
+    shis = slos = None
+    if want_sample:
+        sample = np.full((sample_cap, 2), 0xFFFFFFFF, np.uint32)
+        sample[:hi_s.size, 0] = hi_s
+        sample[:hi_s.size, 1] = lo_s
+        g_sample = np.asarray(multihost_utils.process_allgather(sample))
+        shis = [g_sample[p, :int(g_meta[p, n_dev + 1]), 0]
+                .astype(np.uint32) for p in range(n_proc)]
+        slos = [g_sample[p, :int(g_meta[p, n_dev + 1]), 1]
+                .astype(np.uint32) for p in range(n_proc)]
+    return counts_out, max_out, shis, slos
+
+
 def _frame_run(rows: np.ndarray, lens: np.ndarray, six: np.ndarray,
                hi: np.ndarray, lo: np.ndarray) -> bytes:
     """Serialize one bucket-round's sorted records as framed bytes:
@@ -480,43 +542,11 @@ def _sort_bam_mesh_bytes_spill(input_path: str, output_path: str, *, mesh,
             err = e
 
         # --- agree on round geometry (and boundaries, round 0) ---
-        if n_proc > 1:
-            SAMPLE = 4096
-            hi_s = np.concatenate(his) if his else np.zeros(0, np.uint32)
-            lo_s = np.concatenate(los) if los else np.zeros(0, np.uint32)
-            if hi_s.size > SAMPLE:
-                st_ = -(-hi_s.size // SAMPLE)
-                hi_s, lo_s = hi_s[::st_], lo_s[::st_]
-            meta = np.zeros(n_dev + 3, np.int64)
-            meta[:n_dev] = counts_vec
-            meta[n_dev] = max_len
-            meta[n_dev + 1] = hi_s.size
-            meta[n_dev + 2] = 0 if err is None else 1
-            g_meta = np.asarray(multihost_utils.process_allgather(meta))
-            if err is not None:
-                raise err
-            if int(g_meta[:, n_dev + 2].max()) > 0:
-                raise RuntimeError("mesh spill sort: decode failed on "
-                                   "another host")
-            counts_vec = g_meta[:, :n_dev].sum(axis=0)
-            max_len = int(g_meta[:, n_dev].max())
-            if t == 0:
-                sample = np.full((SAMPLE, 2), 0xFFFFFFFF, np.uint32)
-                sample[:hi_s.size, 0] = hi_s
-                sample[:hi_s.size, 1] = lo_s
-                g_sample = np.asarray(
-                    multihost_utils.process_allgather(sample))
-                shis = [g_sample[p, :int(g_meta[p, n_dev + 1]), 0]
-                        .astype(np.uint32) for p in range(n_proc)]
-                slos = [g_sample[p, :int(g_meta[p, n_dev + 1]), 1]
-                        .astype(np.uint32) for p in range(n_proc)]
-                bhi, blo = _sample_bounds(shis, slos, n_dev)
-        else:
-            if err is not None:
-                raise err
-            if t == 0:
-                bhi, blo = _sample_bounds(his, los, n_dev)
+        counts_vec, max_len, shis, slos = _agree_round_geometry(
+            counts_vec, max_len, his, los, err=err, want_sample=(t == 0))
+        err = None     # consumed: the helper raised if any host failed
         if t == 0:
+            bhi, blo = _sample_bounds(shis, slos, n_dev)
             # boundaries are fixed after round 0: ship them once
             bhi_g = replicated(bhi, jnp.uint32)
             blo_g = replicated(blo, jnp.uint32)
@@ -704,48 +734,31 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
     los: List[np.ndarray] = []
     counts_vec = np.zeros(n_dev, np.int64)
     max_len = 0
-    for d in local_pos:
-        if d >= len(spans):
-            continue
-        data, offs, _voffs, _ = _decode_span_core(
-            input_path, spans[d], False, "auto", want_voffs=False)
-        lens_ = _record_lens(data, offs)
-        local[d] = (data, offs, lens_)
-        counts_vec[d] = offs.size
-        if offs.size:
-            max_len = max(max_len, int(lens_.max()))
-        h, l = _keys_of(data, offs)
-        his.append(h)
-        los.append(l)
+    decode_err: Optional[BaseException] = None
+    try:
+        for d in local_pos:
+            if d >= len(spans):
+                continue
+            data, offs, _voffs, _ = _decode_span_core(
+                input_path, spans[d], False, "auto", want_voffs=False)
+            lens_ = _record_lens(data, offs)
+            local[d] = (data, offs, lens_)
+            counts_vec[d] = offs.size
+            if offs.size:
+                max_len = max(max_len, int(lens_.max()))
+            h, l = _keys_of(data, offs)
+            his.append(h)
+            los.append(l)
+    except Exception as e:  # noqa: BLE001 — must reach the collective
+        decode_err = e
 
     # agree on global geometry: counts/base, row stride, bucket bounds.
     # Boundary choice only affects balance, never order (buckets are a
     # range partition and every bucket is fully sorted), so a modest
-    # fixed-size per-process sample is enough.
-    SAMPLE = 4096
-    hi_s = np.concatenate(his) if his else np.zeros(0, np.uint32)
-    lo_s = np.concatenate(los) if los else np.zeros(0, np.uint32)
-    if hi_s.size > SAMPLE:
-        step_ = -(-hi_s.size // SAMPLE)
-        hi_s, lo_s = hi_s[::step_], lo_s[::step_]
-    if n_proc > 1:
-        meta = np.zeros(n_dev + 2, np.int64)
-        meta[:n_dev] = counts_vec
-        meta[n_dev] = max_len
-        meta[n_dev + 1] = hi_s.size
-        sample = np.full((SAMPLE, 2), 0xFFFFFFFF, np.uint32)
-        sample[:hi_s.size, 0] = hi_s
-        sample[:hi_s.size, 1] = lo_s
-        g_meta = np.asarray(multihost_utils.process_allgather(meta))
-        g_sample = np.asarray(multihost_utils.process_allgather(sample))
-        counts_vec = g_meta[:, :n_dev].sum(axis=0)
-        max_len = int(g_meta[:, n_dev].max())
-        shis = [g_sample[p, :int(g_meta[p, n_dev + 1]), 0].astype(np.uint32)
-                for p in range(n_proc)]
-        slos = [g_sample[p, :int(g_meta[p, n_dev + 1]), 1].astype(np.uint32)
-                for p in range(n_proc)]
-    else:
-        shis, slos = [hi_s], [lo_s]
+    # fixed-size per-process sample is enough.  Same shared protocol as
+    # the spill rounds (_agree_round_geometry), failure flag included.
+    counts_vec, max_len, shis, slos = _agree_round_geometry(
+        counts_vec, max_len, his, los, err=decode_err)
     total = int(counts_vec.sum())
     if total > 2**31 - 2:
         raise ValueError(f"{total} records exceed the int32 global-index "
