@@ -286,6 +286,14 @@ def _make_bytes_sort_step(mesh, records_cap: int, stride: int):
         out_specs=(P("data"), P("data"), P("data")), check_vma=False))
 
 
+def _buckets(garr) -> dict:
+    """Per-device bucket arrays of a sharded step output, keyed by device
+    position — the one shard-extraction helper for both exchange
+    flavors.  A 1-device mesh yields slice(None) indices: start is 0."""
+    return {(sh.index[0].start or 0): np.asarray(sh.data)[0]
+            for sh in garr.addressable_shards}
+
+
 def _agree_round_geometry(counts_vec: np.ndarray, max_len: int,
                           his: List[np.ndarray], los: List[np.ndarray],
                           *, err: Optional[BaseException] = None,
@@ -589,12 +597,8 @@ def _sort_bam_mesh_bytes_spill(input_path: str, output_path: str, *, mesh,
                                      bhi_g, blo_g)
 
         # --- spill this round's local buckets as framed sorted runs ---
-        def buckets(garr):
-            return {sh.index[0].start: np.asarray(sh.data)[0]
-                    for sh in garr.addressable_shards}
-
-        b_rows, b_lens, b_six = (buckets(rows_s), buckets(lens_s),
-                                 buckets(six_s))
+        b_rows, b_lens, b_six = (_buckets(rows_s), _buckets(lens_s),
+                                 _buckets(six_s))
         try:
             for b in sorted(b_rows):
                 keep = b_six[b] != _I32_SENTINEL
@@ -811,11 +815,8 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
     # global order
     out_header = _sorted_header(header, by_name=False)
 
-    def buckets(garr):
-        return {sh.index[0].start: np.asarray(sh.data)[0]
-                for sh in garr.addressable_shards}
-
-    b_rows, b_lens, b_six = buckets(rows_s), buckets(lens_s), buckets(six_s)
+    b_rows, b_lens, b_six = (_buckets(rows_s), _buckets(lens_s),
+                             _buckets(six_s))
 
     def bucket_payload(b):
         keep = b_six[b] != _I32_SENTINEL

@@ -236,3 +236,25 @@ def test_spill_requires_bytes_exchange(tmp_path):
     with pytest.raises(ValueError, match="bytes"):
         sort_bam_mesh(path, str(tmp_path / "o.bam"), exchange="index",
                       round_records=10)
+
+
+def test_bytes_and_spill_on_single_device_mesh(tmp_path):
+    """A 1-device mesh produces whole-axis shard indices (slice(None),
+    start=None): the bucket extraction must map that to bucket 0 — both
+    byte-exchange flavors previously crashed on single-device meshes."""
+    import jax
+    from jax.sharding import Mesh
+
+    header = make_header()
+    recs = make_records(header, 500, seed=44)
+    path = _write_shuffled(tmp_path, recs, header, seed=44)
+    import numpy as np
+    mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    ref = str(tmp_path / "ref1.bam")
+    sort_bam(path, ref)
+    for label, kw in (("bytes", dict(exchange="bytes")),
+                      ("spill", dict(round_records=100))):
+        out = str(tmp_path / f"one_{label}.bam")
+        n = sort_bam_mesh(path, out, mesh=mesh1, **kw)
+        assert n == 500
+        assert open(out, "rb").read() == open(ref, "rb").read(), label
