@@ -455,6 +455,54 @@ def bench_bgzf_inflate(path: str):
             "unit": "GB/s", "vs_baseline": round(gbps / base_gbps, 3)}
 
 
+def bench_fault_resilience(path: str):
+    """Throughput under injected transient faults (the resilience-layer
+    chaos hook): flagstat with a handful of injected transient read
+    failures healing under the classified span-retry policy, reported as
+    the slowdown vs the clean pipeline.  Correctness is asserted (the
+    faulted run must produce the clean answer with nothing quarantined),
+    so this row doubles as an end-to-end resilience check."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+    from hadoop_bam_tpu.utils.resilient import FaultSpec, chaos_on
+    import dataclasses
+
+    header, _ = read_bam_header(path)
+    # plan once OUTSIDE the chaos window: planning probes are not under
+    # the span retry policy (a fault there is a planner bug, not the
+    # resilience path this row measures)
+    from hadoop_bam_tpu.parallel.pipeline import pipeline_span_count
+    from hadoop_bam_tpu.split.planners import plan_spans_cached
+    import jax
+    spans = plan_spans_cached(
+        path, header, DEFAULT_CONFIG,
+        num_spans=pipeline_span_count(path, len(jax.devices()),
+                                      DEFAULT_CONFIG))
+    clean, clean_dt = _median_time(
+        lambda: flagstat_file(path, header=header, spans=spans))
+    cfg = dataclasses.replace(DEFAULT_CONFIG, span_retries=3,
+                              retry_backoff_base_s=0.001,
+                              retry_backoff_max_s=0.01)
+
+    def chaotic():
+        # budget of 2 faults vs span_retries=3: even if one span's retry
+        # chain eats BOTH faults (possible — the shared budget drains by
+        # read order, and a 1-span plan is legal), it still heals
+        faults = [FaultSpec("transient", at_read=0, count=2)]
+        with chaos_on(path, faults):
+            return flagstat_file(path, header=header, spans=spans,
+                                 config=cfg)
+
+    stats, dt = _median_time(chaotic)
+    if {k: stats[k] for k in clean} != clean:
+        raise AssertionError("faulted flagstat diverged from clean run")
+    rate = stats["total"] / dt
+    return {"metric": "faulted_flagstat_records_per_sec",
+            "value": round(rate, 1), "unit": "records/s",
+            "vs_baseline": round(clean_dt / dt, 3)}
+
+
 # ---------------------------------------------------------------------------
 # 3. CRAM decode records/s
 # ---------------------------------------------------------------------------
@@ -1202,6 +1250,8 @@ def main() -> None:
                    est_s=15)
     _run_component(lambda: bench_split_guess(path),
                    "split_guess_p50_ms_per_boundary", est_s=10)
+    _run_component(lambda: bench_fault_resilience(path),
+                   "faulted_flagstat_records_per_sec", est_s=20)
     _run_component(lambda: bench_cram(build_cram_fixture()),
                    "cram_tensor_records_per_sec", est_s=25)
     _run_component(lambda: bench_vcf(build_vcf_fixture()),

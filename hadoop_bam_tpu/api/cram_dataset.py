@@ -58,7 +58,8 @@ class CramDataset:
 
     def tensor_batches(self, mesh=None, geometry=None,
                        num_spans: Optional[int] = None,
-                       spans: Optional[List[FileByteSpan]] = None
+                       spans: Optional[List[FileByteSpan]] = None,
+                       quarantine=None,
                        ) -> Iterator[Dict]:
         """Device-resident read batches (same layout as
         FastqDataset.tensor_batches) decoded from CRAM containers.
@@ -91,7 +92,8 @@ class CramDataset:
 
         yield from stream_read_tensor_batches(
             self.spans(num_spans) if spans is None else spans, None,
-            self.config, mesh, geometry, tiles_fn=tiles)
+            self.config, mesh, geometry, tiles_fn=tiles,
+            quarantine=quarantine)
 
     def flagstat(self, mesh=None) -> Dict[str, int]:
         """Host-side flagstat over decoded CRAM records (same counters as

@@ -71,6 +71,11 @@ _HADOOP_KEY_MAP = {
     "hbam.qseq-input.base-quality-encoding": "qseq_base_quality_encoding",
     "hbam.qseq-input.filter-failed-qc": "qseq_filter_failed_qc",
     "hadoop-bam.backend": "backend",
+    # failure-policy knobs (no reference analog: Hadoop relied on
+    # mapreduce.map.maxattempts; these are the span-grain equivalents)
+    "hbam.span-retries": "span_retries",
+    "hbam.skip-bad-spans": "skip_bad_spans",
+    "hbam.max-bad-span-fraction": "max_bad_span_fraction",
 }
 
 
@@ -108,10 +113,22 @@ class HBamConfig:
     keep_paired_reads_together: bool = False
 
     # --- failure policy (SURVEY.md section 5: spans are idempotent retry
-    # units, the MapReduce task-retry analog) ---
-    span_retries: int = 2            # re-decode attempts per failing span
-    skip_bad_spans: bool = False     # after retries: True = warn + skip
-    #                                  (ticks pipeline.bad_spans), False = raise
+    # units, the MapReduce task-retry analog — but retries are CLASSIFIED:
+    # only transient I/O faults are re-attempted; corruption fails fast;
+    # plan errors are never retried or skipped.  utils/errors.py owns the
+    # taxonomy, utils/resilient.py the backoff/quarantine machinery.) ---
+    span_retries: int = 2            # TRANSIENT re-decode attempts per span
+    skip_bad_spans: bool = False     # after the policy: True = quarantine +
+    #                                  skip (ticks pipeline.bad_spans and the
+    #                                  manifest), False = raise
+    max_bad_span_fraction: float = 1.0  # circuit breaker: abort once the
+    #                                  quarantined fraction of planned spans
+    #                                  exceeds this (1.0 = never trips)
+    retry_backoff_base_s: float = 0.05  # first transient-retry delay
+    retry_backoff_max_s: float = 2.0    # backoff ceiling
+    io_read_retries: int = 0         # >0: wrap file sources in
+    #                                  RetryingByteSource with this budget
+    io_read_deadline_s: Optional[float] = None  # per-pread deadline
     check_crc: bool = False          # verify BGZF CRC32 footers on inflate
 
     # --- split planning ---
@@ -151,9 +168,16 @@ def _coerce(kwargs: dict) -> dict:
     for k in ("trust_exts", "vcf_trust_exts", "fastq_filter_failed_qc",
               "qseq_filter_failed_qc", "write_header", "write_terminator",
               "use_splitting_index", "use_native",
-              "keep_paired_reads_together"):
+              "keep_paired_reads_together", "skip_bad_spans"):
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
+    for k in ("max_bad_span_fraction", "retry_backoff_base_s",
+              "retry_backoff_max_s", "io_read_deadline_s"):
+        if k in out and isinstance(out[k], str):
+            out[k] = float(out[k])
+    for k in ("span_retries", "io_read_retries"):
+        if k in out and isinstance(out[k], str):
+            out[k] = int(out[k])
     return out
 
 

@@ -137,8 +137,13 @@ def read_bam_header(source) -> Tuple[SAMHeader, int]:
 
     Equivalent of hb/util/SAMHeaderReader.java for BAM containers (and of the
     header step of hb/BAMRecordReader.initialize)."""
+    from hadoop_bam_tpu.utils.errors import CorruptDataError
+
     r = bgzf.BGZFReader(source)
     # Headers are typically < a few MB; read blocks until parse succeeds.
+    # Transient read faults surface from r.read() itself (outside this
+    # try) with their own class; what the handler sees is always a parse
+    # failure over an in-memory buffer — deterministic corruption.
     size = 1 << 16
     while True:
         r.seek_voffset(0)
@@ -148,7 +153,8 @@ def read_bam_header(source) -> Tuple[SAMHeader, int]:
             break
         except (IndexError, Exception) as e:
             if len(buf) < size:  # EOF — really malformed
-                raise
+                raise CorruptDataError(
+                    f"malformed BAM header: {type(e).__name__}: {e}") from e
             size *= 4
     # Convert the plain offset-after-header into a virtual offset by walking
     # blocks again (cheap: headers span few blocks).

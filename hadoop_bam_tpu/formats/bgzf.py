@@ -27,6 +27,8 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from hadoop_bam_tpu.utils.errors import CorruptDataError
+
 # [SPEC] gzip member header: ID1 ID2 CM FLG, with FLG.FEXTRA set.
 GZIP_MAGIC = b"\x1f\x8b\x08\x04"
 # [SPEC] BGZF extra subfield identifiers.
@@ -50,8 +52,9 @@ EOF_BLOCK = bytes.fromhex(
     "1f8b08040000000000ff0600424302001b0003000000000000000000")
 
 
-class BGZFError(ValueError):
-    pass
+class BGZFError(CorruptDataError):
+    """Malformed BGZF bytes — classified CORRUPT (still a ValueError for
+    pre-taxonomy callers): re-reading the same bytes never heals it."""
 
 
 @dataclass(frozen=True)
@@ -117,7 +120,11 @@ def inflate_block(buf: bytes, info: Optional[BlockInfo] = None,
     if info is None:
         info = parse_block_header(buf, offset)
     raw = bytes(buf[info.cdata_offset:info.cdata_offset + info.cdata_size])
-    data = zlib.decompress(raw, wbits=-15)
+    try:
+        data = zlib.decompress(raw, wbits=-15)
+    except zlib.error as e:
+        raise BGZFError(f"corrupt DEFLATE payload at coffset "
+                        f"{info.coffset}: {e}") from e
     if len(data) != info.isize:
         raise BGZFError(f"ISIZE mismatch: {len(data)} != {info.isize}")
     if check_crc:

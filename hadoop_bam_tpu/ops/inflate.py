@@ -79,7 +79,13 @@ def inflate_span(raw: bytes, table: Optional[dict] = None,
         mv = memoryview(raw)
         for i in range(isize.size):
             o, l = int(table["cdata_off"][i]), int(table["cdata_len"][i])
-            out = zlib.decompress(bytes(mv[o:o + l]), wbits=-15)
+            try:
+                out = zlib.decompress(bytes(mv[o:o + l]), wbits=-15)
+            except zlib.error as e:
+                # classified at the policy boundary: bad DEFLATE bytes are
+                # deterministic corruption, not a retryable read fault
+                raise bgzf.BGZFError(
+                    f"corrupt DEFLATE payload in block {i}: {e}") from e
             if len(out) != int(isize[i]):
                 raise bgzf.BGZFError(f"ISIZE mismatch in block {i}")
             dst[int(ubase[i]):int(ubase[i + 1])] = np.frombuffer(out, np.uint8)

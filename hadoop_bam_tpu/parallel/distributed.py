@@ -20,12 +20,20 @@ re-decoded anywhere; ``retry_span`` is a plain re-invoke.
 from __future__ import annotations
 
 import json
-from typing import List, Optional, Sequence
+import logging
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import numpy as np
 
 from hadoop_bam_tpu.split.spans import FileVirtualSpan
+from hadoop_bam_tpu.utils.errors import (
+    PlanError, TRANSIENT, TransientIOError, classify_error,
+)
+from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.utils.resilient import QuarantineManifest, RetryPolicy
+
+logger = logging.getLogger(__name__)
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -55,22 +63,109 @@ def serialize_plan(spans: Sequence, max_bytes: int = 1 << 24) -> bytes:
     payload = json.dumps(
         [{"k": type(s).__name__, **s.to_dict()} for s in spans]).encode()
     if len(payload) + 8 > max_bytes:
-        raise ValueError(f"plan of {len(spans)} spans serializes to "
-                         f"{len(payload)} bytes — exceeds the "
-                         f"{max_bytes}-byte broadcast buffer; raise "
-                         f"max_bytes or plan coarser spans")
+        # PLAN class (still a ValueError): a mis-sized broadcast buffer is
+        # a configuration fault, not retryable and not skippable
+        raise PlanError(f"plan of {len(spans)} spans serializes to "
+                        f"{len(payload)} bytes — exceeds the "
+                        f"{max_bytes}-byte broadcast buffer; raise "
+                        f"max_bytes or plan coarser spans")
     return payload
 
 
+class _CollectiveTimeout(Exception):
+    """Internal sentinel: the collective outlived timeout_s.  Distinct from
+    TransientIOError so the retry clause below cannot confuse a hang (never
+    safe to re-enter solo) with a failed-and-returned transient error
+    (safe to retry in lockstep)."""
+
+
+def _run_collective(fn: Callable[[], object], what: str,
+                    retries: int = 0,
+                    timeout_s: Optional[float] = None):
+    """Classified retry/timeout wrapper for multihost collectives.
+
+    Retries fire only on TRANSIENT-classified failures raised by the
+    collective itself (transport resets, interrupted syscalls) — failures
+    every participating host observes — and the schedule is deterministic
+    (``jitter=0``), so all hosts re-enter the collective in lockstep.  A
+    TIMEOUT is different: the operation may still be in flight on peer
+    hosts, and a solo re-entry would deadlock the group, so it surfaces
+    immediately as ``TransientIOError`` for the caller to abort on.  The
+    timed body runs on a DAEMON thread: a hung collective cannot be
+    cancelled from Python, but a daemon never blocks interpreter exit, so
+    the abort actually terminates the job.
+
+    Retries REQUIRE a timeout: a transport error is not guaranteed to be
+    observed by every peer, and an unbounded solo re-entry into a
+    collective the peers already left would hang forever — so with
+    ``timeout_s=None`` transient failures fail fast (the pre-resilience
+    behavior) and the retry budget is ignored."""
+    import threading
+
+    if timeout_s is None:
+        retries = 0
+
+    def run_once():
+        if timeout_s is None:
+            return fn()
+        box: dict = {}
+
+        def runner():
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["error"] = e
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name=f"collective:{what}")
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            raise _CollectiveTimeout
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    policy = RetryPolicy(retries=retries, jitter=0.0)
+    for attempt in range(retries + 1):
+        try:
+            return run_once()
+        except _CollectiveTimeout:
+            raise TransientIOError(
+                f"{what} timed out after {timeout_s:g}s — peers may still "
+                "be in the collective; aborting rather than re-entering "
+                "solo") from None
+        except Exception as e:  # noqa: BLE001 — policy boundary
+            if classify_error(e) != TRANSIENT or attempt >= retries:
+                raise
+            METRICS.count("distributed.collective_retries")
+            d = policy.delay(attempt)
+            logger.warning("%s failed transiently (attempt %d/%d), "
+                           "retrying in %.3fs: %s", what, attempt + 1,
+                           retries + 1, d, e)
+            policy.sleep(d)
+    raise AssertionError("unreachable")  # loop always returns or raises
+
+
 def broadcast_plan(spans: Optional[Sequence],
-                   max_bytes: int = 1 << 24) -> List:
+                   max_bytes: int = 1 << 24,
+                   retries: int = 2,
+                   timeout_s: Optional[float] = None) -> List:
     """Host 0 passes its plan; other hosts pass None and receive it.
 
     Uses a fixed-size uint8 buffer through broadcast_one_to_all (the payload
     must have identical shape on all hosts).  Both span flavors travel
     (virtual-offset BAM spans and plain byte spans for text formats),
     tagged with their class.
-    """
+
+    Transient collective failures are retried ``retries`` times on a
+    deterministic (jitter-free) backoff schedule so every host re-enters in
+    lockstep; ``timeout_s`` bounds the wall-clock wait and surfaces a hang
+    as ``TransientIOError`` instead of blocking the job forever.  Retries
+    only engage when ``timeout_s`` is set — an unbounded solo re-entry
+    could hang on peers that already left the collective (see
+    ``_run_collective``); without a timeout, transient failures fail
+    fast."""
     from hadoop_bam_tpu.split.spans import FileByteSpan
 
     span_classes = {"FileVirtualSpan": FileVirtualSpan,
@@ -87,12 +182,61 @@ def broadcast_plan(spans: Optional[Sequence],
         buf[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
     else:
         buf = np.zeros(max_bytes, dtype=np.uint8)
-    out = multihost_utils.broadcast_one_to_all(buf)
-    out = np.asarray(out)
+    out = _run_collective(
+        lambda: np.asarray(multihost_utils.broadcast_one_to_all(buf)),
+        "broadcast_plan", retries=retries, timeout_s=timeout_s)
+    # some jax/gloo versions widen uint8 payloads element-wise through the
+    # collective; each element still holds one byte value, so cast back
+    out = out.astype(np.uint8, copy=False)
     n = int(np.frombuffer(out[:8].tobytes(), np.int64)[0])
     plan = json.loads(out[8:8 + n].tobytes().decode())
     return [span_classes[d.pop("k", "FileVirtualSpan")].from_dict(d)
             for d in plan]
+
+
+def merge_quarantine_manifests(manifest: QuarantineManifest,
+                               max_bytes: int = 1 << 20,
+                               timeout_s: Optional[float] = None
+                               ) -> QuarantineManifest:
+    """Reduce-side manifest merge: every host contributes its local
+    quarantine entries over one fixed-size allgather, and all hosts return
+    the identical deduplicated, canonically-ordered union — so "what was
+    skipped" is a property of the JOB, not of whichever host happened to
+    decode the bad span.  Single-process: returns the manifest unchanged."""
+    if jax.process_count() == 1:
+        return manifest
+    from jax.experimental import multihost_utils
+
+    # cheap pre-check (8 bytes/host): clean runs — the common case — skip
+    # the max_bytes-sized payload allgather entirely
+    counts = _run_collective(
+        lambda: np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(manifest)], np.int64))),
+        "merge_quarantine_manifests:counts", timeout_s=timeout_s)
+    if int(np.sum(counts)) == 0:
+        return manifest
+
+    payload = manifest.to_json().encode()
+    if len(payload) + 8 > max_bytes:
+        raise PlanError(f"quarantine manifest of {len(manifest)} entries "
+                        f"serializes to {len(payload)} bytes — exceeds the "
+                        f"{max_bytes}-byte allgather buffer")
+    buf = np.zeros(max_bytes, dtype=np.uint8)
+    buf[:8] = np.frombuffer(np.int64(len(payload)).tobytes(), np.uint8)
+    buf[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
+    rows = _run_collective(
+        lambda: np.asarray(multihost_utils.process_allgather(buf)),
+        "merge_quarantine_manifests", timeout_s=timeout_s)
+    rows = rows.astype(np.uint8, copy=False)  # see broadcast_plan: some
+    #                                           collectives widen uint8
+    per_host = []
+    for host in range(rows.shape[0]):
+        n = int(np.frombuffer(rows[host, :8].tobytes(), np.int64)[0])
+        per_host.append(QuarantineManifest.from_json(
+            rows[host, 8:8 + n].tobytes().decode()))
+    # merge the allgathered ROWS only (this host's own row is among them):
+    # merged_with sums total_spans, and each host must count exactly once
+    return per_host[0].merged_with(per_host[1:])
 
 
 def assign_spans(spans: Sequence[FileVirtualSpan],
@@ -201,13 +345,25 @@ def distributed_flagstat(path: str, config=None, header=None):
         n = pipeline_span_count(path, jax.device_count(), config)
         return plan_spans_cached(path, header, config, num_spans=n)
 
+    # the circuit breaker trips HOST-LOCALLY (fraction over this host's
+    # assigned spans) — safe against stranding peers because local() runs
+    # inside _multihost_reduce's failure-flag phase: a CircuitBreakerError
+    # rides the ok/failed allgather and every host raises
+    quarantine = QuarantineManifest()
+
     def local(mine):
         stats = flagstat_file(path, mesh=_local_mesh(), config=config,
-                              header=header, spans=mine)
+                              header=header, spans=mine,
+                              quarantine=quarantine)
         return np.asarray([stats[k] for k in FLAGSTAT_FIELDS], np.float64)
 
     tot = _multihost_reduce(plan, local, len(FLAGSTAT_FIELDS)).sum(axis=0)
-    return {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, tot)}
+    out = {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, tot)}
+    # reduce-side manifest merge: every host reports the same union of
+    # skipped spans (runs as its own collective AFTER the stat reduce, in
+    # the same order on all hosts)
+    from hadoop_bam_tpu.parallel.pipeline import _attach_quarantine
+    return _attach_quarantine(out, merge_quarantine_manifests(quarantine))
 
 
 def distributed_seq_stats(path: str, config=None, header=None,
@@ -233,13 +389,17 @@ def distributed_seq_stats(path: str, config=None, header=None,
         n = pipeline_span_count(path, jax.device_count(), config)
         return plan_spans_cached(path, header, config, num_spans=n)
 
+    quarantine = QuarantineManifest()
+
     def local(mine):
         return _pack_seq_stats(seq_stats_file(
             path, mesh=_local_mesh(), config=config, header=header,
-            spans=mine, geometry=geometry))
+            spans=mine, geometry=geometry, quarantine=quarantine))
 
-    return _combine_seq_stats(
+    out = _combine_seq_stats(
         _multihost_reduce(plan, local, 3 + N_CODES))
+    from hadoop_bam_tpu.parallel.pipeline import _attach_quarantine
+    return _attach_quarantine(out, merge_quarantine_manifests(quarantine))
 
 
 def _pack_seq_stats(s) -> np.ndarray:
@@ -412,12 +572,17 @@ def distributed_coverage(path: str, region, config=None, header=None,
     return g.astype(np.int32)
 
 
-def retry_span(decode_fn, span: FileVirtualSpan, attempts: int = 3):
-    """Span-level retry — the framework's failure-recovery unit."""
-    last: Exception
-    for _ in range(attempts):
-        try:
-            return decode_fn(span)
-        except Exception as e:  # noqa: BLE001 — deliberate blanket retry
-            last = e
-    raise last
+def retry_span(decode_fn, span: FileVirtualSpan, attempts: int = 3,
+               policy: Optional[RetryPolicy] = None):
+    """Span-level retry — the framework's failure-recovery unit, now
+    fault-classified via the shared ``call_with_retry`` core: only
+    TRANSIENT failures are re-attempted (with the policy's backoff);
+    corruption and plan errors raise on the first attempt (re-decoding
+    the same corrupt bytes can never heal them)."""
+    from hadoop_bam_tpu.utils.resilient import call_with_retry
+
+    if policy is None:
+        policy = RetryPolicy(retries=max(0, attempts - 1))
+    return call_with_retry(lambda: decode_fn(span), policy,
+                           what=f"decode of span {span}",
+                           counter="pipeline.transient_retries")

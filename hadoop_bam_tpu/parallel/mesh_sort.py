@@ -164,6 +164,8 @@ def _make_sort_step(mesh, records_cap: int):
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from hadoop_bam_tpu.parallel.mesh import shard_map
+
     from hadoop_bam_tpu.ops.unpack_bam import unpack_fixed_fields
 
     n_dev = int(np.prod(mesh.devices.shape))
@@ -191,7 +193,7 @@ def _make_sort_step(mesh, records_cap: int):
             num_keys=3)
         return six[None]
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_device, mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P("data"), P(), P()),
         out_specs=P("data"), check_vma=False))
@@ -235,6 +237,8 @@ def _make_bytes_sort_step(mesh, records_cap: int, stride: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
+
+    from hadoop_bam_tpu.parallel.mesh import shard_map
 
     n_dev = int(np.prod(mesh.devices.shape))
     R = records_cap
@@ -280,7 +284,7 @@ def _make_bytes_sort_step(mesh, records_cap: int, stride: int):
         sorted_ln = jnp.take(recv_ln, order)
         return sorted_rows[None], sorted_ln[None], six[None]
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         per_device, mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P("data"), P(), P()),
         out_specs=(P("data"), P("data"), P("data")), check_vma=False))
@@ -510,17 +514,19 @@ def _sort_bam_mesh_bytes_spill(input_path: str, output_path: str, *, mesh,
     run_files: dict = {}               # bucket -> [run paths]
     err: Optional[BaseException] = None
 
+    # make_array_from_single_device_arrays grew its dtype kwarg after
+    # jax 0.4; casting host-side before device_put is version-portable
     def sharded(shape, dtype, of_d):
         return jax.make_array_from_single_device_arrays(
             shape, sharding,
-            [jax.device_put(of_d(d), mesh_devs[d]) for d in local_pos],
-            dtype=dtype)
+            [jax.device_put(np.asarray(of_d(d), dtype=dtype),
+                            mesh_devs[d]) for d in local_pos])
 
     def replicated(arr, dtype):
+        arr = np.asarray(arr, dtype=dtype)
         return jax.make_array_from_single_device_arrays(
             arr.shape, rep,
-            [jax.device_put(arr, mesh_devs[d]) for d in local_pos],
-            dtype=dtype)
+            [jax.device_put(arr, mesh_devs[d]) for d in local_pos])
 
     for t in range(n_rounds):
         # --- decode this round's local spans (streaming: only one
@@ -784,17 +790,19 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
         packed[d] = _pack_record_rows(data, offs, lens_, records_cap,
                                       stride)
 
+    # make_array_from_single_device_arrays grew its dtype kwarg after
+    # jax 0.4; casting host-side before device_put is version-portable
     def sharded(shape, dtype, of_d):
         return jax.make_array_from_single_device_arrays(
             shape, sharding,
-            [jax.device_put(of_d(d), mesh_devs[d]) for d in local_pos],
-            dtype=dtype)
+            [jax.device_put(np.asarray(of_d(d), dtype=dtype),
+                            mesh_devs[d]) for d in local_pos])
 
     def replicated(arr, dtype):
+        arr = np.asarray(arr, dtype=dtype)
         return jax.make_array_from_single_device_arrays(
             arr.shape, rep,
-            [jax.device_put(arr, mesh_devs[d]) for d in local_pos],
-            dtype=dtype)
+            [jax.device_put(arr, mesh_devs[d]) for d in local_pos])
 
     rows_g = sharded((n_dev, records_cap, stride), jnp.uint8,
                      lambda d: packed[d][0][None])

@@ -19,14 +19,16 @@ from __future__ import annotations
 import concurrent.futures as cf
 import dataclasses
 import functools
+import logging
 import os
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hadoop_bam_tpu.parallel.mesh import shard_map
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.bam import SAMHeader
@@ -39,8 +41,15 @@ from hadoop_bam_tpu.ops.unpack_bam import (
 )
 from hadoop_bam_tpu.split.planners import plan_bam_spans
 from hadoop_bam_tpu.split.spans import FileVirtualSpan
+from hadoop_bam_tpu.utils import errors as hberrors
+from hadoop_bam_tpu.utils.errors import PlanError, classify_error
 from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.utils.resilient import (
+    QuarantineManifest, RetryPolicy, RetryingByteSource,
+)
 from hadoop_bam_tpu.utils.seekable import as_byte_source, scoped_byte_source
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -254,7 +263,9 @@ def decode_span_host(source, span: FileVirtualSpan, geometry: DecodeGeometry,
     n = int(offs.size)
     g = geometry
     if data.size > g.bytes_cap or n > g.records_cap:
-        raise ValueError(
+        # PlanError: a mis-sized plan is a configuration fault — the retry
+        # policy must neither re-decode it nor skip_bad_spans-eat it
+        raise PlanError(
             f"span exceeds geometry: {data.size}B/{n} records vs caps "
             f"{g.bytes_cap}B/{g.records_cap} — plan smaller spans")
     out_data = np.zeros(g.bytes_cap, dtype=np.uint8)
@@ -549,28 +560,87 @@ def parse_config_intervals(config: HBamConfig, header):
                            header.ref_names if header else None)
 
 
-def decode_with_retry(fn: Callable, span: FileVirtualSpan,
-                      config: HBamConfig):
-    """Span-level failure policy (SURVEY.md section 5): a span is a
-    self-describing, idempotent unit of work — the retry mechanism is
-    simply re-decoding it, exactly as MapReduce re-runs a map task.  After
-    ``config.span_retries`` re-attempts, ``skip_bad_spans`` decides between
-    raising and warn+skip (returns None; ticks pipeline.bad_spans)."""
+def _span_retry_policy(config: HBamConfig) -> RetryPolicy:
+    return RetryPolicy(
+        retries=max(0, int(getattr(config, "span_retries", 0))),
+        backoff_base_s=float(getattr(config, "retry_backoff_base_s", 0.05)),
+        backoff_max_s=float(getattr(config, "retry_backoff_max_s", 2.0)))
 
-    retries = max(0, int(getattr(config, "span_retries", 0)))
+
+def _resilient_source(path, config: HBamConfig):
+    """What the decode stages should read through: the plain path, or a
+    RetryingByteSource wrap when ``config.io_read_retries`` asks for
+    read-level retries (backoff + per-read deadline under the span grain)."""
+    r = int(getattr(config, "io_read_retries", 0) or 0)
+    if r <= 0 or not isinstance(path, (str, os.PathLike)):
+        return path
+    return RetryingByteSource(path, RetryPolicy(
+        retries=r,
+        backoff_base_s=float(getattr(config, "retry_backoff_base_s", 0.05)),
+        backoff_max_s=float(getattr(config, "retry_backoff_max_s", 2.0)),
+        deadline_s=getattr(config, "io_read_deadline_s", None)))
+
+
+def decode_with_retry(fn: Callable, span: FileVirtualSpan,
+                      config: HBamConfig,
+                      quarantine: Optional[QuarantineManifest] = None,
+                      policy: Optional[RetryPolicy] = None):
+    """Span-level failure policy (SURVEY.md section 5), fault-classified.
+
+    A span is a self-describing, idempotent unit of work — the retry
+    mechanism is re-decoding it, as MapReduce re-runs a map task — but
+    unlike the reference, failures are classified (utils/errors.py) and
+    each class gets its own policy:
+
+    - TRANSIENT: re-attempted up to ``config.span_retries`` times with
+      jittered exponential backoff (``policy`` injectable, so tests assert
+      the exact schedule without real sleeps);
+    - CORRUPT: fails fast with ZERO re-decodes — a CRC mismatch or
+      malformed record chain never heals, re-reading it only wastes the
+      budget;
+    - PLAN: always raised — a misconfigured run must not be retried or
+      quietly skipped as if the data were bad.
+
+    Once the policy is exhausted, ``skip_bad_spans`` decides between
+    raising and quarantine+skip: the span is recorded in ``quarantine``
+    (file, virtual-offset range, error class, attempts) and None returned.
+    Counters: ``pipeline.bad_spans`` ticks ONLY on an actual skip;
+    ``pipeline.transient_retries`` counts re-attempts;
+    ``pipeline.corrupt_spans`` counts corrupt failures.  The manifest's
+    circuit breaker (``config.max_bad_span_fraction``) raises
+    CircuitBreakerError when the run has quarantined too much of its plan
+    to stay meaningful."""
+    if policy is None:
+        policy = _span_retry_policy(config)
     last: Optional[BaseException] = None
-    for attempt in range(retries + 1):
+    kind = hberrors.CORRUPT
+    attempts = 0
+    for attempt in range(policy.retries + 1):
+        attempts = attempt + 1
         try:
             return fn(span)
         except Exception as e:  # noqa: BLE001 — policy boundary
             last = e
-            if attempt < retries:
-                METRICS.count("pipeline.span_retries")
-    METRICS.count("pipeline.bad_spans")
+            kind = classify_error(e)
+            if kind == hberrors.PLAN:
+                raise
+            if kind != hberrors.TRANSIENT:
+                METRICS.count("pipeline.corrupt_spans")
+                break
+            if attempt < policy.retries:
+                METRICS.count("pipeline.transient_retries")
+                d = policy.delay(attempt)
+                logger.debug("transient fault on span %s (attempt %d/%d), "
+                             "retrying in %.3fs: %s", span, attempts,
+                             policy.retries + 1, d, e)
+                policy.sleep(d)
     if getattr(config, "skip_bad_spans", False):
-        import sys
-        print(f"hadoop-bam-tpu: skipping bad span {span}: {last}",
-              file=sys.stderr)
+        METRICS.count("pipeline.bad_spans")
+        logger.warning("skipping bad span %s after %d attempt(s) [%s]: %s",
+                       span, attempts, kind, last)
+        if quarantine is not None:
+            quarantine.add(span, last, kind, attempts)
+            quarantine.check_circuit(config)  # may raise CircuitBreakerError
         return None
     raise last
 
@@ -703,6 +773,7 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                              config: HBamConfig = DEFAULT_CONFIG,
                              prefetch: int = 2,
                              header=None,
+                             quarantine: Optional[QuarantineManifest] = None,
                              ) -> Iterator[Tuple[List[np.ndarray],
                                                  np.ndarray]]:
     """Stream payload tile groups ready for a device mesh: yields
@@ -717,16 +788,21 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     widths = (PREFIX, geometry.seq_stride, geometry.qual_stride)
     check_crc = bool(getattr(config, "check_crc", False))
     intervals = parse_config_intervals(config, header)
+    src = _resilient_source(path, config)
+    spans = list(spans)
+    if quarantine is not None and quarantine.total_spans is None:
+        quarantine.total_spans = len(spans)
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     window = max(1, prefetch) * n_workers
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         def decode(span):
             def inner(s):
                 prefix, seq, qual, _v = decode_span_payload_host(
-                    path, s, geometry, check_crc,
+                    src, s, geometry, check_crc,
                     intervals=intervals, header=header)
                 return prefix, seq, qual
-            out = decode_with_retry(inner, span, config)
+            out = decode_with_retry(inner, span, config,
+                                    quarantine=quarantine)
             return out if out is not None else (
                 np.empty((0, PREFIX), np.uint8),
                 np.empty((0, geometry.seq_stride), np.uint8),
@@ -799,6 +875,16 @@ def _payload_stats_tail(stats, valid, axis: str):
     return jax.lax.psum(fvec, axis), jax.lax.psum(ivec, axis)
 
 
+def _attach_quarantine(result: Dict,
+                       quarantine: Optional[QuarantineManifest]) -> Dict:
+    """Attach the quarantine manifest to a driver's result dict.  Only when
+    non-empty: clean runs keep their exact historical result shape, and
+    dict-equality comparisons across runs/hosts stay valid."""
+    if quarantine:
+        result["quarantine"] = quarantine.to_dicts()
+    return result
+
+
 def _payload_stats_result(totals: _StatTotals) -> Dict[str, object]:
     from hadoop_bam_tpu.ops.seq_pallas import N_CODES
     if not totals:
@@ -858,7 +944,9 @@ def make_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
 def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
                                mesh: Optional[Mesh],
                                geometry: "Optional[PayloadGeometry]",
-                               tiles_fn=None) -> Iterator[Dict]:
+                               tiles_fn=None,
+                               quarantine: Optional[QuarantineManifest] = None,
+                               ) -> Iterator[Dict]:
     """Shared tensor-batch generator for text/record read formats
     (FASTQ/QSEQ/CRAM): ``read_span_fn(span)`` returns a list of objects
     with ``.sequence``/``.quality`` attributes; yields sharded device
@@ -878,6 +966,9 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
     n_dev = int(np.prod(mesh.devices.shape))
     cap = geometry.tile_records
     sharding = NamedSharding(mesh, P("data"))
+    spans = list(spans)
+    if quarantine is not None and quarantine.total_spans is None:
+        quarantine.total_spans = len(spans)
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     specs = (geometry.seq_stride, geometry.qual_stride, (None, np.int32))
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
@@ -888,7 +979,8 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
                 return fragments_to_payload_tiles(
                     read_span_fn(s), geometry.seq_stride,
                     geometry.qual_stride, geometry.max_len)
-            out = decode_with_retry(inner, span, config)
+            out = decode_with_retry(inner, span, config,
+                                    quarantine=quarantine)
             return out if out is not None else (
                 np.empty((0, geometry.seq_stride), np.uint8),
                 np.empty((0, geometry.qual_stride), np.uint8),
@@ -991,7 +1083,9 @@ CRAM_EXTS = (".cram",)
 def cram_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                         config: HBamConfig = DEFAULT_CONFIG,
                         geometry: Optional[PayloadGeometry] = None,
-                        spans=None) -> Dict[str, object]:
+                        spans=None,
+                        quarantine: Optional[QuarantineManifest] = None,
+                        ) -> Dict[str, object]:
     """GC / quality / base stats over a CRAM — the CRAM member of the
     seq-stats driver family, fed by the columnar slice decoder
     (CramDataset.tensor_batches) through the same fused stats step as
@@ -1012,17 +1106,22 @@ def cram_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                                                        config))
     step = make_read_stats_step(mesh, geometry)
     totals = _StatTotals()
-    for b in ds.tensor_batches(mesh=mesh, geometry=geometry, spans=spans):
+    if quarantine is None:
+        quarantine = QuarantineManifest()
+    for b in ds.tensor_batches(mesh=mesh, geometry=geometry, spans=spans,
+                               quarantine=quarantine):
         totals.add(*step(b["seq_packed"], b["qual"], b["lengths"],
                          b["n_records"]))
-    return _payload_stats_result(totals)
+    return _attach_quarantine(_payload_stats_result(totals), quarantine)
 
 
 def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                          config: HBamConfig = DEFAULT_CONFIG,
                          geometry: Optional[PayloadGeometry] = None,
                          spans=None,
-                         prefetch: int = 2) -> Dict[str, object]:
+                         prefetch: int = 2,
+                         quarantine: Optional[QuarantineManifest] = None,
+                         ) -> Dict[str, object]:
     """Distributed GC / quality / base stats over a FASTQ (or QSEQ) file —
     the text-format twin of seq_stats_file, through the same fused Pallas
     payload kernel."""
@@ -1055,6 +1154,11 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     if spans is None:
         spans = ds.spans(
             num_spans=pipeline_span_count(path, n_dev, config))
+    spans = list(spans)
+    if quarantine is None:
+        quarantine = QuarantineManifest()
+    if quarantine.total_spans is None:
+        quarantine.total_spans = len(spans)
     step = make_read_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
@@ -1071,7 +1175,8 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                 return fragments_to_payload_tiles(
                     frags, geometry.seq_stride, geometry.qual_stride,
                     geometry.max_len)
-            out = decode_with_retry(inner, span, config)
+            out = decode_with_retry(inner, span, config,
+                                    quarantine=quarantine)
             return out if out is not None else (
                 np.empty((0, geometry.seq_stride), np.uint8),
                 np.empty((0, geometry.qual_stride), np.uint8),
@@ -1110,7 +1215,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                 dispatch()
         if group:
             dispatch()
-    return _payload_stats_result(totals)
+    return _attach_quarantine(_payload_stats_result(totals), quarantine)
 
 
 def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
@@ -1118,7 +1223,9 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
                    geometry: Optional[PayloadGeometry] = None,
                    header: Optional[SAMHeader] = None,
                    spans: Optional[Sequence[FileVirtualSpan]] = None,
-                   prefetch: int = 2) -> Dict[str, object]:
+                   prefetch: int = 2,
+                   quarantine: Optional[QuarantineManifest] = None,
+                   ) -> Dict[str, object]:
     """Distributed sequence/quality stats over a whole BAM: mean GC
     fraction, mean per-read quality, and the 4-bit base-code histogram —
     computed by the fused Pallas payload kernel on every device of the
@@ -1147,12 +1254,15 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     step = make_seq_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
     totals = _StatTotals()
+    if quarantine is None:
+        quarantine = QuarantineManifest()
     for stacked, cvec in iter_payload_tile_groups(
-            path, spans, geometry, n_dev, config, prefetch, header=header):
+            path, spans, geometry, n_dev, config, prefetch, header=header,
+            quarantine=quarantine):
         args = [jax.device_put(a, sharding) for a in stacked]
         c = jax.device_put(cvec, sharding)
         totals.add(*step(*args, c))       # async; drained once at the end
-    return _payload_stats_result(totals)
+    return _attach_quarantine(_payload_stats_result(totals), quarantine)
 
 
 def flagstat_file(path: str, mesh: Optional[Mesh] = None,
@@ -1160,7 +1270,9 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                   geometry: Optional[DecodeGeometry] = None,
                   header: Optional[SAMHeader] = None,
                   spans: Optional[Sequence[FileVirtualSpan]] = None,
-                  prefetch: int = 2) -> Dict[str, int]:
+                  prefetch: int = 2,
+                  quarantine: Optional[QuarantineManifest] = None,
+                  ) -> Dict[str, int]:
     """Distributed flagstat over a whole BAM — the minimum end-to-end slice
     (SURVEY.md section 7): plan -> shard -> inflate -> pack prefixes ->
     device reduce.
@@ -1203,6 +1315,12 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     row_bytes = projection_row_bytes(projection)
     step = make_flagstat_tile_step(mesh, projection=projection)
     sharding = NamedSharding(mesh, P("data"))
+    spans = list(spans)
+    if quarantine is None:
+        quarantine = QuarantineManifest()
+    if quarantine.total_spans is None:
+        quarantine.total_spans = len(spans)
+    src = _resilient_source(path, config)
     n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     window = max(1, prefetch) * n_workers
     totals_vec = None
@@ -1213,11 +1331,12 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
         def decode(span):
             def inner(s):
                 rows, _voffs = decode_span_prefix_host(
-                    path, s, check_crc, "auto", projection,
+                    src, s, check_crc, "auto", projection,
                     want_voffs=False, intervals=intervals, header=header)
                 return rows
             with METRICS.timer("pipeline.host_decode"):
-                out = decode_with_retry(inner, span, config)
+                out = decode_with_retry(inner, span, config,
+                                        quarantine=quarantine)
             return out if out is not None \
                 else np.empty((0, row_bytes), dtype=np.uint8)
 
@@ -1260,7 +1379,8 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     else:
         with METRICS.timer("pipeline.device_drain"):
             host = np.asarray(jax.device_get(totals_vec), dtype=np.int64)
-    return {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}
+    return _attach_quarantine(
+        {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}, quarantine)
 
 
 # Coverage row layout: the fixed-field projection (offsets sourced from
@@ -1363,7 +1483,9 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
                   header: Optional[SAMHeader] = None,
                   spans: Optional[Sequence[FileVirtualSpan]] = None,
                   max_cigar: int = 64, tile_records: int = 1 << 15,
-                  prefetch: int = 2) -> np.ndarray:
+                  prefetch: int = 2,
+                  quarantine: Optional[QuarantineManifest] = None,
+                  ) -> np.ndarray:
     """Distributed per-base aligned-base depth over a genomic window —
     the first analysis op past flagstat (SURVEY.md section 7 kernel (b)):
     plan -> shard -> inflate -> pack cigar rows -> device diff-scatter
@@ -1423,16 +1545,21 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
     tref = jax.device_put(np.int32(target_refid), rep)
     wstart = jax.device_put(np.int32(win_start), rep)
 
+    spans = list(spans)
+    if quarantine is not None and quarantine.total_spans is None:
+        quarantine.total_spans = len(spans)
+    src = _resilient_source(path, config)
     with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
         def decode(span):
             def inner(s):
-                return decode_span_cigar_rows(path, s, max_cigar,
+                return decode_span_cigar_rows(src, s, max_cigar,
                                               check_crc)
-            out = decode_with_retry(inner, span, config)
+            out = decode_with_retry(inner, span, config,
+                                    quarantine=quarantine)
             return out if out is not None else np.zeros((0, row_w),
                                                         np.uint8)
 
-        stream = _iter_windowed(pool, list(spans), decode,
+        stream = _iter_windowed(pool, spans, decode,
                                 max(1, prefetch) * n_workers)
         tiles = _iter_tile_tuples(((r,) for r in stream), tile_records,
                                   (row_w,))
@@ -1451,7 +1578,7 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
                           | (t[:c, nc_off + 1].astype(np.int32) << 8))
                     mc = max(mc, int(nc.max()))
             if mc > max_cigar:
-                raise ValueError(
+                raise PlanError(
                     f"record with {mc} cigar ops exceeds "
                     f"max_cigar={max_cigar}; pass a larger max_cigar")
             mc = min(max_cigar, max(8, 1 << (mc - 1).bit_length()))

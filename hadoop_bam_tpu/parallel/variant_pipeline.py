@@ -23,8 +23,9 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hadoop_bam_tpu.parallel.mesh import shard_map
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.vcf import VariantBatch, VCFHeader

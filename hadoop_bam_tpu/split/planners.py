@@ -30,12 +30,21 @@ from hadoop_bam_tpu.formats.virtual_offset import make_voffset
 from hadoop_bam_tpu.split.bam_guesser import BAMSplitGuesser
 from hadoop_bam_tpu.split.spans import FileByteSpan, FileVirtualSpan
 from hadoop_bam_tpu.split.splitting_index import SplittingIndex
+from hadoop_bam_tpu.utils.errors import PlanError
 from hadoop_bam_tpu.utils.seekable import as_byte_source
 
 
 def plan_byte_ranges(size: int, *, num_spans: Optional[int] = None,
                      span_bytes: Optional[int] = None) -> List[Tuple[int, int]]:
-    """Uniform byte ranges — the FileInputFormat.getSplits starting point."""
+    """Uniform byte ranges — the FileInputFormat.getSplits starting point.
+
+    Invalid split parameters raise ``PlanError`` (the PLAN failure class):
+    a bad plan request is a configuration fault that must never be retried
+    or quarantined as if the data were corrupt."""
+    if num_spans is not None and num_spans <= 0:
+        raise PlanError(f"num_spans must be positive, got {num_spans}")
+    if span_bytes is not None and span_bytes <= 0:
+        raise PlanError(f"span_bytes must be positive, got {span_bytes}")
     if size <= 0:
         return []
     if num_spans is not None:
@@ -241,7 +250,15 @@ def plan_spans_maybe_intervals(path: str, header, config,
     if getattr(config, "bam_intervals", None):
         from hadoop_bam_tpu.split.bai import plan_interval_spans
         from hadoop_bam_tpu.split.intervals import parse_intervals
-        ivs = parse_intervals(config.bam_intervals, header.ref_names)
+        try:
+            ivs = parse_intervals(config.bam_intervals, header.ref_names)
+        except PlanError:
+            raise
+        except ValueError as e:
+            # user-supplied interval syntax: PLAN class, never retried or
+            # skip_bad_spans-eaten downstream (still a ValueError)
+            raise PlanError(f"bad bam_intervals "
+                            f"{config.bam_intervals!r}: {e}") from e
         spans = plan_interval_spans(path, ivs, header)
         if spans is not None:
             return spans

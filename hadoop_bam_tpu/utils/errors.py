@@ -1,0 +1,95 @@
+"""Fault taxonomy for the I/O resilience layer.
+
+The reference leaned entirely on MapReduce task retries for fault tolerance
+(SURVEY.md section 5): every failure was retried identically.  Production
+streaming decompressors separate failure *classes* with different policies —
+a transient read error (flaky NFS, object-store throttle, tunnel reset) may
+heal on retry with backoff, while a CRC mismatch or malformed record chain
+is deterministic and re-decoding it only wastes the retry budget.  This
+module is the single place that distinction lives; every policy boundary
+(``decode_with_retry``, ``RetryingByteSource``, ``broadcast_plan``) consults
+``classify_error`` instead of growing its own isinstance ladders.
+
+Classes deliberately multiple-inherit from the builtin they historically
+surfaced as (``OSError`` / ``ValueError``) so pre-taxonomy callers catching
+builtins keep working — classification is additive, not a breaking rename.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+
+# error-class tags (quarantine manifest entries carry these strings)
+TRANSIENT = "transient"
+CORRUPT = "corrupt"
+PLAN = "plan"
+
+
+class HBamError(Exception):
+    """Base of all classified framework errors."""
+
+
+class TransientIOError(HBamError, OSError):
+    """A read/communication failure that may heal on retry: flaky network
+    filesystem, object-store throttling, a dropped tunnel link, an injected
+    chaos fault.  The retry policy backs off and re-attempts these."""
+
+
+class CorruptDataError(HBamError, ValueError):
+    """Deterministic data corruption: bad magic, CRC mismatch, malformed
+    record chain, impossible field values.  Re-decoding the same bytes can
+    never heal it — the policy fails fast (or quarantines the span when
+    ``skip_bad_spans`` is set) without burning retries."""
+
+
+class PlanError(HBamError, ValueError):
+    """A planning / user-parameter error (bad interval syntax, span larger
+    than the device geometry, oversized broadcast payload).  Never retried
+    and never eaten by ``skip_bad_spans``: the run is misconfigured, not
+    the data."""
+
+
+class CircuitBreakerError(HBamError, RuntimeError):
+    """Raised when the quarantined-span fraction crosses
+    ``config.max_bad_span_fraction``: the run aborts loudly instead of
+    silently degrading into a mostly-skipped answer."""
+
+
+# builtins that indicate the environment, not the bytes, failed
+_TRANSIENT_BUILTINS = (TimeoutError, ConnectionError, InterruptedError,
+                       BlockingIOError)
+# deterministic OSErrors: retrying a missing path or a permission wall
+# wastes the budget exactly like corruption would, and quarantining it
+# would silently convert a path typo into an empty result — PLAN class
+_PLAN_BUILTINS = (FileNotFoundError, IsADirectoryError, NotADirectoryError,
+                  PermissionError)
+# builtins raised by the decode stack on bad bytes
+_CORRUPT_BUILTINS = (zlib.error, struct.error, ValueError, IndexError,
+                     KeyError, UnicodeDecodeError, EOFError, OverflowError)
+
+
+def classify_error(exc: BaseException) -> str:
+    """Map an exception to its failure class: TRANSIENT / CORRUPT / PLAN.
+
+    Explicit taxonomy classes win; builtins fall back to their usual
+    meaning on the decode path (most of the OSError family = environment =
+    transient, except the deterministic members like FileNotFoundError
+    which are PLAN; parse/decode errors = bytes = corrupt).  Unknown
+    exceptions classify as CORRUPT: retrying an unknown failure is the old
+    wasteful behavior this layer exists to remove, and fail-fast is the
+    safe default."""
+    if isinstance(exc, PlanError):
+        return PLAN
+    if isinstance(exc, TransientIOError):
+        return TRANSIENT
+    if isinstance(exc, CorruptDataError):
+        return CORRUPT
+    if isinstance(exc, _TRANSIENT_BUILTINS):
+        return TRANSIENT
+    if isinstance(exc, _PLAN_BUILTINS):
+        return PLAN
+    if isinstance(exc, OSError):
+        return TRANSIENT
+    if isinstance(exc, _CORRUPT_BUILTINS):
+        return CORRUPT
+    return CORRUPT

@@ -25,6 +25,22 @@ class Metrics:
         with self._lock:
             self.counters[name] += n
 
+    def get(self, name: str) -> int:
+        """Read one counter without mutating the defaultdict (a bare
+        ``counters[name]`` probe would materialize a zero entry)."""
+        with self._lock:
+            return self.counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Consistent copy of all counters/timers (one lock acquisition) —
+        the hook quarantine/failure reports use to embed resilience counts
+        (pipeline.bad_spans / transient_retries / corrupt_spans,
+        io.read_retries, chaos.injected_faults) without racing the pool."""
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "timers": dict(self.timers),
+                    "timer_calls": dict(self.timer_calls)}
+
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()

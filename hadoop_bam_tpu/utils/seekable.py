@@ -14,7 +14,12 @@ from __future__ import annotations
 import io
 import os
 import threading
-from typing import Union
+from typing import Callable, Optional, Union
+
+# Resilience hook (utils/resilient.py): when chaos injection or a retry
+# wrapper is registered for some path, resilient installs a wrapper here and
+# every path-opened source flows through it.  None = zero-overhead fast path.
+_SOURCE_WRAPPER: Optional[Callable[["ByteSource"], "ByteSource"]] = None
 
 
 class ByteSource:
@@ -48,7 +53,16 @@ class FileByteSource(ByteSource):
     def pread(self, offset: int, size: int) -> bytes:
         if offset >= self.size or size <= 0:
             return b""
-        return os.pread(self._fd, size, offset)
+        try:
+            return os.pread(self._fd, size, offset)
+        except OSError as e:
+            # classify at the policy boundary: a failed positioned read is
+            # an environment fault (EIO on network mounts, stale handles),
+            # not data corruption — retryable upstream
+            from hadoop_bam_tpu.utils.errors import TransientIOError
+            raise TransientIOError(
+                f"pread({offset}, {size}) failed on {self.path}: {e}"
+            ) from e
 
     def close(self) -> None:
         if self._fd >= 0:
@@ -80,7 +94,8 @@ def as_byte_source(obj) -> ByteSource:
     if isinstance(obj, (bytes, bytearray, memoryview)):
         return BytesByteSource(bytes(obj))
     if isinstance(obj, (str, os.PathLike)):
-        return FileByteSource(obj)
+        src = FileByteSource(obj)
+        return _SOURCE_WRAPPER(src) if _SOURCE_WRAPPER is not None else src
     raise TypeError(f"cannot make a ByteSource from {type(obj)!r}")
 
 

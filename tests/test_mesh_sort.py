@@ -116,10 +116,11 @@ def test_mesh_sort_exchange_validation(tmp_path):
 _MULTIHOST_CHILD = """\
 import os, sys
 idx, port, src, out = int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4]
-os.environ["XLA_FLAGS"] = ""   # no inherited forced device count
+# 2 virtual CPU devices per process via XLA_FLAGS: works on every jax
+# (the jax_num_cpu_devices config option only exists on newer releases)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(f"localhost:{port}", num_processes=2,
                            process_id=idx)

@@ -239,10 +239,11 @@ import numpy as np
 idx, port, bam_src, vcf_src, fq_src = (int(sys.argv[1]), sys.argv[2],
                                        sys.argv[3], sys.argv[4],
                                        sys.argv[5])
-os.environ["XLA_FLAGS"] = ""
+# 2 virtual CPU devices per process via XLA_FLAGS: works on every jax
+# (the jax_num_cpu_devices config option only exists on newer releases)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(f"localhost:{port}", num_processes=2,
                            process_id=idx)
