@@ -5,11 +5,16 @@ line is the authoritative result (the driver parses the last line, so
 an external kill at any moment costs at most the in-flight row).
 The top-level keys keep the driver contract
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-for the headline metric (BAM decode records/sec/chip), and add
+for the headline metric (BAM decode records/sec/chip).  Progress lines
+carry the FULL matrix
     "components": [ {metric, value, unit[, vs_baseline]}, ... ]
-covering the whole matrix (BASELINE.md rows): BGZF inflate GB/s, CRAM
-records/s, VCF variants/s, FASTQ reads/s, split-guess p50 latency —
-so per-component regressions are visible in BENCH_r*.json.
+(BASELINE.md rows: BGZF inflate GB/s, CRAM records/s, VCF and BCF
+variants/s, FASTQ reads/s, split-guess p50 latency) so per-component
+regressions are visible in BENCH_r*.json; every full line is followed
+by a compact twin — ``components: {metric: value}`` + ``scaling:
+[[n_dev, rec_s]]``, under FINAL_LINE_BUDGET (~1.5 KB) — so the LAST
+stdout line parses inside the driver's 2000-char tail no matter when
+an external kill lands.
 
 - Baselines, where present, are measured in-process on this host:
   single-thread zlib + NumPy decode (the htsjdk-single-thread analog;
@@ -35,6 +40,9 @@ import numpy as np
 BENCH_RECORDS = int(os.environ.get("BENCH_RECORDS", "300000"))
 CRAM_RECORDS = int(os.environ.get("BENCH_CRAM_RECORDS", "20000"))
 VCF_RECORDS = int(os.environ.get("BENCH_VCF_RECORDS", "100000"))
+# same default count as the VCF fixture ON PURPOSE: the acceptance bar
+# compares bcf_variants_per_sec against vcf_variants_per_sec directly
+BCF_RECORDS = int(os.environ.get("BENCH_BCF_RECORDS", str(VCF_RECORDS)))
 FASTQ_RECORDS = int(os.environ.get("BENCH_FASTQ_RECORDS", "200000"))
 BENCH_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_data")
@@ -101,22 +109,82 @@ def _snapshot(status: str) -> dict:
     return out
 
 
+# the driver tails ~2000 chars of stdout and parses the LAST line; the
+# final line therefore MUST stay under this budget (BASELINE.md r5: the
+# full snapshot grew past it and the round parsed as null)
+FINAL_LINE_BUDGET = 1500
+
+
+def _compact_snapshot(full: dict) -> dict:
+    """The compact line derived from one already-built ``_snapshot``
+    dict (never re-snapshots: ``_snapshot`` mutates notes on a missing
+    headline): headline contract keys plus a compressed matrix —
+    ``components`` as {metric: value} (errors/skips become the strings
+    "error"/"skipped") and ``scaling`` as [[n_dev, flagstat rec/s],
+    ...].  Full per-stage dicts stay on the paired full lines; this
+    line exists to be parseable in a 2000-char stdout tail, and is
+    hard-capped at FINAL_LINE_BUDGET bytes."""
+    comp = {}
+    for c in full["components"]:
+        name = c.get("metric", "?")
+        if isinstance(c.get("value"), (int, float)):
+            comp[name] = c["value"]
+        elif "error" in c:
+            comp[name] = "error"
+        else:
+            comp[name] = "skipped"
+    out = {
+        "metric": full["metric"], "value": full["value"],
+        "unit": full["unit"], "platform": full["platform"],
+        "status": full["status"], "components": comp,
+    }
+    if "vs_baseline" in full:
+        out["vs_baseline"] = full["vs_baseline"]
+    scaling = full.get("scaling")
+    if isinstance(scaling, dict):
+        rows = [[r["n_devices"], r["flagstat_records_per_sec"]]
+                for r in scaling.get("devices", [])
+                if isinstance(r.get("flagstat_records_per_sec"),
+                              (int, float))]
+        if rows:
+            out["scaling"] = sorted(rows)
+    if full.get("notes"):
+        out["notes"] = "; ".join(full["notes"])[:160]
+    while len(json.dumps(out)) > FINAL_LINE_BUDGET:
+        for k in ("notes", "scaling", "components"):
+            if k in out:
+                del out[k]
+                break
+        else:
+            break
+    return out
+
+
+def _emit_pair(status: str) -> None:
+    """One cumulative FULL line (the per-stage detail) followed by its
+    compact twin — so the LAST stdout line is parseable within the
+    driver's tail no matter when an external kill lands, even between
+    components (the r3/r4/r5 loss modes, all three)."""
+    full = _snapshot(status)
+    print(json.dumps(full), flush=True)
+    print(json.dumps(_compact_snapshot(full)), flush=True)
+
+
 def _emit_progress() -> None:
-    """Cumulative line after each component: last line wins downstream."""
     with _EMIT_LOCK:
         if _EMITTED.is_set():
             return
-        print(json.dumps(_snapshot("partial")), flush=True)
+        _emit_pair("partial")
 
 
 def _emit(status: str) -> None:
     # watchdog + main thread can race here; exactly one may print the
-    # final line (progress lines before it are superseded, by contract)
+    # final pair (progress lines before it are superseded, by contract)
     with _EMIT_LOCK:
         if _EMITTED.is_set():
             return
         _EMITTED.set()
-        print(json.dumps(_snapshot(status)), flush=True)
+        _emit_pair(status)
 
 
 _CHILD = {"proc": None}   # in-flight scaling subprocess, for watchdog kill
@@ -347,6 +415,41 @@ def build_vcf_fixture() -> str:
     return path
 
 
+def build_bcf_fixture() -> str:
+    """BGZF BCF twin of the VCF fixture: same schema, same record shape,
+    so the two variant-stats rows are directly comparable."""
+    path = os.path.join(BENCH_DIR, f"bench_{BCF_RECORDS}.bcf")
+    if os.path.exists(path):
+        return path
+    os.makedirs(BENCH_DIR, exist_ok=True)
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+
+    hdr_text = (
+        "##fileformat=VCFv4.2\n"
+        "##contig=<ID=chr20,length=64444167>\n"
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="Depth">\n'
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="Genotype">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\t"
+        "s0\ts1\ts2\n")
+    header = VCFHeader.from_text(hdr_text)
+    rng = random.Random(77)
+    gts = ["0/0", "0/1", "1/1", "./."]
+    tmp = path + ".tmp.bcf"
+    with open_vcf_writer(tmp, header) as w:
+        pos = 1
+        for i in range(BCF_RECORDS):
+            pos += rng.randint(1, 50)
+            ref = rng.choice("ACGT")
+            alt = rng.choice([c for c in "ACGT" if c != ref])
+            g = "\t".join(rng.choice(gts) for _ in range(3))
+            w.write_record(VcfRecord.from_line(
+                f"chr20\t{pos}\t.\t{ref}\t{alt}\t{30 + i % 40}\tPASS\t"
+                f"DP={i % 100}\tGT\t{g}"))
+    os.replace(tmp, path)
+    return path
+
+
 def build_fastq_fixture() -> str:
     path = os.path.join(BENCH_DIR, f"bench_{FASTQ_RECORDS}.fastq")
     if os.path.exists(path):
@@ -567,6 +670,31 @@ def bench_vcf(path: str):
     return {"metric": "vcf_variants_per_sec",
             "value": round(meas, 1), "unit": "variants/s",
             "vs_baseline": round(meas / base, 3)}
+
+
+def bench_bcf(path: str):
+    """Columnar BCF decode (formats/bcf_columns.py) through the same
+    variant-stats driver.  vs_baseline compares against the text-VCF
+    tokenizer row measured just before on the same variant count — the
+    acceptance bar is binary >= text."""
+    from hadoop_bam_tpu.parallel.variant_pipeline import variant_stats_file
+
+    stats, dt = _median_time(lambda: variant_stats_file(path))
+    meas = stats["n_variants"] / dt
+    out = {"metric": "bcf_variants_per_sec",
+           "value": round(meas, 1), "unit": "variants/s"}
+    vcf_row = next((c for c in _STATE["components"]
+                    if c.get("metric") == "vcf_variants_per_sec"
+                    and isinstance(c.get("value"), (int, float))
+                    and c["value"] > 0), None)
+    if vcf_row is not None and VCF_RECORDS == BCF_RECORDS:
+        out["vs_baseline"] = round(meas / vcf_row["value"], 3)
+        out["note"] = ("baseline = the text-VCF tokenizer driver row on "
+                       "the same variant count")
+    else:
+        out["note"] = ("no vs_baseline: vcf_variants_per_sec row missing "
+                       "or fixture sizes differ")
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1256,6 +1384,8 @@ def main() -> None:
                    "cram_tensor_records_per_sec", est_s=25)
     _run_component(lambda: bench_vcf(build_vcf_fixture()),
                    "vcf_variants_per_sec", est_s=25)
+    _run_component(lambda: bench_bcf(build_bcf_fixture()),
+                   "bcf_variants_per_sec", est_s=25)
     _run_component(lambda: bench_fastq(build_fastq_fixture()),
                    "fastq_reads_per_sec", est_s=25)
     _run_component(lambda: bench_bam_write(path),

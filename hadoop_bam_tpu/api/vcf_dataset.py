@@ -186,6 +186,15 @@ class VcfDataset:
         n_workers = min(32, max(4, (_os.cpu_count() or 4) * 4))
         with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
             def decode(span):
+                if self.container is VCFContainer.BCF:
+                    # columnar fast path: no VcfRecord objects
+                    # (formats/bcf_columns.py, record-scan fallback)
+                    from hadoop_bam_tpu.parallel.variant_pipeline import (
+                        bcf_span_stat_columns,
+                    )
+                    return bcf_span_stat_columns(
+                        self.path, span, self.header, geometry,
+                        self._is_bgzf_bcf)
                 return pack_variant_tiles(
                     VariantBatch(self.read_span(span), self.header),
                     geometry)

@@ -76,6 +76,7 @@ _HADOOP_KEY_MAP = {
     "hbam.span-retries": "span_retries",
     "hbam.skip-bad-spans": "skip_bad_spans",
     "hbam.max-bad-span-fraction": "max_bad_span_fraction",
+    "hbam.debug-keep-spill": "debug_keep_spill",
 }
 
 
@@ -131,6 +132,11 @@ class HBamConfig:
     io_read_deadline_s: Optional[float] = None  # per-pread deadline
     check_crc: bool = False          # verify BGZF CRC32 footers on inflate
 
+    # --- debug ---
+    debug_keep_spill: bool = False   # keep mesh-sort .mesh-spill run dirs
+    #                                  for post-mortem instead of removing
+    #                                  them in the sort's finally
+
     # --- split planning ---
     split_size: int = 128 * 1024 * 1024   # analog of HDFS block size splits
     splitting_index_granularity: int = 4096  # records per splitting-bai sample
@@ -168,7 +174,8 @@ def _coerce(kwargs: dict) -> dict:
     for k in ("trust_exts", "vcf_trust_exts", "fastq_filter_failed_qc",
               "qseq_filter_failed_qc", "write_header", "write_terminator",
               "use_splitting_index", "use_native",
-              "keep_paired_reads_together", "skip_bad_spans"):
+              "keep_paired_reads_together", "skip_bad_spans",
+              "debug_keep_spill"):
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
     for k in ("max_bad_span_fraction", "retry_backoff_base_s",

@@ -431,6 +431,35 @@ def _sort_bam_mesh_bytes_spill(input_path: str, output_path: str, *, mesh,
                                config: HBamConfig,
                                header: Optional[SAMHeader],
                                round_records: int) -> int:
+    """Spill-exchange entry: runs the rounds and ALWAYS removes the
+    ``.mesh-spill`` run directory afterwards — success or failure — so
+    an exception mid-round/mid-merge cannot strand spilled runs that
+    approach the input's size (ADVICE r5).  ``config.debug_keep_spill``
+    preserves the directory for post-mortem.
+
+    Multi-host note: removal happens on host 0 only, and every raise
+    inside the impl is preceded by the round/merge error-flag
+    allgathers, so by the time any host unwinds into this finally all
+    hosts have stopped writing — host 0's rmtree cannot race a writer.
+    """
+    import shutil
+
+    import jax
+
+    try:
+        return _sort_bam_mesh_bytes_spill_impl(
+            input_path, output_path, mesh=mesh, config=config,
+            header=header, round_records=round_records)
+    finally:
+        if not bool(getattr(config, "debug_keep_spill", False)) \
+                and jax.process_index() == 0:
+            shutil.rmtree(output_path + ".mesh-spill", ignore_errors=True)
+
+
+def _sort_bam_mesh_bytes_spill_impl(input_path: str, output_path: str, *,
+                                    mesh, config: HBamConfig,
+                                    header: Optional[SAMHeader],
+                                    round_records: int) -> int:
     """Multi-round byte exchange (VERDICT r4 #6): device memory bounded
     by the ROUND tile, not the file.
 
@@ -648,7 +677,7 @@ def _sort_bam_mesh_bytes_spill(input_path: str, output_path: str, *, mesh,
                 payload, k = _merge_bucket_runs(run_files.get(b, []))
                 w.write_raw(payload, n_records=k)
                 written += k
-        shutil.rmtree(shard_dir, ignore_errors=True)
+        # spill-dir removal lives in the caller's finally
     else:
         try:
             for b in sorted(local_pos):
@@ -685,7 +714,7 @@ def _sort_bam_mesh_bytes_spill(input_path: str, output_path: str, *, mesh,
                         f"time: {missing[:3]} — is {shard_dir} on a "
                         f"filesystem shared by all hosts?")
                 merge_bam_shards_reblocked(parts, output_path, out_header)
-                shutil.rmtree(shard_dir, ignore_errors=True)
+                # spill-dir removal lives in the caller's finally
             except Exception as e:  # noqa: BLE001 — must reach the barrier
                 final_err = e
         ok = np.asarray([0 if final_err is not None else 1], np.int32)

@@ -813,16 +813,19 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
         counts: List[int] = []
 
         def emit() -> Tuple[List[np.ndarray], np.ndarray]:
-            b = _bucket_cap(max(counts), cap, geometry.block_n)
-            stacked = [np.stack([g[j][:b] for g in group])
-                       for j in range(len(widths))]
+            # per-device bucket caps: the dispatch height must be shared
+            # (one shard_map step), but each device only copies its OWN
+            # rows into the zeroed group tile — one skewed device no
+            # longer makes the other seven memcpy its padding
+            b = max(_bucket_cap(c, cap, geometry.block_n) for c in counts)
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts
-            if stacked[0].shape[0] < n_dev:
-                for j, w in enumerate(widths):
-                    pad = np.zeros((n_dev - stacked[j].shape[0], b, w),
-                                   dtype=np.uint8)
-                    stacked[j] = np.concatenate([stacked[j], pad])
+            stacked = []
+            for j, w in enumerate(widths):
+                out = np.zeros((n_dev, b, w), dtype=np.uint8)
+                for i, g in enumerate(group):
+                    out[i, :counts[i]] = g[j][:counts[i]]
+                stacked.append(out)
             group.clear()
             counts.clear()
             return stacked, cvec
@@ -991,15 +994,17 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
         counts: List[int] = []
 
         def emit() -> Dict:
-            b = _bucket_cap(max(counts), cap, geometry.block_n)
+            # per-device bucket caps (see iter_payload_tile_groups.emit)
+            b = max(_bucket_cap(c, cap, geometry.block_n) for c in counts)
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts
             stacked = []
             for j in range(3):
-                arrs = [g[j][:b] for g in group]
-                while len(arrs) < n_dev:
-                    arrs.append(np.zeros_like(arrs[0]))
-                stacked.append(np.stack(arrs))
+                proto = group[0][j]
+                out = np.zeros((n_dev, b) + proto.shape[1:], proto.dtype)
+                for i, g in enumerate(group):
+                    out[i, :counts[i]] = g[j][:counts[i]]
+                stacked.append(out)
             out = {
                 "seq_packed": jax.device_put(stacked[0], sharding),
                 "qual": jax.device_put(stacked[1], sharding),

@@ -30,8 +30,13 @@ from hadoop_bam_tpu.parallel.mesh import shard_map
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.vcf import VariantBatch, VCFHeader
 from hadoop_bam_tpu.parallel.pipeline import (
-    _STEP_CACHE, _StatTotals, _iter_windowed, pipeline_span_count,
+    _STEP_CACHE, _StatTotals, _bucket_cap, _iter_windowed,
+    pipeline_span_count,
 )
+
+# dispatch-bucket granularity for variant tiles (no Pallas block
+# constraint on this path; 64 keeps the jit shape ladder tiny)
+_VARIANT_BLOCK_N = 64
 
 
 def _round_up(x: int, m: int) -> int:
@@ -174,6 +179,30 @@ def _pack_variant_tiles_from_text_scalar(text: bytes, header: VCFHeader,
         n += 1
     return {"chrom": chrom[:n], "pos": pos[:n], "flags": flags[:n],
             "dosage": dosage[:n]}
+
+
+def bcf_span_stat_columns(path: str, span, header: VCFHeader,
+                          geometry: VariantGeometry,
+                          is_bgzf: Optional[bool] = None
+                          ) -> Dict[str, np.ndarray]:
+    """One BCF span -> stats tile columns via the columnar decoder
+    (formats/bcf_columns.py): the span walk frames records for free,
+    one vectorized pass decodes them.  Spans the columnar path declines
+    (pathological geometry) fall back to the record-serial scanner with
+    identical output — the binary twin of the text tokenizer's
+    vectorized/scalar split above."""
+    from hadoop_bam_tpu.formats.bcf_columns import (
+        decode_bcf_columns, stat_columns,
+    )
+    from hadoop_bam_tpu.split.vcf_planners import read_bcf_span_frames
+
+    raw, starts = read_bcf_span_frames(path, span, is_bgzf)
+    cols = decode_bcf_columns(raw, header, geometry.samples_pad,
+                              starts=starts)
+    if cols is not None:
+        return stat_columns(cols)
+    from hadoop_bam_tpu.formats.bcf import scan_variant_columns
+    return scan_variant_columns(raw, header, geometry.samples_pad)
 
 
 _ALT_W = 16            # widest ALT the vectorized SNP test gathers
@@ -345,18 +374,27 @@ def _pack_variant_text_vectorized(text: bytes, header: VCFHeader,
 def _iter_variant_tiles(cols_stream, cap: int, geometry: VariantGeometry
                         ) -> Iterator[Tuple[Dict[str, np.ndarray], int]]:
     """Repack a stream of per-span column dicts into cap-row tiles
-    (cross-span concatenation; only the final tile is padded)."""
+    (cross-span concatenation; only the final tile is padded).
+
+    The tile schema is taken from the first span's dict, so the feed
+    accepts both the stats schema (chrom/pos/flags/dosage) and extended
+    columnar dicts (e.g. formats/bcf_columns.py's rlen/qual/n_allele/
+    n_fmt columns) without either side hard-coding the other."""
     parts: List[Dict[str, np.ndarray]] = []
     have = 0
-    S = geometry.samples_pad
+    proto: Dict[str, np.ndarray] = {}
 
     def empty_tile() -> Dict[str, np.ndarray]:
-        return {
-            "chrom": np.zeros(cap, np.int32),
-            "pos": np.zeros(cap, np.int32),
-            "flags": np.zeros(cap, np.uint8),
-            "dosage": np.full((cap, S), -1, np.int8),
-        }
+        out = {}
+        for k, v in proto.items():
+            shape = (cap,) + v.shape[1:]
+            if k == "dosage":
+                out[k] = np.full(shape, -1, v.dtype)
+            elif k == "qual":
+                out[k] = np.full(shape, np.nan, v.dtype)
+            else:
+                out[k] = np.zeros(shape, v.dtype)
+        return out
 
     def emit(take: int) -> Tuple[Dict[str, np.ndarray], int]:
         nonlocal have
@@ -376,6 +414,8 @@ def _iter_variant_tiles(cols_stream, cap: int, geometry: VariantGeometry
         return tile, take
 
     for cols in cols_stream:
+        if not proto:
+            proto = cols
         if cols["chrom"].shape[0]:
             parts.append(cols)
             have += cols["chrom"].shape[0]
@@ -476,15 +516,8 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
                 if text is not None:  # fast tokenizer, no record objects
                     return pack_variant_tiles_from_text(text, header,
                                                         geometry)
-                # BCF: binary fast scan — skips ID/INFO and non-GT FORMAT
-                # fields entirely
-                from hadoop_bam_tpu.formats.bcf import scan_variant_columns
-                from hadoop_bam_tpu.split.vcf_planners import (
-                    read_bcf_span_bytes,
-                )
-                raw = read_bcf_span_bytes(ds.path, s, ds._is_bgzf_bcf)
-                return scan_variant_columns(raw, header,
-                                            geometry.samples_pad)
+                return bcf_span_stat_columns(ds.path, s, header, geometry,
+                                             ds._is_bgzf_bcf)
             out = decode_with_retry(inner, span, config)
             if out is not None:
                 return out
@@ -495,16 +528,24 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
         counts: List[int] = []
 
         def dispatch():
+            # the dispatch height is shared across the mesh (one
+            # shard_map step), but each device only pays copy work for
+            # its own rows: a skewed device no longer makes the other
+            # seven copy its padding, and the FINAL partial group
+            # shrinks to the smallest bucket that holds the largest
+            # per-device count (the small-input dispatch floor,
+            # mirroring pipeline.py's payload emit)
+            b = max(_bucket_cap(c, cap, _VARIANT_BLOCK_N)
+                    for c in counts)
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts
-            stacked = {}
-            for k in group[0]:
-                arrs = [g[k] for g in group]
-                while len(arrs) < n_dev:
-                    arrs.append(np.zeros_like(arrs[0]))
-                stacked[k] = np.stack(arrs)
-            args = [jax.device_put(stacked[k], sharding)
-                    for k in ("chrom", "pos", "flags", "dosage")]
+            args = []
+            for k in ("chrom", "pos", "flags", "dosage"):
+                proto = group[0][k]
+                out = np.zeros((n_dev, b) + proto.shape[1:], proto.dtype)
+                for i, g in enumerate(group):
+                    out[i, :counts[i]] = g[k][:counts[i]]
+                args.append(jax.device_put(out, sharding))
             c = jax.device_put(cvec, sharding)
             totals.add(*step(*args, c))   # async; drained once at the end
             group.clear()

@@ -259,3 +259,34 @@ def test_bytes_and_spill_on_single_device_mesh(tmp_path):
         n = sort_bam_mesh(path, out, mesh=mesh1, **kw)
         assert n == 500
         assert open(out, "rb").read() == open(ref, "rb").read(), label
+
+
+def test_spill_dir_removed_on_success_and_failure(tmp_path, monkeypatch):
+    """The .mesh-spill run directory must not survive the sort — neither
+    a clean run nor one that dies mid-merge (ADVICE r5) — unless the
+    debug_keep_spill knob asks for the post-mortem."""
+    import dataclasses
+
+    import hadoop_bam_tpu.parallel.mesh_sort as ms
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+
+    header = make_header()
+    recs = make_records(header, 600, seed=21)
+    path = _write_shuffled(tmp_path, recs, header, seed=9)
+    out = str(tmp_path / "o.bam")
+    spill = out + ".mesh-spill"
+
+    sort_bam_mesh(path, out, round_records=100)
+    assert not os.path.exists(spill)
+
+    def boom(run_paths):
+        raise RuntimeError("injected merge failure")
+    monkeypatch.setattr(ms, "_merge_bucket_runs", boom)
+    with pytest.raises(RuntimeError, match="injected merge failure"):
+        sort_bam_mesh(path, out + "2", round_records=100)
+    assert not os.path.exists(out + "2.mesh-spill")
+
+    cfg = dataclasses.replace(DEFAULT_CONFIG, debug_keep_spill=True)
+    with pytest.raises(RuntimeError, match="injected merge failure"):
+        sort_bam_mesh(path, out + "3", round_records=100, config=cfg)
+    assert os.path.isdir(out + "3.mesh-spill")      # kept for autopsy
