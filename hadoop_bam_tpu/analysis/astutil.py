@@ -1,0 +1,167 @@
+"""Shared AST plumbing for the hbam-lint analyzers.
+
+Small, dependency-free helpers: dotted-name rendering, import maps,
+function collection with lexical scope chains, and call-site argument
+to parameter matching.  Analyzers stay declarative; the tree-walking
+mechanics live here.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for an Attribute/Name chain; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node: ast.AST) -> Optional[str]:
+    """'psum' for jax.lax.psum / psum; None when the callee is not a
+    name chain (e.g. a subscript or a call result)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> imported dotted path, for both import flavors:
+    ``import numpy as np`` -> {'np': 'numpy'};
+    ``from jax.experimental import multihost_utils`` ->
+    {'multihost_utils': 'jax.experimental.multihost_utils'}."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function definition with its lexical position."""
+    node: ast.AST                      # FunctionDef | AsyncFunctionDef
+    module_path: str                   # repo-relative path
+    qualname: str                      # outer.inner
+    parent: Optional["FuncInfo"]
+    children: Dict[str, "FuncInfo"] = dataclasses.field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def params(self) -> List[str]:
+        """Named parameters that bind values directly.  ``*args`` /
+        ``**kwargs`` are excluded on purpose: they bind *containers* of
+        arguments (iterating a tuple of tracers is a static unroll, not a
+        data-dependent loop), so treating them as traced values would
+        flood Pallas kernels' ``*out_refs`` loops with false TS103s."""
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+def collect_functions(tree: ast.Module, module_path: str
+                      ) -> Tuple[Dict[str, FuncInfo], List[FuncInfo]]:
+    """(top-level name -> FuncInfo, all FuncInfos incl. nested)."""
+    top: Dict[str, FuncInfo] = {}
+    every: List[FuncInfo] = []
+
+    def visit(node: ast.AST, parent: Optional[FuncInfo], prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                fi = FuncInfo(child, module_path, qn, parent)
+                every.append(fi)
+                if parent is None:
+                    top[child.name] = fi
+                else:
+                    parent.children[child.name] = fi
+                visit(child, fi, qn + ".")
+            elif isinstance(child, ast.ClassDef):
+                # methods live under the class qualname; lexical chain stays
+                # at the enclosing function (class bodies don't close over)
+                visit(child, parent, f"{prefix}{child.name}.")
+            else:
+                visit(child, parent, prefix)
+
+    visit(tree, None, "")
+    return top, every
+
+
+def enclosing_function(every: Sequence[FuncInfo],
+                       node: ast.AST) -> Optional[FuncInfo]:
+    """The innermost FuncInfo whose body span contains ``node`` (by line
+    range; good enough for call-site scoping)."""
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return None
+    best: Optional[FuncInfo] = None
+    for fi in every:
+        n = fi.node
+        end = getattr(n, "end_lineno", n.lineno)
+        if n.lineno <= line <= end:
+            if best is None or n.lineno >= best.node.lineno:
+                best = fi
+    return best
+
+
+def resolve_name(name: str, context: Optional[FuncInfo],
+                 top: Dict[str, FuncInfo]) -> Optional[FuncInfo]:
+    """Lexical lookup of a bare function name from a context function:
+    the context's own nested defs, then each enclosing function's, then
+    the module top level."""
+    scope = context
+    while scope is not None:
+        if name in scope.children:
+            return scope.children[name]
+        scope = scope.parent
+    return top.get(name)
+
+
+def match_args_to_params(call: ast.Call, fn: FuncInfo
+                         ) -> List[Tuple[ast.AST, str]]:
+    """(argument expr, parameter name) pairs for a call of ``fn``;
+    *args/**kwargs forwarding is skipped (we only track simple flow)."""
+    a = fn.node.args
+    pos_params = [p.arg for p in a.posonlyargs + a.args]
+    out: List[Tuple[ast.AST, str]] = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(pos_params):
+            out.append((arg, pos_params[i]))
+    kw_ok = set(pos_params) | {p.arg for p in a.kwonlyargs}
+    for kw in call.keywords:
+        if kw.arg and kw.arg in kw_ok:
+            out.append((kw.value, kw.arg))
+    return out
+
+
+def const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('a', 'b') for a literal str / tuple/list of str; else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            vals.append(e.value)
+        return tuple(vals)
+    return None

@@ -1,0 +1,261 @@
+"""LC4xx — binary-layout contracts: hand-written offsets vs the spec table.
+
+SAGe-style data-prep bugs concentrate in hand-addressed binary layouts:
+a `+ 16` that should be `+ 18`, a two-byte read of a four-byte field, a
+new struct format nobody registered.  ``analysis/layout_specs.py``
+declares every fixed-struct field once; this analyzer cross-checks the
+code against it.
+
+Rules:
+
+- LC401 unregistered struct format: a literal ``struct.pack/unpack``
+  format string in ``formats/`` or ``split/`` that is not in
+  ``KNOWN_FORMATS``.
+- LC402 spec table self-inconsistency (field gaps/overlaps, calcsize
+  mismatch) — the contract itself must be well-formed.
+- LC403 offset contract violation: a hard-coded offset in a contracted
+  function that does not land on a declared field (multi-byte reads
+  must exactly cover contiguous field runs; single-byte reads must fall
+  inside a field).
+- LC404 runtime mirror drift: a runtime field table (e.g.
+  ``ops/unpack_bam.FIXED_FIELDS``) disagrees with its spec row.
+"""
+from __future__ import annotations
+
+import ast
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_bam_tpu.analysis.astutil import collect_functions, last_segment
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+from hadoop_bam_tpu.analysis.layout_specs import (
+    KNOWN_FORMATS, OFFSET_CONTRACTS, RUNTIME_MIRRORS, SPECS, spec_self_check,
+)
+
+SCOPE = ("hadoop_bam_tpu/formats", "hadoop_bam_tpu/split")
+
+_STRUCT_CALLS = {"pack", "unpack", "unpack_from", "pack_into", "calcsize",
+                 "iter_unpack", "Struct"}
+
+
+def _struct_format(node: ast.Call) -> Optional[str]:
+    """The literal format string of a struct.* call, else None."""
+    f = node.func
+    is_struct = (isinstance(f, ast.Attribute)
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id == "struct" and f.attr in _STRUCT_CALLS) \
+        or (isinstance(f, ast.Name) and f.id == "Struct")
+    if not is_struct or not node.args:
+        return None
+    a0 = node.args[0]
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        return a0.value
+    return None
+
+
+def _cursor_offset(node: ast.AST, cursor: str) -> Optional[int]:
+    """Byte offset relative to ``cursor`` for `cursor`, `cursor + k`
+    (any association of constant additions); None when not of that shape."""
+    if isinstance(node, ast.Name):
+        return 0 if node.id == cursor else None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        for a, b in ((node.left, node.right), (node.right, node.left)):
+            if isinstance(b, ast.Constant) and isinstance(b.value, int):
+                base = _cursor_offset(a, cursor)
+                if base is not None:
+                    return base + b.value
+    return None
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+class _ContractChecker:
+    def __init__(self, contract, fn_node: ast.AST, path: str):
+        self.contract = contract
+        self.fn = fn_node
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def _check_span(self, spec_name: str, base: int, offset: int,
+                    width: Optional[int], node: ast.AST, what: str) -> None:
+        spec = SPECS.get(spec_name)
+        if spec is None:
+            self.findings.append(Finding(
+                rule="LC403", severity="error", path=self.path,
+                line=node.lineno,
+                message=f"offset contract names unknown spec "
+                        f"{spec_name!r}"))
+            return
+        abs_off = base + offset
+        if width is None:
+            if spec.field_at(abs_off) is None:
+                self.findings.append(Finding(
+                    rule="LC403", severity="error", path=self.path,
+                    line=node.lineno,
+                    message=f"{what} reads byte {abs_off} of "
+                            f"'{spec_name}' — not inside any declared "
+                            f"field {spec.tag}"))
+        elif spec.run_at(abs_off, width) is None:
+            self.findings.append(Finding(
+                rule="LC403", severity="error", path=self.path,
+                line=node.lineno,
+                message=f"{what} reads bytes [{abs_off}, "
+                        f"{abs_off + width}) of '{spec_name}' — does not "
+                        f"cover a whole declared field run {spec.tag}"))
+
+    def check(self) -> List[Finding]:
+        cursors = self.contract.cursors
+        tiles = self.contract.tiles
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Subscript):
+                self._check_subscript(node, cursors, tiles)
+            elif isinstance(node, ast.Call):
+                self._check_unpack_from(node, cursors)
+        return self.findings
+
+    def _check_subscript(self, node: ast.Subscript, cursors, tiles) -> None:
+        sl = node.slice
+        # tile[:, a] / tile[:, a:b]
+        if isinstance(node.value, ast.Name) and node.value.id in tiles \
+                and isinstance(sl, ast.Tuple) and len(sl.elts) == 2:
+            spec_name, base = tiles[node.value.id]
+            col = sl.elts[1]
+            if isinstance(col, ast.Slice) and col.lower is not None \
+                    and col.upper is not None and col.step is None:
+                a, b = _const_int(col.lower), _const_int(col.upper)
+                if a is not None and b is not None and b > a:
+                    self._check_span(spec_name, base, a, b - a, node,
+                                     f"tile slice [{a}:{b}]")
+            else:
+                a = _const_int(col)
+                if a is not None:
+                    self._check_span(spec_name, base, a, None, node,
+                                     f"tile column {a}")
+            return
+        # data[cur + a] / data[cur + a : cur + b]
+        for cur, (spec_name, base) in cursors.items():
+            if isinstance(sl, ast.Slice) and sl.step is None \
+                    and sl.lower is not None and sl.upper is not None:
+                a = _cursor_offset(sl.lower, cur)
+                b = _cursor_offset(sl.upper, cur)
+                if a is not None and b is not None and b > a:
+                    self._check_span(spec_name, base, a, b - a, node,
+                                     f"slice [{cur}+{a}:{cur}+{b}]")
+                    return
+            else:
+                a = _cursor_offset(sl, cur)
+                if a is not None and a > 0:
+                    # bare `data[cur]` (a == 0) is a record-start peek,
+                    # not a field claim
+                    self._check_span(spec_name, base, a, None, node,
+                                     f"byte read [{cur}+{a}]")
+                    return
+
+    def _check_unpack_from(self, node: ast.Call, cursors) -> None:
+        fmt = _struct_format(node)
+        f = node.func
+        if fmt is None or not isinstance(f, ast.Attribute) \
+                or f.attr not in ("unpack_from", "pack_into"):
+            return
+        if len(node.args) < 3:
+            return
+        try:
+            width = struct.calcsize(fmt)
+        except struct.error:
+            return
+        for cur, (spec_name, base) in cursors.items():
+            off = _cursor_offset(node.args[2], cur)
+            if off is not None:
+                self._check_span(spec_name, base, off, width, node,
+                                 f"struct.{f.attr}({fmt!r})")
+                return
+
+
+@register("layout")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # LC402: the contract table must itself be well-formed
+    for spec in SPECS.values():
+        for problem in spec_self_check(spec):
+            findings.append(Finding(
+                rule="LC402", severity="error",
+                path="hadoop_bam_tpu/analysis/layout_specs.py", line=1,
+                message=f"spec '{spec.name}' inconsistent: {problem}"))
+
+    # LC401: literal struct formats must be registered
+    for m in project.select(SCOPE):
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call):
+                fmt = _struct_format(node)
+                if fmt is not None and fmt not in KNOWN_FORMATS:
+                    findings.append(Finding(
+                        rule="LC401", severity="error", path=m.path,
+                        line=node.lineno,
+                        message=f"struct format {fmt!r} is not registered "
+                                f"in analysis/layout_specs.KNOWN_FORMATS — "
+                                f"declare the layout it addresses"))
+
+    # LC403: contracted functions' hard-coded offsets
+    fn_index: Dict[Tuple[str, str], ast.AST] = {}
+    for m in project.modules:
+        _top, every = collect_functions(m.tree, m.path)
+        for fi in every:
+            fn_index[(m.path, fi.qualname)] = fi.node
+    for contract in OFFSET_CONTRACTS:
+        fn = fn_index.get((contract.path, contract.function))
+        if fn is None:
+            if contract.path in project.by_path:
+                findings.append(Finding(
+                    rule="LC403", severity="warning", path=contract.path,
+                    line=1,
+                    message=f"offset contract names missing function "
+                            f"'{contract.function}' — update "
+                            f"analysis/layout_specs.OFFSET_CONTRACTS"))
+            continue
+        findings.extend(
+            _ContractChecker(contract, fn, contract.path).check())
+
+    # LC404: runtime field tables must mirror their spec
+    for path, var, spec_name in RUNTIME_MIRRORS:
+        m = project.by_path.get(path)
+        spec = SPECS.get(spec_name)
+        if m is None or spec is None:
+            continue
+        table = None
+        line = 1
+        for node in m.tree.body:
+            targets = node.targets if isinstance(node, ast.Assign) else \
+                [node.target] if isinstance(node, ast.AnnAssign) else []
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == var \
+                        and getattr(node, "value", None) is not None:
+                    try:
+                        table = ast.literal_eval(node.value)
+                        line = node.lineno
+                    except ValueError:
+                        pass
+        if not isinstance(table, dict):
+            findings.append(Finding(
+                rule="LC404", severity="warning", path=path, line=line,
+                message=f"runtime mirror '{var}' not found as a literal "
+                        f"dict — cannot cross-check against "
+                        f"'{spec_name}'"))
+            continue
+        declared = {f.name: (f.offset, f.width) for f in spec.fields}
+        got = {}
+        for name, val in table.items():
+            if isinstance(val, (tuple, list)) and len(val) >= 2:
+                got[name] = (int(val[0]), int(val[1]))
+        if got != declared:
+            drift = sorted(set(got.items()) ^ set(declared.items()))
+            findings.append(Finding(
+                rule="LC404", severity="error", path=path, line=line,
+                message=f"runtime table '{var}' drifted from spec "
+                        f"'{spec_name}': {drift}"))
+    return findings

@@ -1,0 +1,237 @@
+"""Declarative binary-layout contracts for the LC4xx analyzer.
+
+Every fixed-struct field the decoders hand-address is declared ONCE here
+— name, byte offset, width, dtype, with the provenance tag the repo uses
+in code comments ([SPEC] = stated by the format spec).  The layout
+analyzer cross-checks three things against this table:
+
+1. every ``struct.pack/unpack`` *literal* format string in ``formats/``
+   and ``split/`` is registered in ``KNOWN_FORMATS`` (an unknown format
+   means a new layout grew without a contract);
+2. hard-coded offsets in the functions listed in ``OFFSET_CONTRACTS``
+   land exactly on declared fields (multi-byte reads must cover whole
+   contiguous field runs; single-byte reads must fall inside a field);
+3. the table itself is self-consistent (contiguous fields, widths sum
+   to the struct size, format strings calcsize-match) and agrees with
+   the runtime mirror ``ops/unpack_bam.FIXED_FIELDS``.
+
+Sources: SAMv1 spec section 4.2 (BAM), RFC1952 + SAMv1 section 4.1
+(BGZF), VCFv4.x spec section 6.3 (BCF record encoding), CRAMv3 spec
+section 6 (file definition).
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    offset: int
+    width: int
+    dtype: str          # "u8"/"i32"/"u16"/"f32"/"bytes"/...
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutSpec:
+    name: str
+    doc: str
+    fields: Tuple[Field, ...]
+    fmt: Optional[str] = None     # struct format covering the whole layout
+    tag: str = "[SPEC]"
+
+    @property
+    def size(self) -> int:
+        return sum(f.width for f in self.fields)
+
+    def field_at(self, offset: int) -> Optional[Field]:
+        """The field containing byte ``offset`` (for single-byte reads)."""
+        for f in self.fields:
+            if f.offset <= offset < f.offset + f.width:
+                return f
+        return None
+
+    def run_at(self, offset: int, width: int) -> Optional[Tuple[Field, ...]]:
+        """The contiguous field run exactly covering [offset, offset+width),
+        or None when the span misaligns field boundaries."""
+        run = []
+        pos = offset
+        end = offset + width
+        for f in sorted(self.fields, key=lambda f: f.offset):
+            if f.offset == pos and f.offset + f.width <= end:
+                run.append(f)
+                pos = f.offset + f.width
+                if pos == end:
+                    return tuple(run)
+        return None
+
+
+def _spec(name: str, doc: str, fields, fmt=None, tag="[SPEC]") -> LayoutSpec:
+    return LayoutSpec(name=name, doc=doc, fmt=fmt, tag=tag,
+                      fields=tuple(Field(*f) for f in fields))
+
+
+SPECS: Dict[str, LayoutSpec] = {s.name: s for s in [
+    _spec(
+        "bam.record_prefix",
+        "BAM alignment record fixed 36-byte prefix (SAMv1 section 4.2); "
+        "runtime mirror: ops/unpack_bam.FIXED_FIELDS",
+        [("block_size", 0, 4, "i32"), ("refid", 4, 4, "i32"),
+         ("pos", 8, 4, "i32"), ("l_read_name", 12, 1, "u8"),
+         ("mapq", 13, 1, "u8"), ("bin", 14, 2, "u16"),
+         ("n_cigar", 16, 2, "u16"), ("flag", 18, 2, "u16"),
+         ("l_seq", 20, 4, "i32"), ("mate_refid", 24, 4, "i32"),
+         ("mate_pos", 28, 4, "i32"), ("tlen", 32, 4, "i32")],
+        fmt="<iiiBBHHHiiii"),
+    _spec(
+        "bam.header_prefix",
+        "BAM file header: magic + l_text (SAMv1 section 4.2)",
+        [("magic", 0, 4, "bytes"), ("l_text", 4, 4, "i32")]),
+    _spec(
+        "bgzf.header",
+        "BGZF block header fixed bytes before FEXTRA (RFC1952 + SAMv1 "
+        "section 4.1)",
+        [("id1", 0, 1, "u8"), ("id2", 1, 1, "u8"), ("cm", 2, 1, "u8"),
+         ("flg", 3, 1, "u8"), ("mtime", 4, 4, "u32"), ("xfl", 8, 1, "u8"),
+         ("os", 9, 1, "u8"), ("xlen", 10, 2, "u16")],
+        fmt="<BBBBIBBH"),
+    _spec(
+        "bgzf.bc_subfield",
+        "BGZF BC extra subfield: SI1 SI2 SLEN BSIZE (SAMv1 section 4.1)",
+        [("si1", 0, 1, "u8"), ("si2", 1, 1, "u8"), ("slen", 2, 2, "u16"),
+         ("bsize", 4, 2, "u16")],
+        fmt="<BBHH"),
+    _spec(
+        "bgzf.footer",
+        "BGZF block trailer: CRC32 + ISIZE (RFC1952)",
+        [("crc32", 0, 4, "u32"), ("isize", 4, 4, "u32")],
+        fmt="<II"),
+    _spec(
+        "bcf.record",
+        "BCF record frame + 24-byte fixed shared prefix (VCFv4.x "
+        "section 6.3.1); bcf_columns gathers bytes 8..32 as one tile",
+        [("l_shared", 0, 4, "u32"), ("l_indiv", 4, 4, "u32"),
+         ("chrom", 8, 4, "i32"), ("pos", 12, 4, "i32"),
+         ("rlen", 16, 4, "i32"), ("qual", 20, 4, "f32"),
+         ("n_info", 24, 2, "u16"), ("n_allele", 26, 2, "u16"),
+         ("n_sample24", 28, 3, "u24"), ("n_fmt", 31, 1, "u8")]),
+    _spec(
+        "cram.file_definition",
+        "CRAM file definition block (CRAMv3 section 6)",
+        [("magic", 0, 4, "bytes"), ("major", 4, 1, "u8"),
+         ("minor", 5, 1, "u8"), ("file_id", 6, 20, "bytes")]),
+]}
+
+
+# Every *literal* struct format string formats/ and split/ are allowed to
+# use, with what layout it belongs to.  A format not in this registry is
+# an LC401 finding: a new hand-addressed layout grew without a contract.
+KNOWN_FORMATS: Dict[str, str] = {
+    "<iiBBHHHiiii": "bam.record_prefix fields after block_size "
+                    "(formats/bam.py record encode)",
+    "<i": "single int32 scalar (BAM block_size / l_text / n_ref / "
+          "l_name / counts)",
+    "<I": "single uint32 scalar (CRC32 / ISIZE / BGZF bsize / "
+          "tok3 ulen)",
+    "<f": "single float32 scalar (BCF QUAL / typed value)",
+    "<H": "single uint16 scalar (BGZF XLEN/SLEN/BSIZE, rANS freq, "
+          "fqzcomp len)",
+    "<HH": "BCF n_info + n_allele pair (bcf.record bytes 24..28)",
+    "<ii": "BCF chrom + pos pair (bcf.record bytes 8..16)",
+    "<iii": "BCF chrom + pos + rlen (bcf.record bytes 8..20) / "
+            "BAI interval triple",
+    "<II": "BCF l_shared + l_indiv frame (bcf.record bytes 0..8) / "
+           "BGZF footer / vcf_planners frame peek",
+    "<Ii": "BAI/tabix n_bin or bin id + count pairs",
+    "<IQi": "BAI pseudo-bin: bin id + voffset + count (split/bai.py)",
+    "<Q": "single uint64 virtual offset (BAI/tabix/splitting-index)",
+    "<QQ": "virtual-offset pair (BAI chunk / tabix chunk / "
+           "splitting-index span)",
+    "<QQQ": "splitting-index record triple (split/splitting_index.py)",
+    ">Q": "splitting-index big-endian magic/version stamp",
+    "<8i": "tabix header int block: n_ref..l_nm (split/tabix.py)",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class OffsetContract:
+    """Function whose hard-coded offsets are checked against a spec.
+
+    ``cursors`` maps local variable names that act as record-base
+    cursors to (spec name, base offset added to every literal offset);
+    ``tiles`` maps variables holding a gathered [n, w] byte tile to
+    (spec name, absolute offset of tile column 0).
+    """
+    path: str
+    function: str                      # qualname ('Cls.meth' for methods)
+    cursors: Dict[str, Tuple[str, int]] = dataclasses.field(
+        default_factory=dict)
+    tiles: Dict[str, Tuple[str, int]] = dataclasses.field(
+        default_factory=dict)
+
+
+OFFSET_CONTRACTS: Tuple[OffsetContract, ...] = (
+    OffsetContract(
+        path="hadoop_bam_tpu/split/bam_guesser.py",
+        function="BAMSplitGuesser._record_ok",
+        cursors={"p": ("bam.record_prefix", 0)}),
+    OffsetContract(
+        path="hadoop_bam_tpu/split/bam_guesser.py",
+        function="BAMSplitGuesser._chain_ok",
+        cursors={"p": ("bam.record_prefix", 0)}),
+    OffsetContract(
+        path="hadoop_bam_tpu/parallel/pipeline.py",
+        function="decode_span_payload_host",
+        cursors={"p": ("bam.record_prefix", 0)}),
+    OffsetContract(
+        path="hadoop_bam_tpu/formats/bgzf.py",
+        function="parse_block_header",
+        cursors={"offset": ("bgzf.header", 0),
+                 "p": ("bgzf.bc_subfield", 0)}),
+    OffsetContract(
+        path="hadoop_bam_tpu/formats/bcf.py",
+        function="plausible_record_start",
+        cursors={"off": ("bcf.record", 0)}),
+    OffsetContract(
+        path="hadoop_bam_tpu/formats/bcf.py",
+        function="peek_record_sizes",
+        cursors={"off": ("bcf.record", 0)}),
+    OffsetContract(
+        path="hadoop_bam_tpu/formats/bcf_columns.py",
+        function="_decode_columns",
+        tiles={"fixed": ("bcf.record", 8)}),
+)
+
+# (path, top-level assignment name) of runtime field tables that must
+# mirror a spec exactly — parsed from the AST, no import needed
+RUNTIME_MIRRORS: Tuple[Tuple[str, str, str], ...] = (
+    ("hadoop_bam_tpu/ops/unpack_bam.py", "FIXED_FIELDS",
+     "bam.record_prefix"),
+)
+
+
+def spec_self_check(spec: LayoutSpec) -> Tuple[str, ...]:
+    """Internal-consistency problems of one spec row (empty = clean)."""
+    problems = []
+    pos = 0
+    for f in sorted(spec.fields, key=lambda f: f.offset):
+        if f.width <= 0:
+            problems.append(f"field {f.name} has non-positive width")
+        if f.offset != pos:
+            problems.append(
+                f"field {f.name} at offset {f.offset}, expected {pos} "
+                f"(gap or overlap)")
+        pos = f.offset + f.width
+    if spec.fmt is not None:
+        try:
+            want = struct.calcsize(spec.fmt)
+        except struct.error as e:
+            problems.append(f"bad format {spec.fmt!r}: {e}")
+        else:
+            if want != spec.size:
+                problems.append(
+                    f"format {spec.fmt!r} calcsize {want} != declared "
+                    f"size {spec.size}")
+    return tuple(problems)

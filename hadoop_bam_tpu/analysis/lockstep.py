@@ -1,0 +1,175 @@
+"""CL2xx — collective lockstep: every host must reach every collective.
+
+Multi-host collectives (``broadcast_one_to_all``, ``process_allgather``,
+and this repo's wrappers ``broadcast_plan`` / ``merge_quarantine_manifests``
+/ ``_run_collective`` / ``_agree_round_geometry`` / ``_multihost_reduce``)
+are rendezvous points: a host that skips one strands every other host in
+it forever.  The repo's discipline (see ``_multihost_reduce``'s
+failure-flag convention) is that collectives sit at the top level of a
+function's control flow — host-dependent *data* may ride a collective,
+but the collective call itself must be unconditional.
+
+Rules:
+
+- CL201 collective nested under a host-index / rank conditional
+  (``if jax.process_index() == 0: ... allgather(...)``) — a structural
+  deadlock.  ``process_count``-based tests are uniform across hosts and
+  are not flagged.
+- CL202 sibling ``if``/``else`` branches carry *different* collective
+  sequences — hosts taking different branches rendezvous in different
+  orders (or counts), which deadlocks or mismatches payloads.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from hadoop_bam_tpu.analysis.astutil import (
+    collect_functions, last_segment,
+)
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/parallel",)
+
+# call names (last segment) that are host-level rendezvous points
+COLLECTIVES = {
+    "broadcast_one_to_all", "process_allgather", "sync_global_devices",
+    "broadcast_plan", "merge_quarantine_manifests", "_run_collective",
+    "_agree_round_geometry", "_multihost_reduce",
+}
+
+# rank sources: expressions of these produce host-divergent values
+_RANK_CALLS = {"process_index", "local_process_index"}
+
+
+def _collective_name(node: ast.Call) -> Optional[str]:
+    seg = last_segment(node.func)
+    if seg in COLLECTIVES:
+        return seg
+    return None
+
+
+def _mentions_rank(node: ast.AST, rank_vars: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and last_segment(sub.func) in _RANK_CALLS:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in _RANK_CALLS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in rank_vars:
+            return True
+    return False
+
+
+def _rank_vars(fn: ast.AST) -> Set[str]:
+    """Names assigned (directly) from a process_index()-derived value."""
+    out: Set[str] = set()
+    for _ in range(4):   # tiny fixpoint for pid -> alias chains
+        before = len(out)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _mentions_rank(node.value,
+                                                               out):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            elif isinstance(node, ast.AnnAssign) and node.value \
+                    and isinstance(node.target, ast.Name) \
+                    and _mentions_rank(node.value, out):
+                out.add(node.target.id)
+        if len(out) == before:
+            break
+    return out
+
+
+def _walk_own(root: ast.AST):
+    """ast.walk that does not descend into nested function definitions —
+    each function is analyzed exactly once (nested defs get their own
+    pass, with the parent chain's rank vars in scope)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and child is not root:
+                continue
+            stack.append(child)
+
+
+def _collectives_in(node: ast.AST) -> List[ast.Call]:
+    return [n for n in ast.walk(node)
+            if isinstance(n, ast.Call) and _collective_name(n)]
+
+
+def _sequence(stmts: List[ast.stmt]) -> List[str]:
+    names: List[str] = []
+    for stmt in stmts:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                c = _collective_name(n)
+                if c:
+                    names.append(c)
+    return names
+
+
+@register("lockstep")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+        _top, every = collect_functions(m.tree, m.path)
+        for fi in every:
+            # rank vars of the whole lexical chain: a nested def closing
+            # over the parent's `pid = jax.process_index()` is still
+            # rank-conditioned by it
+            rank_vars: Set[str] = set()
+            scope = fi
+            while scope is not None:
+                rank_vars |= _rank_vars(scope.node)
+                scope = scope.parent
+
+            for node in _walk_own(fi.node):
+                # CL201: collective under a rank conditional
+                if isinstance(node, (ast.If, ast.While)) \
+                        and _mentions_rank(node.test, rank_vars):
+                    for branch, stmts in (("body", node.body),
+                                          ("else", node.orelse)):
+                        for call in _collectives_in(
+                                ast.Module(body=stmts, type_ignores=[])):
+                            # no line numbers in the MESSAGE: the baseline
+                            # fingerprint hashes it and must stay
+                            # line-insensitive (core.py contract)
+                            findings.append(Finding(
+                                rule="CL201", severity="error", path=m.path,
+                                line=call.lineno,
+                                message=f"collective "
+                                        f"'{_collective_name(call)}' is "
+                                        f"nested under a host-index "
+                                        f"conditional ({branch} branch) "
+                                        f"in '{fi.qualname}' — hosts "
+                                        f"that skip it strand the "
+                                        f"others"))
+                elif isinstance(node, ast.IfExp) \
+                        and _mentions_rank(node.test, rank_vars):
+                    for part in (node.body, node.orelse):
+                        for call in _collectives_in(part):
+                            findings.append(Finding(
+                                rule="CL201", severity="error", path=m.path,
+                                line=call.lineno,
+                                message=f"collective "
+                                        f"'{_collective_name(call)}' "
+                                        f"evaluated under a host-index "
+                                        f"ternary in '{fi.qualname}'"))
+                # CL202: divergent collective order across siblings
+                if isinstance(node, ast.If) and node.orelse:
+                    a = _sequence(node.body)
+                    b = _sequence(node.orelse)
+                    if a and b and a != b:
+                        findings.append(Finding(
+                            rule="CL202", severity="error", path=m.path,
+                            line=node.lineno,
+                            message=f"sibling branches of the conditional "
+                                    f"in '{fi.qualname}' run different "
+                                    f"collective sequences "
+                                    f"({a} vs {b}) — hosts taking "
+                                    f"different branches deadlock"))
+    return findings

@@ -1,0 +1,73 @@
+"""ET3xx — error taxonomy: classified errors at the policy boundaries.
+
+PR 1's resilience layer (``utils/errors.py``) keys every retry /
+quarantine / fail-fast decision on the error *class*:
+``TransientIOError`` retries with backoff, ``CorruptDataError`` fails
+fast (re-decoding corrupt bytes never heals), ``PlanError`` always
+raises (a misconfigured run must not be skipped as if the data were
+bad).  ``classify_error`` has builtin fallbacks, but a bare
+``ValueError`` at a decode boundary classifies as CORRUPT even when the
+real cause is a bad parameter — and a bare ``OSError`` classifies as
+TRANSIENT even when it is deterministic.  At the policy boundaries the
+class must be explicit.
+
+Rule:
+
+- ET301 bare builtin raise (``ValueError`` / ``OSError`` / ``IOError``
+  / ``RuntimeError`` / ``Exception``) at a bgzf / bamio / inflate /
+  planner policy boundary; raise a ``utils.errors`` taxonomy class (or
+  a subclass like ``BGZFError``) instead.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from hadoop_bam_tpu.analysis.astutil import last_segment
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+# the policy boundaries decode_with_retry / RetryingByteSource /
+# broadcast_plan classify across (ISSUE 3 tentpole scope)
+SCOPE = (
+    "hadoop_bam_tpu/formats/bgzf.py",
+    "hadoop_bam_tpu/formats/bamio.py",
+    "hadoop_bam_tpu/ops/inflate.py",
+    "hadoop_bam_tpu/ops/inflate_device.py",
+    "hadoop_bam_tpu/split/planners.py",
+    "hadoop_bam_tpu/split/vcf_planners.py",
+    "hadoop_bam_tpu/split/read_planners.py",
+    "hadoop_bam_tpu/split/cram_planner.py",
+)
+
+_BARE = {
+    "ValueError": "CorruptDataError (bad bytes) or PlanError (bad "
+                  "parameters)",
+    "OSError": "TransientIOError (environment) or PlanError "
+               "(deterministic, e.g. missing path)",
+    "IOError": "TransientIOError or PlanError",
+    "RuntimeError": "PlanError (misconfiguration) or CorruptDataError",
+    "Exception": "an explicit utils.errors taxonomy class",
+}
+
+
+@register("taxonomy")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call):
+                name = last_segment(exc.func)
+            elif isinstance(exc, (ast.Name, ast.Attribute)):
+                name = last_segment(exc)
+            if name in _BARE:
+                findings.append(Finding(
+                    rule="ET301", severity="error", path=m.path,
+                    line=node.lineno,
+                    message=f"bare '{name}' raised at a policy boundary — "
+                            f"decode_with_retry cannot classify it as "
+                            f"intended; use {_BARE[name]}"))
+    return findings

@@ -1,0 +1,402 @@
+"""TS1xx — trace safety: host Python inside JAX-traced code.
+
+Functions reachable from ``jax.jit`` / ``pallas_call`` / ``shard_map``
+call sites in ``ops/`` and ``parallel/`` execute under tracing: their
+array arguments are tracers, and host-side Python on a tracer either
+fails at trace time or — worse — silently forces a device sync /
+constant-folds per call.  Ordinary linters cannot see this because the
+code is legal Python; the contract is JAX's, not the language's.
+
+The analyzer builds the traced-call graph (roots = functions passed to
+jit/shard_map/pallas_call, minus ``static_argnames``), propagates
+tracer-ness through simple intra-function dataflow (assignments taint;
+``.shape``/``.dtype``/``.ndim``/``.size``/``len()`` are static
+extractors and neutralize), and follows calls into project functions,
+tainting exactly the parameters that receive tracer arguments.
+
+Rules:
+
+- TS101 host sync on a traced value: ``.item()``, ``.tolist()``,
+  ``int()/float()/bool()`` or ``np.asarray``-family / ``jax.device_get``
+  on a tracer.
+- TS102 data-dependent Python branch: ``if``/``while`` whose test
+  involves a traced value (host control flow on device data).
+- TS103 Python loop over a traced value: ``for x in tracer`` or
+  ``range(tracer)`` — a data-dependent unroll.
+- TS104 host NumPy on a traced value: any ``numpy`` call taking a
+  tracer argument (silently materializes on host).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from hadoop_bam_tpu.analysis.astutil import (
+    FuncInfo, collect_functions, const_str_tuple, dotted_name,
+    enclosing_function, import_aliases, last_segment, match_args_to_params,
+    resolve_name,
+)
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/ops", "hadoop_bam_tpu/parallel")
+
+# attribute reads that yield static (trace-time-known) values
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "at"}
+# calls whose result is static regardless of argument taint
+_NEUTRAL_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "print"}
+# receiver methods that force a host sync
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# builtins that concretize a tracer
+_CONCRETIZE = {"int", "float", "bool", "complex"}
+# numpy entry points that materialize device data on host
+_NUMPY_MODULES = {"numpy"}
+
+
+class _ModuleIndex:
+    def __init__(self, module):
+        self.module = module
+        self.top, self.every = collect_functions(module.tree, module.path)
+        self.aliases = import_aliases(module.tree)
+        # local names referring to numpy the module
+        self.np_names = {local for local, target in self.aliases.items()
+                         if target.split(".")[0] in _NUMPY_MODULES}
+        self.from_imports = {
+            local: target for local, target in self.aliases.items()
+            if "." in target}
+
+
+def _is_jit_callee(node: ast.AST) -> bool:
+    seg = last_segment(node)
+    return seg == "jit"
+
+
+def _is_trace_wrapper(node: ast.AST) -> Optional[str]:
+    """'jit' / 'shard_map' / 'pallas_call' when the call target is one."""
+    seg = last_segment(node)
+    if seg in ("jit", "shard_map", "pallas_call"):
+        return seg
+    return None
+
+
+def _static_argnames(call: ast.Call) -> Tuple[str, ...]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            got = const_str_tuple(kw.value)
+            if got:
+                return got
+    return ()
+
+
+def _decorator_roots(fi: FuncInfo) -> Optional[Tuple[str, ...]]:
+    """If the function is decorated as a traced root, the tuple of
+    static argnames (possibly empty); else None."""
+    node = fi.node
+    for dec in getattr(node, "decorator_list", ()):
+        if _is_jit_callee(dec):
+            return ()
+        if isinstance(dec, ast.Call):
+            # @jax.jit(...) or @functools.partial(jax.jit, ...)
+            if _is_jit_callee(dec.func):
+                return _static_argnames(dec)
+            if last_segment(dec.func) == "partial" and dec.args \
+                    and _is_jit_callee(dec.args[0]):
+                return _static_argnames(dec)
+    return None
+
+
+def _find_roots(idx: _ModuleIndex) -> List[Tuple[FuncInfo, Set[str]]]:
+    """(function, tracer params) roots in one module: decorated jits plus
+    first arguments of jit()/shard_map()/pallas_call() call sites."""
+    roots: List[Tuple[FuncInfo, Set[str]]] = []
+
+    def tracer_params(fi: FuncInfo, static: Tuple[str, ...]) -> Set[str]:
+        return {p for p in fi.params() if p not in static}
+
+    for fi in idx.every:
+        static = _decorator_roots(fi)
+        if static is not None:
+            roots.append((fi, tracer_params(fi, static)))
+    for node in ast.walk(idx.module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _is_trace_wrapper(node.func)
+        if kind is None or not node.args:
+            continue
+        target = node.args[0]
+        if not isinstance(target, ast.Name):
+            continue
+        ctx = enclosing_function(idx.every, node)
+        fi = resolve_name(target.id, ctx, idx.top)
+        if fi is None:
+            continue
+        static = _static_argnames(node) if kind == "jit" else ()
+        roots.append((fi, tracer_params(fi, static)))
+    return roots
+
+
+class _FunctionChecker:
+    """Taint + rule pass over one function with a given tracer-param set."""
+
+    def __init__(self, idx: _ModuleIndex, fi: FuncInfo, tracers: Set[str]):
+        self.idx = idx
+        self.fi = fi
+        self.tracers = set(tracers)
+        self.findings: List[Finding] = []
+        self.callee_taints: Dict[Tuple[str, str], Set[str]] = {}
+
+    # -- taint ------------------------------------------------------------
+    def tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tracers
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.tainted(node.value) or self.tainted(node.slice)
+        if isinstance(node, ast.Slice):
+            return any(self.tainted(x) for x in
+                       (node.lower, node.upper, node.step) if x is not None)
+        if isinstance(node, (ast.BinOp,)):
+            return self.tainted(node.left) or self.tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.tainted(node.left) or \
+                any(self.tainted(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.tainted(node.body) or self.tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.tainted(v) for v in node.values if v is not None)
+        if isinstance(node, ast.Starred):
+            return self.tainted(node.value)
+        if isinstance(node, ast.Call):
+            seg = last_segment(node.func)
+            if seg in _NEUTRAL_CALLS or seg in _CONCRETIZE:
+                return False
+            args = list(node.args) + [k.value for k in node.keywords]
+            if any(self.tainted(a) for a in args):
+                return True
+            # method on a traced value returns a traced value (x.sum())
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr not in _STATIC_ATTRS:
+                return self.tainted(node.func.value)
+            return False
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return any(self.tainted(g.iter) for g in node.generators) \
+                or self.tainted(node.elt)
+        return False
+
+    def _assign_target_names(self, target: ast.AST) -> List[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for e in target.elts:
+                out.extend(self._assign_target_names(e))
+            return out
+        if isinstance(target, ast.Starred):
+            return self._assign_target_names(target.value)
+        return []
+
+    def propagate(self) -> None:
+        """Monotone taint fixpoint over the function body (no kill set —
+        conservative across loops)."""
+        body = self.fi.node.body
+        for _ in range(16):
+            before = len(self.tracers)
+            for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+                if isinstance(node, ast.Assign) and self.tainted(node.value):
+                    for t in node.targets:
+                        self.tracers.update(self._assign_target_names(t))
+                elif isinstance(node, ast.AnnAssign) and node.value \
+                        and self.tainted(node.value):
+                    self.tracers.update(
+                        self._assign_target_names(node.target))
+                elif isinstance(node, ast.AugAssign) \
+                        and (self.tainted(node.value)
+                             or self.tainted(node.target)):
+                    self.tracers.update(
+                        self._assign_target_names(node.target))
+                elif isinstance(node, ast.For) and self.tainted(node.iter):
+                    self.tracers.update(
+                        self._assign_target_names(node.target))
+                elif isinstance(node, (ast.NamedExpr,)) \
+                        and self.tainted(node.value):
+                    self.tracers.update(
+                        self._assign_target_names(node.target))
+            if len(self.tracers) == before:
+                break
+
+    # -- rules ------------------------------------------------------------
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule=rule, severity="error", path=self.fi.module_path,
+            line=getattr(node, "lineno", 1),
+            message=f"{message} (in traced function "
+                    f"'{self.fi.qualname}')"))
+
+    def check(self) -> None:
+        """Rule pass.  Deliberately walks into NESTED defs too: closures
+        of a traced function usually execute at trace time (``pl.when``
+        bodies, inline helpers) with the enclosing taint in scope, and
+        separately-enqueued callees dedup by (path, line, rule)."""
+        self.propagate()
+        for node in ast.walk(self.fi.node):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and self.tainted(node.test):
+                self._emit("TS102", node,
+                           "data-dependent Python branch on a traced "
+                           "value; use jnp.where / lax.cond")
+            elif isinstance(node, ast.For) and self.tainted(node.iter):
+                self._emit("TS103", node,
+                           "Python loop over a traced value; use lax "
+                           "control flow or vectorize")
+            elif isinstance(node, ast.Call):
+                self._check_call(node)
+
+    def _check_call(self, node: ast.Call) -> None:
+        seg = last_segment(node.func)
+        args = list(node.args) + [k.value for k in node.keywords]
+        any_tainted = any(self.tainted(a) for a in args)
+        if isinstance(node.func, ast.Attribute):
+            if seg in _SYNC_METHODS and self.tainted(node.func.value):
+                self._emit("TS101", node,
+                           f".{seg}() forces a host sync on a traced value")
+                return
+            root = node.func.value
+            root_name = root.id if isinstance(root, ast.Name) else None
+            if root_name in self.idx.np_names and any_tainted:
+                self._emit("TS104", node,
+                           f"host NumPy call "
+                           f"'{dotted_name(node.func) or seg}' on a traced "
+                           "value; use jnp")
+                return
+            if dotted_name(node.func) in ("jax.device_get",) and any_tainted:
+                self._emit("TS101", node,
+                           "jax.device_get on a traced value inside trace")
+                return
+        elif isinstance(node.func, ast.Name):
+            if seg in _CONCRETIZE and any(self.tainted(a)
+                                          for a in node.args):
+                self._emit("TS101", node,
+                           f"{seg}() concretizes a traced value "
+                           "(host sync / trace error)")
+                return
+            target = self.idx.from_imports.get(seg, "")
+            if target.split(".")[0] in _NUMPY_MODULES and any_tainted:
+                self._emit("TS104", node,
+                           f"host NumPy call '{seg}' on a traced value")
+                return
+        # record project-call taint flow for the worklist
+        if isinstance(node.func, ast.Name):
+            ctx = enclosing_function(self.idx.every, node) or self.fi
+            callee = resolve_name(node.func.id, ctx, self.idx.top)
+            callee_key: Optional[Tuple[str, str]] = None
+            fi = None
+            if callee is not None:
+                fi = callee
+                callee_key = (self.idx.module.path, callee.qualname)
+            else:
+                target = self.idx.from_imports.get(node.func.id)
+                if target:
+                    callee_key = ("import", target)
+            if callee_key is not None:
+                params: Set[str] = set()
+                if fi is not None:
+                    for arg, pname in match_args_to_params(node, fi):
+                        if self.tainted(arg):
+                            params.add(pname)
+                else:
+                    # cross-module: positions of tainted args; resolved later
+                    for i, arg in enumerate(node.args):
+                        if self.tainted(arg):
+                            params.add(f"#{i}")
+                    for kw in node.keywords:
+                        if kw.arg and self.tainted(kw.value):
+                            params.add(kw.arg)
+                if params:
+                    self.callee_taints.setdefault(callee_key, set()) \
+                        .update(params)
+
+
+@register("trace_safety")
+def analyze(project: Project) -> List[Finding]:
+    indices: Dict[str, _ModuleIndex] = {}
+    for m in project.select(SCOPE):
+        indices[m.path] = _ModuleIndex(m)
+
+    # worklist over (module path, qualname) -> tracer-param set
+    taint_of: Dict[Tuple[str, str], Set[str]] = {}
+    info_of: Dict[Tuple[str, str], Tuple[_ModuleIndex, FuncInfo]] = {}
+    for idx in indices.values():
+        for fi in idx.every:
+            info_of[(idx.module.path, fi.qualname)] = (idx, fi)
+
+    work: List[Tuple[str, str]] = []
+
+    def add_taint(key: Tuple[str, str], params: Set[str]) -> None:
+        if key not in info_of:
+            return
+        cur = taint_of.setdefault(key, set())
+        if not params <= cur:
+            cur.update(params)
+            if key not in work:
+                work.append(key)
+
+    for idx in indices.values():
+        for fi, params in _find_roots(idx):
+            add_taint((idx.module.path, fi.qualname), params)
+
+    def resolve_import_key(target: str) -> Optional[Tuple[str, str]]:
+        """'hadoop_bam_tpu.ops.unpack_bam.unpack_fixed_fields' ->
+        (module path, top-level qualname) when in scope."""
+        mod, _, name = target.rpartition(".")
+        m = project.by_dotted.get(mod)
+        if m is None or m.path not in indices:
+            return None
+        idx = indices[m.path]
+        if name in idx.top:
+            return (m.path, name)
+        return None
+
+    findings: List[Finding] = []
+    # dedup WITHOUT the message: a closure statement seen both under its
+    # parent's walk and its own enqueued pass reports once
+    seen: Set[Tuple[str, int, str]] = set()
+    rounds = 0
+    while work and rounds < 10000:
+        rounds += 1
+        key = work.pop()
+        idx, fi = info_of[key]
+        checker = _FunctionChecker(idx, fi, taint_of.get(key, set()))
+        checker.check()
+        for f in checker.findings:
+            k = (f.path, f.line, f.rule)
+            if k not in seen:
+                seen.add(k)
+                findings.append(f)
+        for callee_key, params in checker.callee_taints.items():
+            if callee_key[0] == "import":
+                resolved = resolve_import_key(callee_key[1])
+                if resolved is None:
+                    continue
+                # positional markers -> real parameter names
+                _, cfi = info_of[resolved]
+                cparams = cfi.params()
+                real: Set[str] = set()
+                for p in params:
+                    if p.startswith("#"):
+                        i = int(p[1:])
+                        if i < len(cparams):
+                            real.add(cparams[i])
+                    else:
+                        real.add(p)
+                add_taint(resolved, real)
+            else:
+                add_taint(callee_key, params)
+    return findings

@@ -127,6 +127,10 @@ class BamDataset:
         batch of a run may arrive with fewer rows (shrunk to the
         smallest dispatch bucket that holds its records) — size consumer
         buffers from the batch's own shape, not the geometry.
+        Consumers that preallocate by ``tile_records`` can opt out with
+        ``PayloadGeometry(fixed_shape=True)``: the final batch then pads
+        to ``tile_records`` instead of shrinking (every batch shares one
+        shape, at the cost of padding transfer on the last batch).
         """
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -18,6 +18,7 @@ from hadoop_bam_tpu.formats.bam import (
 )
 from hadoop_bam_tpu.formats.sam import SamRecord
 from hadoop_bam_tpu.formats.virtual_offset import make_voffset
+from hadoop_bam_tpu.utils.errors import PlanError
 
 
 class BamWriter:
@@ -50,8 +51,10 @@ class BamWriter:
         self._index_granularity = int(index_granularity)
         self._index_flavor = index_flavor
         if self._index_granularity and self._path is None:
-            raise ValueError("index_granularity needs a path sink (the "
-                             "sidecar is written next to the BAM)")
+            # PLAN class (still a ValueError): a writer misconfiguration,
+            # not bad bytes — must never be retried or quarantine-eaten
+            raise PlanError("index_granularity needs a path sink (the "
+                            "sidecar is written next to the BAM)")
         self._track = track_voffsets or bool(self._index_granularity)
         self.records_written = 0
         if write_header:
@@ -77,7 +80,8 @@ class BamWriter:
         boundaries are not visible here, so a sidecar built from this
         stream would point at wrong offsets."""
         if self._track:
-            raise ValueError(
+            # PLAN class: incompatible writer options, a caller bug
+            raise PlanError(
                 "write_raw cannot be used with track_voffsets / "
                 "index_granularity — record boundaries are not visible; "
                 "use write_record_bytes")

@@ -25,10 +25,11 @@ direction; encode exists to exercise decode.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
+from hadoop_bam_tpu.formats.cram import CRAMError
 from hadoop_bam_tpu.formats.cram_codecs import normalize_truncation
 from hadoop_bam_tpu.formats.cram_codecs_nx16 import (
     RansError, _pack_decode, _pack_encode, _packed_size, var_get_u32,
@@ -50,8 +51,10 @@ ARITH_PACK = 0x80
 _RUN_CTXS = 3        # run-length model chain depth [SPEC-recalled]
 
 
-class ArithError(RansError):
-    pass
+class ArithError(RansError, CRAMError):
+    """Malformed/desynced arith stream.  Also a ``CRAMError`` so container
+    callers see the canonical corruption class (CorruptDataError via the
+    ValueError fallback in classify_error either way)."""
 
 
 # ---------------------------------------------------------------------------
@@ -65,7 +68,7 @@ def _models(max_sym: int, order1: bool):
 
 
 def _decode_symbols(payload: bytes, pos: int, out_size: int,
-                    order1: bool) -> bytes:
+                    order1: bool) -> Tuple[bytes, int]:
     max_sym = payload[pos]
     pos += 1
     if max_sym == 0:
@@ -78,7 +81,7 @@ def _decode_symbols(payload: bytes, pos: int, out_size: int,
         sym = models[prev if order1 else 0].decode(rc)
         out[i] = sym
         prev = sym
-    return bytes(out)
+    return bytes(out), rc.pos
 
 
 def _encode_symbols(data: bytes, order1: bool) -> bytes:
@@ -93,7 +96,7 @@ def _encode_symbols(data: bytes, order1: bool) -> bytes:
 
 
 def _decode_rle(payload: bytes, pos: int, out_size: int,
-                order1: bool) -> bytes:
+                order1: bool) -> Tuple[bytes, int]:
     """Literals through the normal models, run lengths through a chain of
     256-symbol models (255 extends the run) [SPEC-recalled]."""
     max_sym = payload[pos]
@@ -120,7 +123,7 @@ def _decode_rle(payload: bytes, pos: int, out_size: int,
     if len(out) != out_size:
         raise ArithError(
             f"arith RLE expanded to {len(out)}, expected {out_size}")
-    return bytes(out)
+    return bytes(out), rc.pos
 
 
 def _encode_rle(data: bytes, order1: bool) -> bytes:
@@ -206,12 +209,29 @@ def arith_encode(data: bytes, flags: int = 0) -> bytes:
 def arith_decode(payload: bytes, out_size: Optional[int] = None) -> bytes:
     """Decode one adaptive-arithmetic stream.  ``out_size`` is required
     when the stream carries the NOSZ flag (the CRAM block header
-    supplies it)."""
+    supplies it).
+
+    Consistency tripwire: decode must consume EXACTLY the compressed
+    extent.  The range coder reads lazily, so a desynced stream (model
+    drift, trailing garbage, a truncated tail hidden by the decoder's
+    zero-padding) can otherwise produce right-sized wrong bytes that
+    only fail much later — or never.  The encoder/decoder renorm
+    schedules mirror 1:1 (5-byte init vs 5-shift finish), so on a clean
+    stream the final read position equals the payload length; anything
+    else raises ``ArithError`` (a ``CRAMError``) at the block boundary.
+    """
     with normalize_truncation("arith"):
-        return _arith_decode(payload, out_size)
+        data, consumed = _arith_decode(payload, out_size)
+        if consumed != len(payload):
+            raise ArithError(
+                f"arith stream desync: consumed {consumed} of "
+                f"{len(payload)} compressed bytes")
+        return data
 
 
-def _arith_decode(payload: bytes, out_size: Optional[int] = None) -> bytes:
+def _arith_decode(payload: bytes, out_size: Optional[int] = None
+                  ) -> Tuple[bytes, int]:
+    """(decoded bytes, compressed bytes consumed)."""
     if not payload:
         raise ArithError("empty arith stream")
     pos = 0
@@ -221,8 +241,11 @@ def _arith_decode(payload: bytes, out_size: Optional[int] = None) -> bytes:
         out_size, pos = var_get_u32(payload, pos)
     if out_size is None:
         raise ArithError("NOSZ stream needs an external size")
-    if out_size == 0:
-        return b""
+    if out_size == 0 and pos == len(payload):
+        # sizeless empty frame (no entropy stream follows); a non-empty
+        # tail for out_size 0 still decodes below so the exact-extent
+        # tripwire sees the true consumption
+        return b"", pos
 
     if flags & ARITH_STRIPE:
         X = payload[pos]
@@ -234,12 +257,14 @@ def _arith_decode(payload: bytes, out_size: Optional[int] = None) -> bytes:
         outs = []
         for j in range(X):
             sub_len = (out_size - j + X - 1) // X
+            # each sub-stream is its own framed arith stream: the
+            # public decoder applies the exact-extent tripwire to it
             outs.append(arith_decode(payload[pos:pos + clens[j]], sub_len))
             pos += clens[j]
         out = np.zeros(out_size, dtype=np.uint8)
         for j in range(X):
             out[j::X] = np.frombuffer(outs[j], dtype=np.uint8)
-        return out.tobytes()
+        return out.tobytes(), pos
 
     pack_syms = None
     if flags & ARITH_PACK:
@@ -255,22 +280,27 @@ def _arith_decode(payload: bytes, out_size: Optional[int] = None) -> bytes:
         stage = payload[pos:pos + stage_size]
         if len(stage) != stage_size:
             raise ArithError("truncated CAT payload")
+        end = pos + stage_size
     elif flags & ARITH_EXT:
         import bz2
+        d = bz2.BZ2Decompressor()
         try:
-            stage = bz2.decompress(payload[pos:])
+            stage = d.decompress(payload[pos:])
         except OSError as e:
             raise ArithError(f"bad EXT (bzip2) payload: {e}")
+        if not d.eof:
+            raise ArithError("truncated EXT (bzip2) payload")
+        end = len(payload) - len(d.unused_data)
     elif flags & ARITH_RLE:
-        stage = _decode_rle(payload, pos, stage_size,
-                            bool(flags & ARITH_ORDER1))
+        stage, end = _decode_rle(payload, pos, stage_size,
+                                 bool(flags & ARITH_ORDER1))
     else:
-        stage = _decode_symbols(payload, pos, stage_size,
-                                bool(flags & ARITH_ORDER1))
+        stage, end = _decode_symbols(payload, pos, stage_size,
+                                     bool(flags & ARITH_ORDER1))
 
     if flags & ARITH_PACK:
         stage = _pack_decode(stage, pack_syms, out_size)
     if len(stage) != out_size:
         raise ArithError(
             f"arith decoded {len(stage)} bytes, expected {out_size}")
-    return stage
+    return stage, end

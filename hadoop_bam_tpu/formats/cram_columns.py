@@ -663,6 +663,16 @@ def _fill_reference(seq_flat, seq_starts, comp, slice_hdr, ref_names,
             per_rec_need[feat_mpos[x_mask]] = True
         if bool((per_rec_need & ~unk_mapped).any()):
             raise _Ineligible("reference required but not provided")
+        if need_x:
+            # every remaining X feature sits on a CF_UNKNOWN_BASES
+            # record; the record path still decodes its BS code and
+            # substitutes against the 'N' placeholder row — a malformed
+            # code must raise CRAMError identically here, not vanish
+            # with the dropped seq
+            codes = bulk.raw("BS", int(x_mask.sum()))
+            _substitute_vec(comp.substitution_matrix,
+                            np.full(codes.size, ord("N"), np.uint8),
+                            codes)
         return
 
     pos_mapped = pos[mapped_idx]
@@ -670,6 +680,18 @@ def _fill_reference(seq_flat, seq_starts, comp, slice_hdr, ref_names,
     take = ~unk_mapped & (ref_consumed > 0)
     bs_codes = (bulk.raw("BS", int(x_mask.sum())) if need_x
                 else np.zeros(0, np.uint8))
+    if need_x:
+        # X features on CF_UNKNOWN_BASES-skipped records never reach the
+        # per-reference substitution below, but the record path decodes
+        # and validates their BS codes against the 'N' placeholder row
+        # (their seq is discarded as '*', so it never fetches reference
+        # bases for them either); malformed codes must raise CRAMError
+        # identically here
+        unk_codes = bs_codes[(unk_mapped[feat_mpos] & x_mask)[x_mask]]
+        if unk_codes.size:
+            _substitute_vec(comp.substitution_matrix,
+                            np.full(unk_codes.size, ord("N"), np.uint8),
+                            unk_codes)
     for rid in np.unique(rid_mapped[take]):
         sel = take & (rid_mapped == rid)
         name = ref_names[rid] if 0 <= rid < len(ref_names) else "*"

@@ -1025,8 +1025,14 @@ def _make_ref_lookup(r: CramRecord, ref_names: List[str],
     cache = {}
 
     def ref_base_at(off: int) -> str:
-        if ref_source is None and r.cf & CF_UNKNOWN_BASES:
-            return "N"   # bases are declared unknown; placeholder is fine
+        if r.cf & CF_UNKNOWN_BASES:
+            # bases are declared unknown and the decoded seq is discarded
+            # as '*' — the placeholder is output-equivalent WITH a
+            # reference too, skips the pointless fetch, and keeps BS-code
+            # validation deterministic (identical to the columnar path's
+            # 'N'-row check) instead of depending on which reference base
+            # happens to sit under the feature
+            return "N"
         if ref_source is None:
             raise CRAMError(
                 "slice requires reference bases but no reference source was "

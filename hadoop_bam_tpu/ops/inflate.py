@@ -90,7 +90,10 @@ def inflate_span(raw: bytes, table: Optional[dict] = None,
                 raise bgzf.BGZFError(f"ISIZE mismatch in block {i}")
             dst[int(ubase[i]):int(ubase[i + 1])] = np.frombuffer(out, np.uint8)
     else:
-        raise ValueError(f"unknown inflate backend {backend!r}")
+        # PLAN class (still a ValueError): a bad backend name is run
+        # configuration, not data — never retried, never quarantined
+        from hadoop_bam_tpu.utils.errors import PlanError
+        raise PlanError(f"unknown inflate backend {backend!r}")
     return dst, ubase[:-1]
 
 

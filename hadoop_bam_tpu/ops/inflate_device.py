@@ -105,7 +105,12 @@ def inflate_span_device(raw: bytes, table: Optional[dict] = None,
     if table is None:
         table = block_table(raw)
     if not native.available():
-        raise RuntimeError(
+        # PLAN class: selecting the device backend without the native
+        # library is a configuration fault — classify_error must not
+        # treat it as transient (old RuntimeError fell through to the
+        # generic CORRUPT bucket; retrying could never heal it either)
+        from hadoop_bam_tpu.utils.errors import PlanError
+        raise PlanError(
             "device inflate needs the native tokenizer "
             "(hbam_deflate_tokenize_batch); native library unavailable")
     isize = table["isize"]

@@ -87,6 +87,12 @@ class PayloadGeometry:
                                    # tiles win (measured +25%); 64k
                                    # reads/tile is ~17 MB staged
     block_n: int = 256             # Pallas record-tile height
+    fixed_shape: bool = False      # True: the FINAL partial batch pads
+                                   # to tile_records instead of
+                                   # shrinking to a dispatch bucket —
+                                   # for consumers that preallocate by
+                                   # tile_records (costs padding
+                                   # transfer on the last batch only)
 
     @property
     def seq_stride(self) -> int:
@@ -817,7 +823,8 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
             # (one shard_map step), but each device only copies its OWN
             # rows into the zeroed group tile — one skewed device no
             # longer makes the other seven memcpy its padding
-            b = max(_bucket_cap(c, cap, geometry.block_n) for c in counts)
+            b = cap if geometry.fixed_shape else \
+                max(_bucket_cap(c, cap, geometry.block_n) for c in counts)
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts
             stacked = []
@@ -995,7 +1002,8 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
 
         def emit() -> Dict:
             # per-device bucket caps (see iter_payload_tile_groups.emit)
-            b = max(_bucket_cap(c, cap, geometry.block_n) for c in counts)
+            b = cap if geometry.fixed_shape else \
+                max(_bucket_cap(c, cap, geometry.block_n) for c in counts)
             cvec = np.zeros((n_dev,), dtype=np.int32)
             cvec[:len(counts)] = counts
             stacked = []
@@ -1192,7 +1200,8 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         counts: List[int] = []
 
         def dispatch():
-            b = _bucket_cap(max(counts), cap, geometry.block_n)
+            b = cap if geometry.fixed_shape else \
+                _bucket_cap(max(counts), cap, geometry.block_n)
             seqs = np.stack([g[0][:b] for g in group] + [
                 np.zeros((b, geometry.seq_stride), np.uint8)
                 for _ in range(n_dev - len(group))])

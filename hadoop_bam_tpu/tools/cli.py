@@ -420,6 +420,31 @@ def _alen(r) -> int:
 
 
 # ---------------------------------------------------------------------------
+# lint
+# ---------------------------------------------------------------------------
+
+def cmd_lint(args) -> int:
+    """Repo-native static analysis (hbam-lint): trace safety, collective
+    lockstep, error taxonomy, binary-layout contracts.  Non-zero exit on
+    unsuppressed findings — the CI contract."""
+    from hadoop_bam_tpu.analysis.core import lint_main
+    fwd: List[str] = []
+    if args.root:
+        fwd += ["--root", args.root]
+    for only in args.only or ():
+        fwd += ["--only", only]
+    if args.baseline:
+        fwd += ["--baseline", args.baseline]
+    if args.no_baseline:
+        fwd.append("--no-baseline")
+    if args.update_baseline:
+        fwd.append("--update-baseline")
+    if args.show_suppressed:
+        fwd.append("--show-suppressed")
+    return lint_main(fwd)
+
+
+# ---------------------------------------------------------------------------
 # vcf-sort
 # ---------------------------------------------------------------------------
 
@@ -532,6 +557,24 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("input")
     f.add_argument("output")
     f.set_defaults(fn=cmd_fixmate, uses_device=False)
+
+    ln = sub.add_parser("lint",
+                        help="static analysis: trace safety (TS1xx), "
+                             "collective lockstep (CL2xx), error taxonomy "
+                             "(ET3xx), layout contracts (LC4xx); exits "
+                             "non-zero on unsuppressed findings")
+    ln.add_argument("--root", default=None,
+                    help="package directory to analyze")
+    ln.add_argument("--only", action="append", metavar="ANALYZER",
+                    help="run one analyzer (trace_safety, lockstep, "
+                         "taxonomy, layout); repeatable")
+    ln.add_argument("--baseline", default=None,
+                    help="baseline file (default analysis/baseline.json)")
+    ln.add_argument("--no-baseline", action="store_true")
+    ln.add_argument("--update-baseline", action="store_true",
+                    help="accept all current findings into the baseline")
+    ln.add_argument("--show-suppressed", action="store_true")
+    ln.set_defaults(fn=cmd_lint, uses_device=False)
 
     vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos) "
                                          "(external spill-merge)")

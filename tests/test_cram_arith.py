@@ -170,3 +170,64 @@ def test_corrupt_streams_fail_loudly():
         assert len(out) != len(data)           # never silently right-sized
     except ValueError:
         pass
+
+
+def test_desync_tripwire_exact_extent():
+    """Decode must consume EXACTLY the compressed extent: trailing bytes
+    after a valid stream (a desynced/garbage-padded block) raise the
+    canonical CRAMError instead of silently decoding right-sized output."""
+    from hadoop_bam_tpu.formats.cram import CRAMError
+    from hadoop_bam_tpu.formats.cram_arith import ArithError
+
+    data = _qual_like(600)
+    for flags in (0, ARITH_ORDER1, ARITH_RLE, ARITH_PACK, ARITH_STRIPE):
+        enc = arith_encode(data, flags)
+        assert arith_decode(enc) == data           # exact extent: clean
+        with pytest.raises(ArithError):
+            arith_decode(enc + b"\x00\x01\x02")    # trailing garbage
+    err = None
+    try:
+        arith_decode(arith_encode(data, 0) + b"\xff")
+    except ArithError as e:
+        err = e
+    assert isinstance(err, CRAMError)              # block-boundary class
+    assert "desync" in str(err)
+
+
+def test_desync_tripwire_inside_stripe_substream():
+    """A desync hidden inside one STRIPE sub-stream (its clen claims more
+    bytes than its coder consumes) trips the sub-stream's own extent
+    check rather than decoding shifted interleave columns."""
+    from hadoop_bam_tpu.formats.cram_arith import ArithError
+    from hadoop_bam_tpu.formats.cram_codecs_nx16 import (
+        var_get_u32, var_put_u32,
+    )
+
+    data = _qual_like(4096)
+    enc = bytes(arith_encode(data, ARITH_STRIPE))
+    # parse the frame: flags, ulen varint, X, then X clen varints
+    pos = 1
+    ulen, pos = var_get_u32(enc, pos)
+    x = enc[pos]
+    pos += 1
+    clens = []
+    for _ in range(x):
+        c, pos = var_get_u32(enc, pos)
+        clens.append(c)
+    subs = []
+    for c in clens:
+        subs.append(enc[pos:pos + c])
+        pos += c
+    assert pos == len(enc)
+    # pad one garbage byte into sub-stream 0's claimed extent and rebuild
+    subs[0] = subs[0] + b"\x5a"
+    clens[0] += 1
+    bad = bytearray(enc[:1])
+    bad += var_put_u32(ulen)
+    bad.append(x)
+    for c in clens:
+        bad += var_put_u32(c)
+    for s in subs:
+        bad += s
+    with pytest.raises(ArithError):
+        arith_decode(bytes(bad))

@@ -445,3 +445,39 @@ def test_randomized_slice_parity_fuzz():
             ap += rng.randint(1, 20)
         cols, recs = b.decode_both(ref_source=REF)
         _assert_columns_match(cols, recs)
+
+
+def test_unknown_bases_bs_codes_validated_on_both_paths():
+    """A malformed BS code on a CF_UNKNOWN_BASES-skipped record raises
+    CRAMError identically on the record and columnar decode paths (the
+    record path substitutes against the 'N' placeholder row; the columnar
+    path must not let the code vanish with the dropped seq)."""
+    from hadoop_bam_tpu.formats.cram_decode import decode_slice_records
+
+    def build(code):
+        b = _SliceBuilder()
+        b.add(rl=6, ap=5, cf=CF_UNKNOWN_BASES | CF_QUAL_STORED,
+              features=[(3, "X", code)])
+        b.add(rl=4, ap=20, features=[(1, "b", b"ACGT")], name=b"ok")
+        return b
+
+    # no reference: record path raises via substitute_base('N', code)
+    for ref in (None, REF):
+        b = build(0xFF)
+        comp, hdr, core, external = b.build()
+        with pytest.raises(CRAMError):
+            decode_slice_records(comp, hdr, core, dict(external),
+                                 ["c1", "c2"], ref)
+        comp, hdr, core, external = b.build()
+        with pytest.raises(CRAMError):
+            decode_slice_columns(comp, hdr, core, dict(external),
+                                 ["c1", "c2"], ref, want_names=True)
+
+    # a VALID code on an unknown-bases record stays decodable and the
+    # two paths still agree
+    b = build(2)
+    cols, recs = b.decode_both()
+    _assert_columns_match(cols, recs)
+    b = build(2)
+    cols, recs = b.decode_both(ref_source=REF)
+    _assert_columns_match(cols, recs)

@@ -1,0 +1,348 @@
+"""hbam-lint suite tests: seeded-violation corpus, baseline round-trip,
+and the repo-lints-clean CI gate (``pytest -m lint``).
+
+Each analyzer gets at least one intentionally-bad snippet proving it
+fires, plus a clean twin proving the approved idiom passes — the lint
+suite is itself under test, so a silent analyzer regression (an analyzer
+that stops finding anything) fails here, not in review.
+"""
+import json
+
+import pytest
+
+from hadoop_bam_tpu.analysis.core import (
+    Baseline, Finding, Project, run_analyzers,
+)
+
+pytestmark = pytest.mark.lint
+
+
+def lint_sources(sources, only=None):
+    return run_analyzers(Project.from_sources(sources), only=only)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# trace safety (TS1xx)
+# ---------------------------------------------------------------------------
+
+def test_ts_seeded_violations_fire():
+    findings = lint_sources({"hadoop_bam_tpu/ops/bad.py": '''
+import jax
+import numpy as np
+
+@jax.jit
+def f(x, n):
+    if x > 0:                  # TS102
+        x = x + 1
+    for i in range(n):         # TS103
+        x = x + i
+    y = np.asarray(x)          # TS104
+    return x.item()            # TS101
+'''}, only=["trace_safety"])
+    assert rules_of(findings) == {"TS101", "TS102", "TS103", "TS104"}
+    assert all(f.path == "hadoop_bam_tpu/ops/bad.py" for f in findings)
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_ts_reaches_through_shard_map_and_calls():
+    findings = lint_sources({"hadoop_bam_tpu/parallel/bad.py": '''
+from hadoop_bam_tpu.parallel.mesh import shard_map
+
+def make_step(mesh):
+    def per_device(tile, count):
+        return helper(tile)
+    return shard_map(per_device, mesh=mesh, in_specs=(), out_specs=())
+
+def helper(t):
+    return t.tolist()          # TS101, two hops from the shard_map root
+'''}, only=["trace_safety"])
+    assert rules_of(findings) == {"TS101"}
+    assert "helper" in findings[0].message
+
+
+def test_ts_static_argnames_and_shape_are_not_tracers():
+    findings = lint_sources({"hadoop_bam_tpu/ops/good.py": '''
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def f(x, block_n, interpret):
+    n = x.shape[0]
+    if interpret:              # static arg: allowed
+        block_n = 2 * block_n
+    for i in range(n // block_n):   # shape-derived: allowed
+        x = x + i
+    return jnp.sum(x)
+'''}, only=["trace_safety"])
+    assert findings == []
+
+
+def test_ts_unreached_host_helper_not_flagged():
+    findings = lint_sources({"hadoop_bam_tpu/ops/oracle.py": '''
+import numpy as np
+
+def host_oracle(x):            # never traced: host NumPy is fine here
+    out = np.asarray(x)
+    return out.item()
+'''}, only=["trace_safety"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# collective lockstep (CL2xx)
+# ---------------------------------------------------------------------------
+
+_CL_BAD = '''
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+def bad_rank_nested(x):
+    pid = jax.process_index()
+    if pid == 0:
+        multihost_utils.process_allgather(x)      # CL201
+
+def bad_divergent_order(x, flag):
+    if flag:
+        multihost_utils.broadcast_one_to_all(x)   # CL202: A then B
+        multihost_utils.process_allgather(x)
+    else:
+        multihost_utils.process_allgather(x)      # CL202: B then A
+        multihost_utils.broadcast_one_to_all(x)
+'''
+
+_CL_GOOD = '''
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+def good(plan, x):
+    pid = jax.process_index()
+    if jax.process_count() == 1:       # uniform test: fine
+        return plan
+    payload = plan if pid == 0 else None      # data diverges, not control
+    out = multihost_utils.broadcast_one_to_all(x)   # unconditional
+    if pid == 0:
+        print("planner host")          # no collective under the rank test
+    return out
+'''
+
+
+def test_cl_seeded_violations_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/bad.py": _CL_BAD}, only=["lockstep"])
+    assert rules_of(findings) == {"CL201", "CL202"}
+    by_rule = {f.rule: f for f in findings}
+    assert "bad_rank_nested" in by_rule["CL201"].message
+    assert "bad_divergent_order" in by_rule["CL202"].message
+
+
+def test_cl_uniform_and_data_conditionals_pass():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/good.py": _CL_GOOD}, only=["lockstep"])
+    assert findings == []
+
+
+def test_cl_symmetric_branches_pass():
+    findings = lint_sources({"hadoop_bam_tpu/parallel/sym.py": '''
+from jax.experimental import multihost_utils
+
+def symmetric(x, big):
+    if big:
+        y = multihost_utils.process_allgather(2 * x)
+    else:
+        y = multihost_utils.process_allgather(x)
+    return y
+'''}, only=["lockstep"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy (ET3xx)
+# ---------------------------------------------------------------------------
+
+def test_et_seeded_violation_fires_only_at_boundaries():
+    bad = '''
+def f(n):
+    if n < 0:
+        raise ValueError("bad n")          # ET301 at a boundary module
+'''
+    findings = lint_sources(
+        {"hadoop_bam_tpu/split/planners.py": bad}, only=["taxonomy"])
+    assert rules_of(findings) == {"ET301"}
+    # same code OUTSIDE the policy boundaries is not taxonomy-scoped
+    findings = lint_sources(
+        {"hadoop_bam_tpu/utils/other.py": bad}, only=["taxonomy"])
+    assert findings == []
+
+
+def test_et_classified_raises_pass():
+    findings = lint_sources({"hadoop_bam_tpu/formats/bgzf.py": '''
+from hadoop_bam_tpu.utils.errors import CorruptDataError, PlanError
+
+class BGZFError(CorruptDataError):
+    pass
+
+def f(buf, n):
+    if n < 0:
+        raise PlanError("bad span parameters")
+    if not buf:
+        raise BGZFError("truncated block")
+    raise KeyboardInterrupt                    # re-raise style: not scoped
+'''}, only=["taxonomy"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# layout contracts (LC4xx)
+# ---------------------------------------------------------------------------
+
+def test_lc_unknown_struct_format_fires():
+    findings = lint_sources({"hadoop_bam_tpu/formats/bad.py": '''
+import struct
+
+def parse(buf):
+    return struct.unpack_from("<QQi", buf, 0)     # LC401: unregistered
+'''}, only=["layout"])
+    assert rules_of(findings) == {"LC401"}
+    assert "<QQi" in findings[0].message
+
+
+def test_lc_offset_contract_violations_fire():
+    findings = lint_sources({"hadoop_bam_tpu/split/bam_guesser.py": '''
+class BAMSplitGuesser:
+    def _chain_ok(self, data, p, n):
+        return data[p:p + 4]
+
+    def _record_ok(self, data, p, n):
+        ok = data[p + 13]                    # inside mapq: fine
+        bad_span = data[p + 17:p + 19]       # LC403: crosses n_cigar/flag
+        bad_byte = data[p + 36]              # LC403: past the prefix
+        return ok
+'''}, only=["layout"])
+    lc403 = [f for f in findings if f.rule == "LC403"]
+    assert len(lc403) == 2
+    assert {f.line for f in lc403} == {8, 9}
+
+
+def test_lc_exact_field_reads_pass():
+    findings = lint_sources({"hadoop_bam_tpu/split/bam_guesser.py": '''
+class BAMSplitGuesser:
+    def _record_ok(self, data, p, n):
+        bs = int.from_bytes(data[p:p + 4], "little", signed=True)
+        refid = int.from_bytes(data[p + 4:p + 8], "little", signed=True)
+        n_cigar = int.from_bytes(data[p + 16:p + 18], "little")
+        whole = data[p:p + 36]               # full contiguous field run
+        return bs, refid, n_cigar, whole
+
+    def _chain_ok(self, data, p, n):
+        return data[p:p + 4]
+'''}, only=["layout"])
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_lc_runtime_mirror_drift_fires():
+    findings = lint_sources({"hadoop_bam_tpu/ops/unpack_bam.py": '''
+FIXED_FIELDS = {
+    "block_size": (0, 4, True),
+    "refid": (4, 4, True),
+    "pos": (9, 4, True),
+}
+'''}, only=["layout"])
+    assert "LC404" in rules_of(findings)
+    (f,) = [f for f in findings if f.rule == "LC404"]
+    assert "pos" in f.message
+
+
+def test_lc_spec_table_self_check():
+    from hadoop_bam_tpu.analysis.layout_specs import (
+        SPECS, Field, LayoutSpec, spec_self_check,
+    )
+    for spec in SPECS.values():
+        assert spec_self_check(spec) == (), spec.name
+    broken = LayoutSpec(
+        name="broken", doc="", fmt="<II",
+        fields=(Field("a", 0, 4, "u32"), Field("b", 6, 2, "u16")))
+    problems = spec_self_check(broken)
+    assert any("gap or overlap" in p for p in problems)
+    assert any("calcsize" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip / suppression
+# ---------------------------------------------------------------------------
+
+_BAD_FOR_BASELINE = {"hadoop_bam_tpu/split/planners.py": '''
+def f(n):
+    raise ValueError("legacy")
+'''}
+
+
+def test_baseline_round_trip_suppresses(tmp_path):
+    findings = lint_sources(_BAD_FOR_BASELINE)
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    Baseline.from_findings(findings).save(path)
+    loaded = Baseline.load(path)
+    unsup, sup, stale = loaded.apply(findings)
+    assert unsup == [] and len(sup) == len(findings) and stale == []
+    # the stored entries keep human-readable context
+    doc = json.loads(open(path).read())
+    assert doc["findings"][0]["rule"] == "ET301"
+
+
+def test_baseline_is_line_insensitive_but_not_content_insensitive():
+    f1 = Finding("ET301", "error", "a/b.py", 10, "bare 'ValueError' ...")
+    f2 = Finding("ET301", "error", "a/b.py", 99, "bare 'ValueError' ...")
+    f3 = Finding("ET301", "error", "a/c.py", 10, "bare 'ValueError' ...")
+    bl = Baseline.from_findings([f1])
+    assert bl.suppresses(f2)          # same finding, shifted line
+    assert not bl.suppresses(f3)      # moved to a new file: surfaces
+
+
+def test_baseline_stale_entries_reported():
+    findings = lint_sources(_BAD_FOR_BASELINE)
+    bl = Baseline.from_findings(findings)
+    unsup, sup, stale = bl.apply([])      # violation since fixed
+    assert unsup == [] and sup == [] and len(stale) == len(findings)
+
+
+def test_missing_baseline_file_is_empty(tmp_path):
+    bl = Baseline.load(str(tmp_path / "nope.json"))
+    assert len(bl) == 0
+
+
+# ---------------------------------------------------------------------------
+# the CI gate: the repo itself lints clean
+# ---------------------------------------------------------------------------
+
+def test_repo_lints_clean():
+    """``python -m hadoop_bam_tpu lint`` exits 0: zero unsuppressed
+    findings against the checked-in baseline.  New violations anywhere in
+    the package fail HERE — this test is the tier-1 lint gate."""
+    from hadoop_bam_tpu.analysis.core import lint_main
+    assert lint_main([]) == 0
+
+
+def test_lint_cli_exit_codes(tmp_path, capsys):
+    """The lint frontend exits 1 on unsuppressed findings and 0 once they
+    are baselined (exercises --root / --baseline / --update-baseline)."""
+    from hadoop_bam_tpu.analysis.core import lint_main
+
+    pkg = tmp_path / "hadoop_bam_tpu" / "split"
+    pkg.mkdir(parents=True)
+    (pkg / "planners.py").write_text(
+        "def f(n):\n    raise ValueError('x')\n")
+    root = str(tmp_path / "hadoop_bam_tpu")
+    bl = str(tmp_path / "bl.json")
+    assert lint_main(["--root", root, "--baseline", bl]) == 1
+    assert lint_main(["--root", root, "--baseline", bl,
+                      "--update-baseline"]) == 0
+    assert lint_main(["--root", root, "--baseline", bl]) == 0
+    out = capsys.readouterr().out
+    assert "ET301" in out and "1 suppressed" in out

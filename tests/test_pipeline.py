@@ -401,6 +401,39 @@ def test_bucketed_tensor_batches_shapes(tmp_path):
     assert batches[-1]["qual"].shape[1] <= 1024
 
 
+def test_fixed_shape_geometry_pads_final_batch(tmp_path):
+    """PayloadGeometry(fixed_shape=True): the final batch PADS to
+    tile_records instead of shrinking — the opt-out for consumers that
+    preallocate by tile_records.  Totals are unchanged."""
+    import numpy as np
+
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.api.read_datasets import open_fastq
+    from hadoop_bam_tpu.parallel.pipeline import PayloadGeometry
+
+    fq = str(tmp_path / "fixed.fastq")
+    with open(fq, "w") as f:
+        for i in range(600):
+            f.write(f"@r{i}\nACGTACGTAC\n+\nIIIIIIIIII\n")
+    geom = PayloadGeometry(tile_records=4096, block_n=256,
+                           fixed_shape=True)
+    batches = list(open_fastq(fq).tensor_batches(geometry=geom))
+    assert all(b["qual"].shape[1] == 4096 for b in batches)
+    assert sum(int(np.asarray(b["n_records"]).sum())
+               for b in batches) == 600
+
+    # the BAM payload feed honors it too
+    bam = str(tmp_path / "fixed.bam")
+    header = make_header()
+    with BamWriter(bam, header) as w:
+        for r in make_records(header, 500, seed=3):
+            w.write_sam_record(r)
+    batches = list(open_bam(bam).tensor_batches(geometry=geom))
+    assert all(b["prefix"].shape[1] == 4096 for b in batches)
+    assert sum(int(np.asarray(b["n_records"]).sum())
+               for b in batches) == 500
+
+
 def test_assign_spans_empty_plan():
     """A .bai-pruned region with zero aligned reads yields an empty
     plan; every host must receive an empty assignment (not IndexError)
