@@ -1214,33 +1214,62 @@ def _scaling_child(n_dev: int) -> None:
             t0 = time.perf_counter()
             res = fn()
             times.append(time.perf_counter() - t0)
-        timers = {k: round(v / reps, 4) for k, v in METRICS.timers.items()}
+        snap = METRICS.snapshot()
+        timers = {k: round(v / reps, 4) for k, v in snap["timers"].items()}
+        walls = {k: round(v / reps, 4)
+                 for k, v in snap["wall_timers"].items()}
+        counters = {k: v // reps for k, v in snap["counters"].items()}
         # lower median: best-of for reps=2, true median for odd reps —
         # never the max (a GC hiccup must not define the curve)
-        return res, sorted(times)[(len(times) - 1) // 2], timers
+        return (res, sorted(times)[(len(times) - 1) // 2], timers, walls,
+                counters)
 
-    stats, dt, timers = timed(
+    def feed_overlap(walls, counters, prefix):
+        """overlap_efficiency (device-busy wall / total feed wall) +
+        dispatch_bytes per driver row — the wall-clock spans the
+        FeedPipeline records; the thread-summed stage timers cannot
+        show overlap, these can."""
+        row = {}
+        fw = walls.get("pipeline.feed_wall")
+        if fw:
+            dw = walls.get("pipeline.dispatch_wall", 0.0)
+            row[f"{prefix}_overlap_efficiency"] = round(dw / fw, 4)
+        db = counters.get("pipeline.dispatch_bytes")
+        if db:
+            row[f"{prefix}_dispatch_bytes"] = int(db)
+        return row
+
+    stats, dt, timers, walls, counters = timed(
         lambda: flagstat_file(path, mesh=mesh, header=header))
     n_file_records = stats["total"]
     out["file_records"] = n_file_records
     out["flagstat_records_per_sec"] = round(n_file_records / dt, 1)
     # host_decode/inflate/walk run in a thread pool: their values are
     # WORK seconds summed across threads (can exceed wall time); the
-    # single-threaded device_put/device_drain values are wall seconds.
+    # single-threaded device_put/device_drain values are wall seconds;
+    # the *_wall rows (flagstat_wall_seconds_per_run) are wall-clock
+    # UNION spans from Metrics.wall_timer — the overlap-visible ones.
     out["flagstat_stage_seconds_per_run"] = timers
+    out["flagstat_wall_seconds_per_run"] = walls
+    out.update(feed_overlap(walls, counters, "flagstat"))
     out["stage_timer_note"] = ("host_decode/inflate/walk are thread-summed "
-                               "work seconds; device_* are wall seconds")
+                               "work seconds; device_* are wall seconds; "
+                               "*_wall entries and overlap_efficiency are "
+                               "wall-clock union spans")
     print(json.dumps(out), flush=True)
 
-    sstats, dt, _ = timed(lambda: seq_stats_file(path, mesh=mesh))
+    sstats, dt, _, walls, counters = timed(
+        lambda: seq_stats_file(path, mesh=mesh))
     out["seq_stats_records_per_sec"] = round(
         int(sstats.get("n_reads", n_file_records)) / dt, 1)
+    out.update(feed_overlap(walls, counters, "seq_stats"))
     print(json.dumps(out), flush=True)
 
     # no .bai sidecar on the bench fixture: coverage streams every record
-    _, dt, _ = timed(lambda: coverage_file(path, "chr20:1-4194304",
-                                           mesh=mesh))
+    _, dt, _, walls, counters = timed(
+        lambda: coverage_file(path, "chr20:1-4194304", mesh=mesh))
     out["coverage_records_per_sec"] = round(n_file_records / dt, 1)
+    out.update(feed_overlap(walls, counters, "coverage"))
 
     print(json.dumps(out), flush=True)
 

@@ -1,12 +1,15 @@
 """hbam-lint: repo-native static analysis (``python -m hadoop_bam_tpu lint``).
 
-Four AST analyzers over correctness regimes generic linters cannot see:
+Five AST analyzers over correctness regimes generic linters cannot see:
 
 - ``trace_safety`` (TS1xx) — host Python inside JAX-traced code
 - ``lockstep``     (CL2xx) — collectives off the uniform control path
 - ``taxonomy``     (ET3xx) — unclassified raises at policy boundaries
 - ``layout``       (LC4xx) — hand-coded offsets vs the declared
   binary-layout contract table (``analysis/layout_specs.py``)
+- ``feedpath``     (PF5xx) — fresh per-group device-tile allocations in
+  the feed paths (group buffers belong to ``parallel/staging.py``'s
+  rings; the memset tax scales with device count)
 
 Findings carry file:line, rule id and severity; ``analysis/baseline.json``
 suppresses accepted legacy findings so CI fails only on regressions.

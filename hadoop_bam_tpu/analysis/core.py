@@ -158,7 +158,7 @@ def analyzers() -> Dict[str, Analyzer]:
     """Name -> analyzer map (importing the analyzer modules on demand)."""
     # import for registration side effects
     from hadoop_bam_tpu.analysis import (  # noqa: F401
-        layout, lockstep, taxonomy, trace_safety,
+        feedpath, layout, lockstep, taxonomy, trace_safety,
     )
     return dict(_REGISTRY)
 
@@ -253,14 +253,15 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
         prog="hadoop_bam_tpu lint",
         description="repo-native static analysis: trace safety (TS1xx), "
                     "collective lockstep (CL2xx), error taxonomy (ET3xx), "
-                    "binary-layout contracts (LC4xx)")
+                    "binary-layout contracts (LC4xx), feed-path "
+                    "allocation discipline (PF5xx)")
     p.add_argument("--root", default=None,
                    help="package directory to analyze (default: the "
                         "installed hadoop_bam_tpu package)")
     p.add_argument("--only", action="append", default=None,
                    metavar="ANALYZER",
                    help="run one analyzer (trace_safety, lockstep, "
-                        "taxonomy, layout); repeatable")
+                        "taxonomy, layout, feedpath); repeatable")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline file (default: analysis/baseline.json)")
     p.add_argument("--no-baseline", action="store_true",

@@ -1,0 +1,103 @@
+"""PF5xx — feed-path allocation discipline: no fresh group tiles per emit.
+
+The round-8 feed rebuild moved group-tile staging into
+``parallel/staging.py``'s preallocated rings precisely because a fresh
+``np.zeros((n_dev, cap, w))`` per dispatched group put an O(n_dev)
+memset-plus-copy tax on every emit — the host-side cost that made the
+pipeline scale *inversely* with device count (536k rec/s at 8 devices vs
+1.09M at 1 in the r5-r7 bench series).  This analyzer keeps the tax from
+silently regressing:
+
+- PF501: inside ``parallel/`` (the staging module itself excluded — the
+  ring is the one allowed owner of such buffers), an
+  ``np.zeros``/``np.empty``/``np.full`` call allocating a >=2-D array
+  whose LEADING dimension is the device count (a name like ``n_dev``),
+  made inside a loop body or inside an emit/dispatch helper, is a fresh
+  per-group device tile.  Route it through ``staging.StagingRing`` /
+  ``FeedPipeline`` instead.
+
+Per-device 1-D vectors (``np.zeros((n_dev,), np.int32)`` count
+vectors) are deliberately NOT flagged: a 32-byte alloc per group is
+noise, and the rule must not cry wolf over it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from hadoop_bam_tpu.analysis.astutil import last_segment
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/parallel",)
+# the ring owns its buffers; allocations there are the fix, not the bug
+EXEMPT = ("hadoop_bam_tpu/parallel/staging.py",)
+
+_ALLOCATORS = {"zeros", "empty", "full"}
+_DEVICE_DIM_NAMES = {"n_dev", "n_devices", "num_devices"}
+_EMIT_NAMES = ("emit", "dispatch")
+
+
+def _leading_device_dim(call: ast.Call) -> bool:
+    """True when the allocation's shape is a >=2-element tuple whose
+    first element is a device-count name."""
+    if not call.args:
+        return False
+    shape = call.args[0]
+    if not isinstance(shape, (ast.Tuple, ast.List)) or len(shape.elts) < 2:
+        return False
+    lead = shape.elts[0]
+    if isinstance(lead, ast.Name) and lead.id in _DEVICE_DIM_NAMES:
+        return True
+    if isinstance(lead, ast.Attribute) and lead.attr in _DEVICE_DIM_NAMES:
+        return True
+    return False
+
+
+def _is_group_alloc(node: ast.AST) -> Optional[ast.Call]:
+    if isinstance(node, ast.Call) \
+            and last_segment(node.func) in _ALLOCATORS \
+            and isinstance(node.func, ast.Attribute) \
+            and _leading_device_dim(node):
+        return node
+    return None
+
+
+@register("feedpath")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+        if m.path in EXEMPT:
+            continue
+
+        def visit(node: ast.AST, in_loop: bool, in_emit: bool,
+                  where: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                loop = in_loop
+                emit = in_emit
+                ctx = where
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # a fresh function scope: loop context does not
+                    # carry in, but emit/dispatch naming does mark it
+                    loop = False
+                    emit = child.name.startswith(_EMIT_NAMES)
+                    ctx = child.name
+                elif isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                    loop = True
+                call = _is_group_alloc(child)
+                if call is not None and (loop or emit):
+                    findings.append(Finding(
+                        rule="PF501", severity="error", path=m.path,
+                        line=call.lineno,
+                        message=f"fresh device-group tile "
+                                f"'{last_segment(call.func)}' allocation "
+                                f"inside the per-group emit path "
+                                f"('{ctx}') — group buffers must come "
+                                f"from the staging ring "
+                                f"(parallel/staging.py), not a per-"
+                                f"dispatch np allocation (the memset tax "
+                                f"scales with device count)"))
+                visit(child, loop, emit, ctx)
+
+        visit(m.tree, False, False, "<module>")
+    return findings

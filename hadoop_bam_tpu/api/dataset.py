@@ -147,15 +147,20 @@ class BamDataset:
         n_dev = int(np.prod(mesh.devices.shape))
         sharding = NamedSharding(mesh, P("data"))
         spans = self.spans(num_spans)
-        for stacked, cvec in iter_payload_tile_groups(
-                self.path, spans, geometry, n_dev, self.config,
-                header=self.header):
-            yield {
-                "prefix": jax.device_put(stacked[0], sharding),
-                "seq_packed": jax.device_put(stacked[1], sharding),
-                "qual": jax.device_put(stacked[2], sharding),
-                "n_records": jax.device_put(cvec, sharding),
+
+        def emit(arrays, counts):
+            # the device dict doubles as the ring slot's in-flight
+            # transfer handle (staging.FeedPipeline.stream contract)
+            return {
+                "prefix": jax.device_put(arrays[0], sharding),
+                "seq_packed": jax.device_put(arrays[1], sharding),
+                "qual": jax.device_put(arrays[2], sharding),
+                "n_records": jax.device_put(counts, sharding),
             }
+
+        yield from iter_payload_tile_groups(
+            self.path, spans, geometry, n_dev, self.config,
+            header=self.header, emit_fn=emit)
 
     def query(self, region: str) -> Iterator[SamRecord]:
         """Random access via a ``.bai``/``.csi`` sidecar: yields records

@@ -163,17 +163,22 @@ class VcfDataset:
         """Yield device-resident variant tensor batches sharded over the
         mesh's data axis: ``chrom``/``pos`` int32 [n_dev, cap], ``flags``
         uint8 (bit0 PASS, bit1 SNP), ``dosage`` int8 [n_dev, cap, S_pad]
-        (ALT-allele dosage, -1 missing), ``n_records`` int32 [n_dev]."""
+        (ALT-allele dosage, -1 missing), ``n_records`` int32 [n_dev].
+
+        Padding rows (beyond each shard's ``n_records``) carry the
+        missing-value sentinels UNIFORMLY: dosage -1, qual NaN, other
+        columns 0.  (Before the staging-ring feed, shards of the final
+        group that received no spans were zero-filled — dosage 0 read
+        as a hom-ref call; mask by ``n_records`` either way.)"""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from hadoop_bam_tpu.parallel.mesh import make_mesh
         from hadoop_bam_tpu.parallel.pipeline import _iter_windowed
         from hadoop_bam_tpu.parallel.variant_pipeline import (
-            VariantGeometry, _iter_variant_tiles, pack_variant_tiles,
+            VariantGeometry, pack_variant_tiles, variant_feed,
         )
-        import concurrent.futures as cf
-        import os as _os
+        from hadoop_bam_tpu.utils.pools import decode_pool, decode_pool_size
 
         if mesh is None:
             mesh = make_mesh()
@@ -183,49 +188,41 @@ class VcfDataset:
         cap = geometry.tile_records
         sharding = NamedSharding(mesh, P("data"))
         spans = self.spans(num_spans)
-        n_workers = min(32, max(4, (_os.cpu_count() or 4) * 4))
-        with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
-            def decode(span):
-                if self.container is VCFContainer.BCF:
-                    # columnar fast path: no VcfRecord objects
-                    # (formats/bcf_columns.py, record-scan fallback)
-                    from hadoop_bam_tpu.parallel.variant_pipeline import (
-                        bcf_span_stat_columns,
-                    )
-                    return bcf_span_stat_columns(
-                        self.path, span, self.header, geometry,
-                        self._is_bgzf_bcf)
-                return pack_variant_tiles(
-                    VariantBatch(self.read_span(span), self.header),
-                    geometry)
+        pool = decode_pool(self.config)
 
-            stream = _iter_windowed(pool, spans, decode, 2 * n_workers)
-            group, counts = [], []
-            for tile, count in _iter_variant_tiles(stream, cap, geometry):
-                group.append(tile)
-                counts.append(count)
-                if len(group) == n_dev:
-                    yield self._emit_tensor_batch(group, counts, n_dev,
-                                                  sharding)
-            if group:
-                yield self._emit_tensor_batch(group, counts, n_dev, sharding)
+        def decode(span):
+            if self.container is VCFContainer.BCF:
+                # columnar fast path: no VcfRecord objects
+                # (formats/bcf_columns.py, record-scan fallback)
+                from hadoop_bam_tpu.parallel.variant_pipeline import (
+                    bcf_span_stat_columns,
+                )
+                return bcf_span_stat_columns(
+                    self.path, span, self.header, geometry,
+                    self._is_bgzf_bcf)
+            return pack_variant_tiles(
+                VariantBatch(self.read_span(span), self.header),
+                geometry)
 
-    @staticmethod
-    def _emit_tensor_batch(group, counts, n_dev, sharding) -> Dict:
-        import jax
+        stream = _iter_windowed(pool, spans, decode,
+                                2 * decode_pool_size(self.config))
+        # variant_feed peeks the first span's dict for the schema (same
+        # genericity as the old serial tiler); fixed_shape keeps the
+        # historical contract that every variant tensor batch carries
+        # full tile_records rows
+        keys, fp, tuples = variant_feed(stream, n_dev, cap, self.config,
+                                        fixed_shape=True)
+        if fp is None:
+            return
 
-        cvec = np.zeros((n_dev,), dtype=np.int32)
-        cvec[:len(counts)] = counts
-        out = {}
-        for k in group[0]:
-            arrs = [g[k] for g in group]
-            while len(arrs) < n_dev:
-                arrs.append(np.zeros_like(arrs[0]))
-            out[k] = jax.device_put(np.stack(arrs), sharding)
-        out["n_records"] = jax.device_put(cvec, sharding)
-        group.clear()
-        counts.clear()
-        return out
+        def emit(arrays, counts) -> Dict:
+            # the device dict doubles as the slot's in-flight handle
+            out = {k: jax.device_put(a, sharding)
+                   for k, a in zip(keys, arrays)}
+            out["n_records"] = jax.device_put(counts, sharding)
+            return out
+
+        yield from fp.stream(tuples, emit)
 
     def variant_stats(self, mesh=None, geometry=None) -> Dict:
         """Distributed variant/SNP/PASS counts, mean ALT allele frequency,

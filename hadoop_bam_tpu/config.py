@@ -77,6 +77,11 @@ _HADOOP_KEY_MAP = {
     "hbam.skip-bad-spans": "skip_bad_spans",
     "hbam.max-bad-span-fraction": "max_bad_span_fraction",
     "hbam.debug-keep-spill": "debug_keep_spill",
+    # host->device feed knobs (parallel/staging.py; no reference analog —
+    # Hadoop's record-ahead buffering was not configurable)
+    "hbam.feed-ring-slots": "feed_ring_slots",
+    "hbam.feed-dispatch-depth": "feed_dispatch_depth",
+    "hbam.decode-pool-workers": "decode_pool_workers",
 }
 
 
@@ -142,6 +147,19 @@ class HBamConfig:
     splitting_index_granularity: int = 4096  # records per splitting-bai sample
     use_splitting_index: bool = True      # snap splits via sidecar when present
 
+    # --- host->device feed (parallel/staging.py) ---
+    feed_ring_slots: int = 2         # preallocated group buffers in the
+    #                                  staging ring (2 = one being packed
+    #                                  while one is in dispatch; more buys
+    #                                  slack at n_dev*cap*row_bytes each)
+    feed_dispatch_depth: int = 2     # groups in flight past the packer
+    #                                  (2 = double buffering: device_put k
+    #                                  overlaps host repack of k+1)
+    decode_pool_workers: Optional[int] = None  # shared decode pool size;
+    #                                  None = min(32, max(4, 4*cpus)).
+    #                                  First driver call in the process
+    #                                  sizes the pool (utils/pools.py)
+
     # --- TPU backend ---
     backend: str = "tpu"                  # "tpu" | "cpu" (host NumPy decode)
     blocks_per_batch: int = 512           # BGZF blocks per device batch
@@ -182,7 +200,8 @@ def _coerce(kwargs: dict) -> dict:
               "retry_backoff_max_s", "io_read_deadline_s"):
         if k in out and isinstance(out[k], str):
             out[k] = float(out[k])
-    for k in ("span_retries", "io_read_retries"):
+    for k in ("span_retries", "io_read_retries", "feed_ring_slots",
+              "feed_dispatch_depth", "decode_pool_workers"):
         if k in out and isinstance(out[k], str):
             out[k] = int(out[k])
     return out

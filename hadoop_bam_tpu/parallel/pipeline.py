@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hadoop_bam_tpu.parallel.mesh import shard_map
+from hadoop_bam_tpu.parallel.staging import FeedPipeline, TileSpec, bucket_cap
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.bam import SAMHeader
@@ -44,6 +45,7 @@ from hadoop_bam_tpu.split.spans import FileVirtualSpan
 from hadoop_bam_tpu.utils import errors as hberrors
 from hadoop_bam_tpu.utils.errors import PlanError, classify_error
 from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.utils.pools import decode_pool, decode_pool_size
 from hadoop_bam_tpu.utils.resilient import (
     QuarantineManifest, RetryPolicy, RetryingByteSource,
 )
@@ -656,21 +658,30 @@ def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
     """Submit ``fn(item)`` to the pool with bounded in-flight futures and
     yield results in order.  Bounds host memory: at most ``window`` decoded
     spans exist at once (a plain list of futures would retain every span's
-    rows for the whole run — concurrent.futures keeps results referenced)."""
+    rows for the whole run — concurrent.futures keeps results referenced).
+
+    On early close (a consumer abandoning the stream), queued-but-unstarted
+    futures are cancelled — the SHARED decode pool (utils/pools.py) never
+    shuts down, so without the cancel an abandoned window of decodes would
+    keep running to completion for nothing."""
     from collections import deque
 
     it = iter(items)
     dq: "deque[cf.Future]" = deque()
-    for item in it:
-        dq.append(pool.submit(fn, item))
-        if len(dq) >= window:
-            break
-    while dq:
-        fut = dq.popleft()
+    try:
         for item in it:
             dq.append(pool.submit(fn, item))
-            break
-        yield fut.result()
+            if len(dq) >= window:
+                break
+        while dq:
+            fut = dq.popleft()
+            for item in it:
+                dq.append(pool.submit(fn, item))
+                break
+            yield fut.result()
+    finally:
+        for fut in dq:
+            fut.cancel()
 
 
 def _iter_prefix_tiles(row_arrays, cap: int, row_bytes: int = PREFIX
@@ -680,8 +691,17 @@ def _iter_prefix_tiles(row_arrays, cap: int, row_bytes: int = PREFIX
     Spans have data-dependent record counts; the jit contract wants static
     shapes.  Rather than padding each span to the worst case (the old span
     path's memset + transfer tax), concatenate across span boundaries and
-    emit full tiles — only the final tile carries padding."""
-    parts: List[np.ndarray] = []
+    emit full tiles — only the final tile carries padding.
+
+    This is the SERIAL tiler: the hot drivers feed through
+    parallel/staging.FeedPipeline (in-place ring packing, no per-tile
+    allocation); this stays as the reference implementation the
+    byte-identity property tests compare the ring against."""
+    from collections import deque
+
+    # deque, not a list: parts.pop(0) is O(len) per pop, which turns a
+    # many-small-span plan (thousands of parts per tile) quadratic
+    parts: "deque[np.ndarray]" = deque()
     have = 0
 
     def emit(take: int) -> Tuple[np.ndarray, int]:
@@ -696,7 +716,7 @@ def _iter_prefix_tiles(row_arrays, cap: int, row_bytes: int = PREFIX
             k = min(take - filled, head.shape[0])
             tile[filled:filled + k] = head[:k]
             if k == head.shape[0]:
-                parts.pop(0)
+                parts.popleft()
             else:
                 parts[0] = head[k:]
             filled += k
@@ -719,10 +739,16 @@ def _iter_tile_tuples(array_tuples, cap: int, specs: Sequence
     lockstep (prefix/seq/qual/lengths share record order and counts).
 
     ``specs``: per-array spec — an int width (uint8 [cap, w] tile) or a
-    (width_or_None, dtype) pair; width None means a 1-D [cap] tile."""
+    (width_or_None, dtype) pair; width None means a 1-D [cap] tile.
+
+    Serial tiler, like _iter_prefix_tiles: coverage still drives it, and
+    the FeedPipeline byte-identity tests use it as the oracle."""
+    from collections import deque
+
     norm = [(s, np.uint8) if isinstance(s, int) else tuple(s)
             for s in specs]
-    parts: List[Tuple[np.ndarray, ...]] = []
+    # deque: parts.pop(0) was O(n^2) on many-small-span plans
+    parts: "deque[Tuple[np.ndarray, ...]]" = deque()
     have = 0
 
     def emit(take: int) -> Tuple[Tuple[np.ndarray, ...], int]:
@@ -738,7 +764,7 @@ def _iter_tile_tuples(array_tuples, cap: int, specs: Sequence
             for t, h in zip(tiles, head):
                 t[filled:filled + m] = h[:m]
             if m == head[0].shape[0]:
-                parts.pop(0)
+                parts.popleft()
             else:
                 parts[0] = tuple(h[m:] for h in head)
             filled += m
@@ -756,22 +782,9 @@ def _iter_tile_tuples(array_tuples, cap: int, specs: Sequence
         yield emit(have)
 
 
-def _bucket_cap(count: int, cap: int, block_n: int = 256) -> int:
-    """Rows to actually dispatch for a partial tile of ``count`` records.
-
-    Full tiles ship at ``cap``; the FINAL partial tile shrinks to the
-    smallest bucket (~cap/16, ~cap/4, cap) that holds it, so a small
-    file pays a kernel over ~its own rows instead of the full padded
-    tile (the small-input dispatch floor: a 10k-read file inside a
-    64k-row tile spent 6x its data in padding).  Buckets are rounded up
-    to the Pallas record-block height ``block_n`` (the kernel asserts
-    divisibility), and a fixed 3-step ladder bounds jit retraces at two
-    extra shapes per step function."""
-    for b in (cap // 16, cap // 4):
-        b = -(-b // block_n) * block_n       # round up to a block multiple
-        if b >= block_n and count <= b < cap:
-            return b
-    return cap
+# canonical home is parallel/staging.py (the FeedPipeline shares it);
+# the alias keeps this module's historical import surface
+_bucket_cap = bucket_cap
 
 
 def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
@@ -780,16 +793,26 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                              prefetch: int = 2,
                              header=None,
                              quarantine: Optional[QuarantineManifest] = None,
-                             ) -> Iterator[Tuple[List[np.ndarray],
-                                                 np.ndarray]]:
+                             balance: bool = False,
+                             emit_fn=None,
+                             ) -> Iterator:
     """Stream payload tile groups ready for a device mesh: yields
     ([prefix, seq, qual] each [n_dev, rows, w] uint8, counts [n_dev]
     int32), where rows == geometry.tile_records for every full group and
     the FINAL partial group may shrink to a smaller bucket (_bucket_cap).
     The shared batching core of seq_stats_file and
-    BamDataset.tensor_batches — host decode pool with a bounded window,
-    cross-span tile repacking, zero-padded final group, span retry/skip
-    per the config's failure policy."""
+    BamDataset.tensor_batches — shared decode pool with a bounded
+    window, staging-ring group packing (parallel/staging.py: rows write
+    in place, partial tiles zero only their own tail), span retry/skip
+    per the config's failure policy.
+
+    ``emit_fn(arrays, counts)``, when given, runs per group inside the
+    FeedPipeline (its return value is yielded AND becomes the ring
+    slot's in-flight transfer handle — see staging.FeedPipeline.stream);
+    both in-repo consumers pass one.  Without it, the yielded arrays
+    are caller-owned copies (the historical contract — this fallback
+    only exists for external callers, so it pays the copy rather than
+    hand out ring views that the packer will overwrite)."""
     cap = geometry.tile_records
     widths = (PREFIX, geometry.seq_stride, geometry.qual_stride)
     check_crc = bool(getattr(config, "check_crc", False))
@@ -798,52 +821,36 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     spans = list(spans)
     if quarantine is not None and quarantine.total_spans is None:
         quarantine.total_spans = len(spans)
-    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
-    window = max(1, prefetch) * n_workers
-    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
-        def decode(span):
-            def inner(s):
-                prefix, seq, qual, _v = decode_span_payload_host(
-                    src, s, geometry, check_crc,
-                    intervals=intervals, header=header)
-                return prefix, seq, qual
+    pool = decode_pool(config)
+    window = max(1, prefetch) * decode_pool_size(config)
+
+    def decode(span):
+        def inner(s):
+            prefix, seq, qual, _v = decode_span_payload_host(
+                src, s, geometry, check_crc,
+                intervals=intervals, header=header)
+            return prefix, seq, qual
+        with METRICS.wall_timer("pipeline.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
-            return out if out is not None else (
-                np.empty((0, PREFIX), np.uint8),
-                np.empty((0, geometry.seq_stride), np.uint8),
-                np.empty((0, geometry.qual_stride), np.uint8))
+        return out if out is not None else (
+            np.empty((0, PREFIX), np.uint8),
+            np.empty((0, geometry.seq_stride), np.uint8),
+            np.empty((0, geometry.qual_stride), np.uint8))
 
-        stream = _iter_windowed(pool, spans, decode, window)
-        group: List[Tuple[np.ndarray, ...]] = []
-        counts: List[int] = []
-
-        def emit() -> Tuple[List[np.ndarray], np.ndarray]:
-            # per-device bucket caps: the dispatch height must be shared
-            # (one shard_map step), but each device only copies its OWN
-            # rows into the zeroed group tile — one skewed device no
-            # longer makes the other seven memcpy its padding
-            b = cap if geometry.fixed_shape else \
-                max(_bucket_cap(c, cap, geometry.block_n) for c in counts)
-            cvec = np.zeros((n_dev,), dtype=np.int32)
-            cvec[:len(counts)] = counts
-            stacked = []
-            for j, w in enumerate(widths):
-                out = np.zeros((n_dev, b, w), dtype=np.uint8)
-                for i, g in enumerate(group):
-                    out[i, :counts[i]] = g[j][:counts[i]]
-                stacked.append(out)
-            group.clear()
-            counts.clear()
-            return stacked, cvec
-
-        for tiles, count in _iter_tile_tuples(stream, cap, widths):
-            group.append(tiles)
-            counts.append(count)
-            if len(group) == n_dev:
-                yield emit()
-        if group:
-            yield emit()
+    stream = _iter_windowed(pool, spans, decode, window)
+    # balance=True only for psum'd stats consumers (seq_stats_file);
+    # tensor_batches keeps the serial row placement, so public batches
+    # stay byte-stable across releases
+    fp = FeedPipeline(n_dev, cap, [TileSpec((w,), np.uint8) for w in widths],
+                      block_n=geometry.block_n,
+                      fixed_shape=geometry.fixed_shape, balance=balance,
+                      config=config)
+    if emit_fn is not None:
+        yield from fp.stream(stream, emit_fn)
+    else:
+        for arrays, counts in fp.groups(stream):
+            yield [a.copy() for a in arrays], counts.copy()
 
 
 class _StatTotals:
@@ -979,57 +986,40 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
     spans = list(spans)
     if quarantine is not None and quarantine.total_spans is None:
         quarantine.total_spans = len(spans)
-    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
-    specs = (geometry.seq_stride, geometry.qual_stride, (None, np.int32))
-    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
-        def decode(span):
-            def inner(s):
-                if tiles_fn is not None:
-                    return tiles_fn(s, geometry)
-                return fragments_to_payload_tiles(
-                    read_span_fn(s), geometry.seq_stride,
-                    geometry.qual_stride, geometry.max_len)
+    pool = decode_pool(config)
+
+    def decode(span):
+        def inner(s):
+            if tiles_fn is not None:
+                return tiles_fn(s, geometry)
+            return fragments_to_payload_tiles(
+                read_span_fn(s), geometry.seq_stride,
+                geometry.qual_stride, geometry.max_len)
+        with METRICS.wall_timer("pipeline.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
-            return out if out is not None else (
-                np.empty((0, geometry.seq_stride), np.uint8),
-                np.empty((0, geometry.qual_stride), np.uint8),
-                np.empty((0,), np.int32))
+        return out if out is not None else (
+            np.empty((0, geometry.seq_stride), np.uint8),
+            np.empty((0, geometry.qual_stride), np.uint8),
+            np.empty((0,), np.int32))
 
-        stream = _iter_windowed(pool, spans, decode, 2 * n_workers)
-        group: List[Tuple[np.ndarray, ...]] = []
-        counts: List[int] = []
+    stream = _iter_windowed(pool, spans, decode,
+                            2 * decode_pool_size(config))
+    specs = (geometry.seq_stride, geometry.qual_stride, (None, np.int32))
+    fp = FeedPipeline(n_dev, cap, specs, block_n=geometry.block_n,
+                      fixed_shape=geometry.fixed_shape, config=config)
 
-        def emit() -> Dict:
-            # per-device bucket caps (see iter_payload_tile_groups.emit)
-            b = cap if geometry.fixed_shape else \
-                max(_bucket_cap(c, cap, geometry.block_n) for c in counts)
-            cvec = np.zeros((n_dev,), dtype=np.int32)
-            cvec[:len(counts)] = counts
-            stacked = []
-            for j in range(3):
-                proto = group[0][j]
-                out = np.zeros((n_dev, b) + proto.shape[1:], proto.dtype)
-                for i, g in enumerate(group):
-                    out[i, :counts[i]] = g[j][:counts[i]]
-                stacked.append(out)
-            out = {
-                "seq_packed": jax.device_put(stacked[0], sharding),
-                "qual": jax.device_put(stacked[1], sharding),
-                "lengths": jax.device_put(stacked[2], sharding),
-                "n_records": jax.device_put(cvec, sharding),
-            }
-            group.clear()
-            counts.clear()
-            return out
+    def emit(arrays, counts) -> Dict:
+        # the returned device dict doubles as the slot's in-flight
+        # transfer handle (FeedPipeline.stream contract)
+        return {
+            "seq_packed": jax.device_put(arrays[0], sharding),
+            "qual": jax.device_put(arrays[1], sharding),
+            "lengths": jax.device_put(arrays[2], sharding),
+            "n_records": jax.device_put(counts, sharding),
+        }
 
-        for tile, count in _iter_tile_tuples(stream, cap, specs):
-            group.append(tile)
-            counts.append(count)
-            if len(group) == n_dev:
-                yield emit()
-        if group:
-            yield emit()
+    yield from fp.stream(stream, emit)
 
 
 def make_read_stats_step(mesh: Mesh, geometry: PayloadGeometry,
@@ -1174,61 +1164,46 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         quarantine.total_spans = len(spans)
     step = make_read_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
-    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
-    window = max(1, prefetch) * n_workers
+    pool = decode_pool(config)
+    window = max(1, prefetch) * decode_pool_size(config)
     totals = _StatTotals()
-    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
-        def decode(span):
-            def inner(s):
-                if fast_tiles:
-                    return text_to_tiles(
-                        ds.read_span_text(s), geometry.seq_stride,
-                        geometry.qual_stride, geometry.max_len, qual_offset)
-                frags = ds.read_span(s)
-                return fragments_to_payload_tiles(
-                    frags, geometry.seq_stride, geometry.qual_stride,
-                    geometry.max_len)
+
+    def decode(span):
+        def inner(s):
+            if fast_tiles:
+                return text_to_tiles(
+                    ds.read_span_text(s), geometry.seq_stride,
+                    geometry.qual_stride, geometry.max_len, qual_offset)
+            frags = ds.read_span(s)
+            return fragments_to_payload_tiles(
+                frags, geometry.seq_stride, geometry.qual_stride,
+                geometry.max_len)
+        with METRICS.wall_timer("pipeline.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
-            return out if out is not None else (
-                np.empty((0, geometry.seq_stride), np.uint8),
-                np.empty((0, geometry.qual_stride), np.uint8),
-                np.empty((0,), np.int32))
+        return out if out is not None else (
+            np.empty((0, geometry.seq_stride), np.uint8),
+            np.empty((0, geometry.qual_stride), np.uint8),
+            np.empty((0,), np.int32))
 
-        stream = _iter_windowed(pool, spans, decode, window)
-        group: List[Tuple[np.ndarray, ...]] = []
-        counts: List[int] = []
+    stream = _iter_windowed(pool, spans, decode, window)
+    # the shared feed: in-place ring packing replaces the old per-group
+    # np.stack of freshly zero-padded shards, and each device only pays
+    # copy work for its own rows (the per-device bucket-cap behavior the
+    # BAM payload path already had); balance spreads the final partial
+    # group over all shards (stats are psum'd, placement-invariant)
+    specs = (geometry.seq_stride, geometry.qual_stride, (None, np.int32))
+    fp = FeedPipeline(n_dev, cap, specs, block_n=geometry.block_n,
+                      fixed_shape=geometry.fixed_shape, balance=True,
+                      config=config)
 
-        def dispatch():
-            b = cap if geometry.fixed_shape else \
-                _bucket_cap(max(counts), cap, geometry.block_n)
-            seqs = np.stack([g[0][:b] for g in group] + [
-                np.zeros((b, geometry.seq_stride), np.uint8)
-                for _ in range(n_dev - len(group))])
-            quals = np.stack([g[1][:b] for g in group] + [
-                np.zeros((b, geometry.qual_stride), np.uint8)
-                for _ in range(n_dev - len(group))])
-            lens = np.stack([g[2][:b] for g in group] + [
-                np.zeros((b,), np.int32)
-                for _ in range(n_dev - len(group))])
-            cvec = np.zeros((n_dev,), dtype=np.int32)
-            cvec[:len(counts)] = counts
-            args = [jax.device_put(a, sharding)
-                    for a in (seqs, quals, lens)]
-            c = jax.device_put(cvec, sharding)
-            totals.add(*step(*args, c))   # async; drained once at the end
-            group.clear()
-            counts.clear()
+    def dispatch(arrays, counts):
+        args = [jax.device_put(a, sharding) for a in arrays]
+        c = jax.device_put(counts, sharding)
+        totals.add(*step(*args, c))   # async; drained once at the end
+        return (*args, c)  # in-flight handles: the ring waits before reuse
 
-        specs = (geometry.seq_stride, geometry.qual_stride,
-                 (None, np.int32))
-        for tile, count in _iter_tile_tuples(stream, cap, specs):
-            group.append(tile)
-            counts.append(count)
-            if len(group) == n_dev:
-                dispatch()
-        if group:
-            dispatch()
+    fp.feed(stream, dispatch)
     return _attach_quarantine(_payload_stats_result(totals), quarantine)
 
 
@@ -1270,12 +1245,19 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     totals = _StatTotals()
     if quarantine is None:
         quarantine = QuarantineManifest()
-    for stacked, cvec in iter_payload_tile_groups(
-            path, spans, geometry, n_dev, config, prefetch, header=header,
-            quarantine=quarantine):
-        args = [jax.device_put(a, sharding) for a in stacked]
-        c = jax.device_put(cvec, sharding)
+    def emit(arrays, counts):
+        # the group generator packs on its own thread (FeedPipeline);
+        # this runs on the dispatch side of the double buffer, and the
+        # returned device arrays are the slot's in-flight handles
+        args = [jax.device_put(a, sharding) for a in arrays]
+        c = jax.device_put(counts, sharding)
         totals.add(*step(*args, c))       # async; drained once at the end
+        return (*args, c)
+
+    for _ in iter_payload_tile_groups(
+            path, spans, geometry, n_dev, config, prefetch, header=header,
+            quarantine=quarantine, balance=True, emit_fn=emit):
+        pass
     return _attach_quarantine(_payload_stats_result(totals), quarantine)
 
 
@@ -1335,59 +1317,51 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     if quarantine.total_spans is None:
         quarantine.total_spans = len(spans)
     src = _resilient_source(path, config)
-    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
-    window = max(1, prefetch) * n_workers
+    pool = decode_pool(config)
+    window = max(1, prefetch) * decode_pool_size(config)
     totals_vec = None
-    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
-        check_crc = bool(getattr(config, "check_crc", False))
-        intervals = parse_config_intervals(config, header)
+    check_crc = bool(getattr(config, "check_crc", False))
+    intervals = parse_config_intervals(config, header)
 
-        def decode(span):
-            def inner(s):
-                rows, _voffs = decode_span_prefix_host(
-                    src, s, check_crc, "auto", projection,
-                    want_voffs=False, intervals=intervals, header=header)
-                return rows
-            with METRICS.timer("pipeline.host_decode"):
-                out = decode_with_retry(inner, span, config,
-                                        quarantine=quarantine)
-            return out if out is not None \
-                else np.empty((0, row_bytes), dtype=np.uint8)
+    def decode(span):
+        def inner(s):
+            rows, _voffs = decode_span_prefix_host(
+                src, s, check_crc, "auto", projection,
+                want_voffs=False, intervals=intervals, header=header)
+            return rows
+        with METRICS.timer("pipeline.host_decode"), \
+                METRICS.wall_timer("pipeline.host_decode_wall"):
+            out = decode_with_retry(inner, span, config,
+                                    quarantine=quarantine)
+        return out if out is not None \
+            else np.empty((0, row_bytes), dtype=np.uint8)
 
-        row_stream = _iter_windowed(pool, spans, decode, window)
-        # Fresh staging buffers per group + NO blocking between dispatches:
-        # device_put/step calls queue asynchronously from this one thread
-        # (sequential issue keeps the tunnel link from collapsing the way
-        # concurrent multi-thread puts do), and the single device_get at the
-        # end drains the whole queue.
-        group_tiles: List[np.ndarray] = []
-        group_counts: List[int] = []
+    row_stream = _iter_windowed(pool, spans, decode, window)
+    # Ring-staged groups + NO blocking between dispatches: the packer
+    # thread writes rows straight into a leased [n_dev, cap, row] slot
+    # (no per-group allocation, no np.stack, no pad memset) while THIS
+    # thread issues device_put/step for the previous group — sequential
+    # single-thread issue keeps the tunnel link from collapsing the way
+    # concurrent multi-thread puts do, and the single device_get at the
+    # end drains the whole async queue.  balance: the final partial
+    # group spreads across all shards and shrinks to a dispatch bucket
+    # — a file smaller than one full group otherwise lands entirely on
+    # device 0 and ships n_dev*cap rows of padding (the 8-device
+    # inverse-scaling tax); the bucket ladder bounds the extra jit
+    # shapes at two.
+    fp = FeedPipeline(n_dev, cap, (TileSpec((row_bytes,), np.uint8),),
+                      balance=True, config=config)
 
-        def dispatch():
-            nonlocal totals_vec
-            tiles = np.stack(group_tiles) if len(group_tiles) > 1 \
-                else group_tiles[0][None]
-            counts = np.zeros((n_dev,), dtype=np.int32)
-            counts[:len(group_counts)] = group_counts
-            if tiles.shape[0] < n_dev:  # final partial group
-                pad = np.zeros((n_dev - tiles.shape[0], cap, row_bytes),
-                               dtype=np.uint8)
-                tiles = np.concatenate([tiles, pad])
-            with METRICS.timer("pipeline.device_put"):
-                t = jax.device_put(tiles, sharding)
-                c = jax.device_put(counts, sharding)
-            vec = step(t, c)
-            totals_vec = vec if totals_vec is None else _ADD(totals_vec, vec)
-            group_tiles.clear()
-            group_counts.clear()
+    def dispatch(arrays, counts):
+        nonlocal totals_vec
+        with METRICS.timer("pipeline.device_put"):
+            t = jax.device_put(arrays[0], sharding)
+            c = jax.device_put(counts, sharding)
+        vec = step(t, c)
+        totals_vec = vec if totals_vec is None else _ADD(totals_vec, vec)
+        return t, c      # in-flight handles: the ring waits before reuse
 
-        for tile, count in _iter_prefix_tiles(row_stream, cap, row_bytes):
-            group_tiles.append(tile)
-            group_counts.append(count)
-            if len(group_tiles) == n_dev:
-                dispatch()
-        if group_tiles:
-            dispatch()
+    fp.feed(((r,) for r in row_stream), dispatch)
     if totals_vec is None:
         host = np.zeros(len(FLAGSTAT_FIELDS), dtype=np.int64)
     else:
@@ -1553,7 +1527,6 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
     sharding = NamedSharding(mesh, P("data"))
     rep = NamedSharding(mesh, P())
     check_crc = bool(getattr(config, "check_crc", False))
-    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
     row_w = _cigar_row_bytes(max_cigar)
     window_depth = None                   # [n_dev, window], device-sharded
     tref = jax.device_put(np.int32(target_refid), rep)
@@ -1563,61 +1536,62 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
     if quarantine is not None and quarantine.total_spans is None:
         quarantine.total_spans = len(spans)
     src = _resilient_source(path, config)
-    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
-        def decode(span):
-            def inner(s):
-                return decode_span_cigar_rows(src, s, max_cigar,
-                                              check_crc)
+    pool = decode_pool(config)
+
+    def decode(span):
+        def inner(s):
+            return decode_span_cigar_rows(src, s, max_cigar,
+                                          check_crc)
+        with METRICS.wall_timer("pipeline.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
-            return out if out is not None else np.zeros((0, row_w),
-                                                        np.uint8)
+        return out if out is not None else np.zeros((0, row_w),
+                                                    np.uint8)
 
-        stream = _iter_windowed(pool, spans, decode,
-                                max(1, prefetch) * n_workers)
-        tiles = _iter_tile_tuples(((r,) for r in stream), tile_records,
-                                  (row_w,))
-        group: List[np.ndarray] = []
-        counts: List[int] = []
+    stream = _iter_windowed(pool, spans, decode,
+                            max(1, prefetch) * decode_pool_size(config))
+    # full-width ring tiles; dispatch slices each group down to its real
+    # pow2-bucketed op width before it crosses the link (fixed_shape:
+    # the HEIGHT never shrinks — the step is cached per (window, mc))
+    # count_bytes=False: this dispatch ships a width-sliced cut of the
+    # ring views, so it counts the real transferred bytes itself
+    fp = FeedPipeline(n_dev, tile_records,
+                      (TileSpec((row_w,), np.uint8),),
+                      fixed_shape=True, count_bytes=False, config=config)
 
-        def dispatch():
-            # most records carry far fewer ops than max_cigar; slice the
-            # tile to the group's real op width (pow2-bucketed so the jit
-            # cache stays small) before it crosses the link
-            mc = 1
-            nc_off = _CIGAR_ROW_HDR - 4
-            for t, c in zip(group, counts):
-                if c:
-                    nc = (t[:c, nc_off].astype(np.int32)
-                          | (t[:c, nc_off + 1].astype(np.int32) << 8))
-                    mc = max(mc, int(nc.max()))
-            if mc > max_cigar:
-                raise PlanError(
-                    f"record with {mc} cigar ops exceeds "
-                    f"max_cigar={max_cigar}; pass a larger max_cigar")
-            mc = min(max_cigar, max(8, 1 << (mc - 1).bit_length()))
-            w = _cigar_row_bytes(mc)
-            t = np.stack([g[:, :w] for g in group]
-                         + [np.zeros((tile_records, w), np.uint8)
-                            for _ in range(n_dev - len(group))])
-            cvec = np.zeros(n_dev, np.int32)
-            cvec[:len(counts)] = counts
-            step = make_coverage_step(mesh, window, mc)
-            out = step(jax.device_put(t, sharding),
-                       jax.device_put(cvec, sharding), tref, wstart)
-            nonlocal window_depth
-            window_depth = out if window_depth is None else \
-                window_depth + out        # shard-local add, no collective
-            group.clear()
-            counts.clear()
+    def dispatch(arrays, counts):
+        # most records carry far fewer ops than max_cigar; slice the
+        # tile to the group's real op width (pow2-bucketed so the jit
+        # cache stays small) before it crosses the link
+        tiles = arrays[0]
+        mc = 1
+        nc_off = _CIGAR_ROW_HDR - 4
+        for dev in range(n_dev):
+            c = int(counts[dev])
+            if c:
+                t = tiles[dev]
+                nc = (t[:c, nc_off].astype(np.int32)
+                      | (t[:c, nc_off + 1].astype(np.int32) << 8))
+                mc = max(mc, int(nc.max()))
+        if mc > max_cigar:
+            raise PlanError(
+                f"record with {mc} cigar ops exceeds "
+                f"max_cigar={max_cigar}; pass a larger max_cigar")
+        mc = min(max_cigar, max(8, 1 << (mc - 1).bit_length()))
+        w = _cigar_row_bytes(mc)
+        step = make_coverage_step(mesh, window, mc)
+        cut = tiles[:, :, :w]
+        METRICS.count("pipeline.dispatch_bytes",
+                      int(cut.nbytes) + int(counts.nbytes))
+        t = jax.device_put(cut, sharding)
+        c = jax.device_put(counts, sharding)
+        out = step(t, c, tref, wstart)
+        nonlocal window_depth
+        window_depth = out if window_depth is None else \
+            window_depth + out        # shard-local add, no collective
+        return t, c      # in-flight handles: the ring waits before reuse
 
-        for (tile,), count in tiles:
-            group.append(tile)
-            counts.append(count)
-            if len(group) == n_dev:
-                dispatch()
-        if group:
-            dispatch()
+    fp.feed(((r,) for r in stream), dispatch)
     if window_depth is None:
         return np.zeros(window, np.int32)
     # one cross-device reduce at the end instead of one psum per dispatch

@@ -1,0 +1,463 @@
+"""Staging rings + the shared host->device feed pipeline.
+
+Before this module, every driver family (flagstat tiles, BAM payload
+stats, FASTQ/QSEQ, CRAM, variant tensors) hand-rolled the same
+emit/dispatch loop — and each emit allocated a fresh
+``np.zeros((n_dev, cap, w))`` group tile, memset it, copied every
+device's rows into it, then synchronously ``device_put`` + stepped it.
+That loop is why the pipeline scaled *inversely* with device count:
+host group-assembly work (memsets + copies, all O(n_dev)) grew with
+every added device while the device waited, serialized behind it.
+
+Two mechanisms replace it:
+
+- **``StagingRing``** — a small ring of preallocated, reusable
+  ``[n_dev, cap, w]`` group buffers.  Emit writes each device's rows in
+  place; a partial tile zeroes only its own tail (rows
+  ``[count, bucket)``), so a full group pays ZERO allocation and ZERO
+  memset.  Slots are leased/released: a slot is handed back to the ring
+  only after its dispatch completed, and the device arrays a dispatch
+  creates ride the slot as IN-FLIGHT handles — the packer waits on
+  them after re-leasing, before writing — so an asynchronous
+  host->device transfer can never still be reading a buffer the packer
+  overwrites (``jax.device_put`` may return before the DMA completes
+  on real TPUs), and the dispatch thread never blocks for it.
+
+- **``FeedPipeline``** — a packer thread assembles group *k+1* into one
+  ring slot while the caller's thread dispatches group *k* from another
+  (depth-2 double buffering).  All JAX calls stay on the caller's
+  thread — transfers keep issuing sequentially from one thread, which
+  the tunneled TPU link requires — while the packing memcpys overlap
+  them.  ``feed()`` drives stats drivers to completion;
+  ``stream()`` powers the generator-shaped ``tensor_batches`` APIs.
+
+Wall-clock accounting rides along: ``pipeline.feed_wall`` (whole feed),
+``pipeline.dispatch_wall`` (device-busy wall inside dispatch calls) and
+the ``pipeline.dispatch_bytes`` counter feed the bench's
+``overlap_efficiency`` ratio — the thread-summed ``METRICS.timer``
+values cannot show overlap, the wall spans can.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.utils.metrics import METRICS
+
+
+def committed_device_put(array, sharding=None):
+    """``jax.device_put`` that returns only after the host->device copy
+    is COMPLETE.  Plain device_put may return while the DMA is still
+    reading the host buffer (PJRT immutable-until-transfer-completes
+    semantics on real TPUs); for a BORROWED ring-slot view that window
+    is an aliasing hazard — the slot is released when dispatch returns
+    and the packer may overwrite it mid-transfer.  Blocking on the
+    RESULT bounds the wait to the transfer itself; compute steps
+    launched afterwards stay async, and the packer keeps assembling the
+    next group on its own thread throughout.  Every feed-path
+    device_put of ring-backed memory must go through here
+    (``jnp.asarray`` is outright forbidden: it aliases host memory on
+    the CPU backend)."""
+    import jax
+
+    out = jax.device_put(array, sharding)
+    jax.block_until_ready(out)
+    return out
+
+
+def bucket_cap(count: int, cap: int, block_n: int = 256) -> int:
+    """Rows to actually dispatch for a partial tile of ``count`` records.
+
+    Full tiles ship at ``cap``; the FINAL partial tile shrinks to the
+    smallest bucket (~cap/16, ~cap/4, cap) that holds it, so a small
+    file pays a kernel over ~its own rows instead of the full padded
+    tile (the small-input dispatch floor: a 10k-read file inside a
+    64k-row tile spent 6x its data in padding).  Buckets are rounded up
+    to the Pallas record-block height ``block_n`` (the kernel asserts
+    divisibility), and a fixed 3-step ladder bounds jit retraces at two
+    extra shapes per step function."""
+    for b in (cap // 16, cap // 4):
+        b = -(-b // block_n) * block_n       # round up to a block multiple
+        if b >= block_n and count <= b < cap:
+            return b
+    return cap
+
+
+@dataclasses.dataclass(frozen=True)
+class TileSpec:
+    """Per-record layout of one array in a tile tuple: trailing shape
+    (``()`` for 1-D series), dtype, and the padding value rows beyond a
+    device's count are filled with (0 for byte tiles, -1 for dosage,
+    NaN for qual columns)."""
+    shape: Tuple[int, ...]
+    dtype: object
+    pad: object = 0
+
+    @classmethod
+    def normalize(cls, spec) -> "TileSpec":
+        """Accept the legacy ``_iter_tile_tuples`` spec forms too: an int
+        width (uint8 [cap, w]) or a (width_or_None, dtype) pair."""
+        if isinstance(spec, TileSpec):
+            return spec
+        if isinstance(spec, (int, np.integer)):
+            return cls((int(spec),), np.uint8, 0)
+        w, dt = spec
+        return cls(() if w is None else (int(w),), dt, 0)
+
+
+class RingSlot:
+    """One leased group buffer set: ``arrays[j]`` is
+    [n_dev, cap, *specs[j].shape], ``counts`` is [n_dev] int32.
+
+    ``in_flight`` carries the device arrays the last dispatch created
+    from these buffers (any pytree); the packer blocks on them after
+    re-leasing the slot and BEFORE writing — so an asynchronous
+    host->device transfer can never still be reading a buffer the
+    packer overwrites, without the dispatch thread ever waiting."""
+    __slots__ = ("arrays", "counts", "index", "in_flight", "_ring")
+
+    def __init__(self, arrays: List[np.ndarray], counts: np.ndarray,
+                 index: int, ring: "StagingRing"):
+        self.arrays = arrays
+        self.counts = counts
+        self.index = index
+        self.in_flight = None
+        self._ring = ring
+
+    def release(self) -> None:
+        self._ring.release(self)
+
+
+def _block_in_flight(handles) -> None:
+    """Wait for every transfer handle in ``handles`` (a pytree of jax
+    arrays, or anything exposing ``block_until_ready``)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(handles):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+class _Cancelled(Exception):
+    """Internal: the other side of the pipeline stopped; unwind quietly."""
+
+
+class StagingRing:
+    """A ring of preallocated group buffers, leased and released.
+
+    ``lease`` blocks until a slot is free (with a cancellation event so
+    an aborted run can't deadlock the packer); ``release`` hands the
+    slot back for reuse.  Buffers are allocated ONCE here — the lint
+    rule PF501 exists to keep fresh per-emit group allocations from
+    creeping back into the feed paths."""
+
+    def __init__(self, n_dev: int, cap: int, specs: Sequence[TileSpec],
+                 slots: int):
+        self.n_dev, self.cap = int(n_dev), int(cap)
+        self.specs = [TileSpec.normalize(s) for s in specs]
+        self.n_slots = max(2, int(slots))
+        self._free: "queue.Queue[RingSlot]" = queue.Queue()
+        self.slots: List[RingSlot] = []
+        for i in range(self.n_slots):
+            arrays = [
+                np.full((self.n_dev, self.cap) + s.shape, s.pad,
+                        dtype=s.dtype)
+                for s in self.specs
+            ]
+            slot = RingSlot(arrays, np.zeros(self.n_dev, np.int32), i, self)
+            self.slots.append(slot)
+            self._free.put(slot)
+
+    def lease(self, cancel: threading.Event) -> RingSlot:
+        while True:
+            try:
+                return self._free.get(timeout=0.05)
+            except queue.Empty:
+                if cancel.is_set():
+                    raise _Cancelled()
+
+    def release(self, slot: RingSlot) -> None:
+        self._free.put(slot)
+
+
+def _put(q: "queue.Queue", item, cancel: threading.Event) -> None:
+    while True:
+        try:
+            q.put(item, timeout=0.05)
+            return
+        except queue.Full:
+            if cancel.is_set():
+                raise _Cancelled()
+
+
+_SENTINEL = object()
+
+
+class FeedPipeline:
+    """The shared group-assembly + double-buffered dispatch engine.
+
+    Construct with the mesh width, the tile cap, and per-array
+    ``TileSpec``s, then either::
+
+        fp.feed(span_arrays_stream, dispatch_fn)      # stats drivers
+
+    or::
+
+        for out in fp.stream(span_arrays_stream, emit_fn):  # datasets
+            ...
+
+    ``span_arrays_stream`` yields per-span TUPLES of row arrays in
+    lockstep (axis 0 = records; empty spans allowed).  The pipeline
+    repacks them across span boundaries into ring-slot group buffers —
+    device ``i`` of a group holds rows ``[i*cap, (i+1)*cap)`` of the
+    concatenated stream, exactly the tiling of the old serial
+    ``_iter_*_tiles`` + emit path (byte-identical, pinned by tests).
+
+    ``dispatch_fn(arrays, counts)`` / ``emit_fn(arrays, counts)`` run on
+    the CALLER's thread with ``arrays[j]`` a ``[n_dev, bucket, w]`` view
+    of a leased ring slot and ``counts`` the per-device row counts.
+    The buffers are BORROWED: valid until the call returns (``feed``)
+    or until the generator is advanced (``stream``) — consumers must
+    ``device_put``/copy before then, never retain the views.  That
+    borrow is what makes the ring safe: the slot is released (and can
+    be overwritten by the packer) only after the consumer is done.
+    """
+
+    def __init__(self, n_dev: int, cap: int, specs: Sequence[TileSpec],
+                 *, block_n: int = 256, fixed_shape: bool = False,
+                 balance: bool = False,
+                 ring_slots: Optional[int] = None,
+                 dispatch_depth: Optional[int] = None,
+                 config: Optional[HBamConfig] = None,
+                 count_bytes: bool = True,
+                 name: str = "pipeline"):
+        config = config if config is not None else DEFAULT_CONFIG
+        self.n_dev, self.cap = int(n_dev), int(cap)
+        self.specs = [TileSpec.normalize(s) for s in specs]
+        self.block_n = int(block_n)
+        self.fixed_shape = bool(fixed_shape)
+        self.balance = bool(balance)
+        self.ring_slots = int(ring_slots if ring_slots is not None
+                              else getattr(config, "feed_ring_slots", 2))
+        self.dispatch_depth = max(1, int(
+            dispatch_depth if dispatch_depth is not None
+            else getattr(config, "feed_dispatch_depth", 2)))
+        # count_bytes=False: the dispatcher transfers a narrower slice
+        # of the ring views (coverage's op-width cut) and counts its
+        # own pipeline.dispatch_bytes — the view nbytes would overstate
+        self.count_bytes = bool(count_bytes)
+        self.name = name
+        self.dispatches = 0
+        self.dispatch_bytes = 0
+        self._device_wall = 0.0
+        self._total_wall = 0.0
+
+    @property
+    def overlap_efficiency(self) -> float:
+        """Device-busy wall / total feed wall for the last run — the
+        ratio the bench reports to prove the overlap is real (1.0 means
+        the host never made the dispatch side wait)."""
+        return (self._device_wall / self._total_wall
+                if self._total_wall > 0 else 0.0)
+
+    # -- packer side (its own thread) ---------------------------------------
+
+    def _pack_loop(self, stream: Iterable[Tuple[np.ndarray, ...]],
+                   q: "queue.Queue", cancel: threading.Event,
+                   ring: StagingRing) -> None:
+        it = iter(stream)
+        parts: "collections.deque[Tuple[np.ndarray, ...]]" = \
+            collections.deque()
+        have = 0
+        exhausted = False
+
+        def pull_until(need: int) -> None:
+            nonlocal exhausted, have
+            while not exhausted and have < need:
+                if cancel.is_set():
+                    raise _Cancelled()
+                try:
+                    arrays = next(it)
+                except StopIteration:
+                    exhausted = True
+                    return
+                arrays = tuple(arrays)
+                n = arrays[0].shape[0]
+                if n:
+                    parts.append(arrays)
+                    have += n
+
+        while True:
+            # balance needs one group's worth buffered up front (the
+            # tail split depends on the total); serial mode pulls
+            # lazily so tensor_batches never holds an extra group of
+            # decoded spans in memory
+            pull_until(self.n_dev * self.cap if self.balance else 1)
+            if not have:
+                break
+            slot = ring.lease(cancel)
+            if slot.in_flight is not None:
+                # the slot's previous dispatch may still be transferring
+                # from these buffers: wait HERE, on the packer thread,
+                # where the wait overlaps the consumer's next dispatch
+                _block_in_flight(slot.in_flight)
+                slot.in_flight = None
+            counts = slot.counts
+            counts[:] = 0
+            target = self.cap
+            if self.balance and exhausted and have < self.n_dev * self.cap:
+                # balanced tail (stats drivers): the serial fill order
+                # would park the whole remainder on the first devices
+                # and leave the rest idle — a small file on an 8-wide
+                # mesh then pays one device's wall time AND a full-cap
+                # padded transfer.  Spreading the tail evenly keeps
+                # every shard busy and lets the bucket ladder shrink
+                # the dispatch.  psum-invariant, so results are
+                # unchanged; tensor_batches keeps the serial order
+                # (balance=False) for byte-stable public batches.
+                target = max(1, -(-have // self.n_dev))
+            for dev in range(self.n_dev):
+                filled = 0
+                while filled < target:
+                    if not parts:
+                        pull_until(1)
+                        if not parts:
+                            break
+                    head = parts[0]
+                    k = min(target - filled, head[0].shape[0])
+                    for dst, src in zip(slot.arrays, head):
+                        dst[dev, filled:filled + k] = src[:k]
+                    if k == head[0].shape[0]:
+                        parts.popleft()
+                    else:
+                        parts[0] = tuple(h[k:] for h in head)
+                    filled += k
+                    have -= k
+                counts[dev] = filled
+                if not parts and exhausted:
+                    break
+            bucket = self.cap
+            if not self.fixed_shape:
+                # per-device bucket caps: the dispatch height is shared
+                # (one shard_map step) but sized by the LARGEST shard,
+                # so the final partial group shrinks to the smallest
+                # bucket holding it (bucket_cap is monotonic in count,
+                # so the max over devices equals bucket_cap(max count))
+                bucket = max(bucket_cap(int(c), self.cap, self.block_n)
+                             for c in counts)
+            # zero ONLY the written tail: rows [count, bucket) per
+            # device.  Rows past the bucket are never dispatched, and
+            # rows under the count are fully overwritten — a full group
+            # therefore pays no memset at all.
+            for spec, dst in zip(self.specs, slot.arrays):
+                for dev in range(self.n_dev):
+                    c = int(counts[dev])
+                    if c < bucket:
+                        dst[dev, c:bucket] = spec.pad
+            _put(q, (slot, bucket), cancel)
+
+    # -- consumer side (the caller's thread) --------------------------------
+
+    def _slots(self, stream: Iterable[Tuple[np.ndarray, ...]]
+               ) -> Iterator[Tuple[RingSlot, Tuple[np.ndarray, ...]]]:
+        """Yield leased ``(slot, bucket_views)`` pairs; the slot is
+        released when the generator is advanced (or closed) — the
+        depth-2 contract lives here."""
+        ring = StagingRing(self.n_dev, self.cap, self.specs,
+                           self.ring_slots)
+        q: "queue.Queue" = queue.Queue(maxsize=max(1,
+                                                   self.dispatch_depth - 1))
+        cancel = threading.Event()
+        errs: List[BaseException] = []
+
+        def pack() -> None:
+            try:
+                self._pack_loop(stream, q, cancel, ring)
+            except _Cancelled:
+                return
+            except BaseException as e:  # noqa: BLE001 — crosses the thread
+                errs.append(e)
+            try:
+                _put(q, _SENTINEL, cancel)
+            except _Cancelled:
+                pass
+
+        packer = threading.Thread(target=pack, name="hbam-feed-pack",
+                                  daemon=True)
+        self._device_wall = 0.0
+        self.dispatches = 0
+        self.dispatch_bytes = 0
+        t0 = time.perf_counter()
+        packer.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                slot, bucket = item
+                arrays = tuple(a[:, :bucket] for a in slot.arrays)
+                try:
+                    yield slot, arrays
+                finally:
+                    slot.release()
+        finally:
+            cancel.set()
+            packer.join()
+            self._total_wall = time.perf_counter() - t0
+            METRICS.add_wall(f"{self.name}.feed_wall", self._total_wall)
+        if errs:
+            raise errs[0]
+
+    def groups(self, stream: Iterable[Tuple[np.ndarray, ...]]
+               ) -> Iterator[Tuple[Tuple[np.ndarray, ...], np.ndarray]]:
+        """Yield borrowed ``(arrays, counts)`` group batches (valid until
+        the generator is advanced).  NOTE: this pass-through path has no
+        in-flight transfer tracking — a consumer that hands these views
+        to jax itself must use ``committed_device_put`` (or copy first);
+        ``stream``/``feed`` consumers get the tracking for free."""
+        for slot, arrays in self._slots(stream):
+            yield arrays, slot.counts
+
+    def _account(self, arrays: Tuple[np.ndarray, ...], counts: np.ndarray,
+                 dt: float) -> None:
+        self._device_wall += dt
+        self.dispatches += 1
+        if self.count_bytes:
+            n = sum(int(a.nbytes) for a in arrays) + int(counts.nbytes)
+            self.dispatch_bytes += n
+            METRICS.count("pipeline.dispatch_bytes", n)
+        METRICS.add_wall(f"{self.name}.dispatch_wall", dt)
+
+    def stream(self, span_stream: Iterable[Tuple[np.ndarray, ...]],
+               emit_fn: Callable) -> Iterator:
+        """Generator mode for ``tensor_batches``-shaped APIs: yields
+        ``emit_fn(arrays, counts)`` per group.  The borrowed buffers
+        stay valid until the generator is advanced for the NEXT group.
+        ``emit_fn`` should ``jax.device_put`` the views (plain, NOT
+        blocking) and RETURN the resulting device arrays (any pytree):
+        the return value is attached to the ring slot as its in-flight
+        transfer handle, and the packer waits on it before reusing the
+        buffers — asynchronous transfers stay safe without the dispatch
+        thread ever blocking."""
+        for slot, arrays in self._slots(span_stream):
+            t0 = time.perf_counter()
+            out = emit_fn(arrays, slot.counts)
+            self._account(arrays, slot.counts, time.perf_counter() - t0)
+            slot.in_flight = out
+            yield out
+
+    def feed(self, span_stream: Iterable[Tuple[np.ndarray, ...]],
+             dispatch_fn: Callable) -> int:
+        """Drive the whole stream through ``dispatch_fn`` (same handle
+        contract as ``stream``: return the device arrays made from the
+        borrowed buffers); returns the number of dispatched groups."""
+        for _ in self.stream(span_stream, dispatch_fn):
+            pass
+        return self.dispatches

@@ -15,9 +15,8 @@ allele frequency, and per-sample call rates in a single pass.
 """
 from __future__ import annotations
 
-import concurrent.futures as cf
 import dataclasses
-import os
+import itertools
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -26,13 +25,15 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hadoop_bam_tpu.parallel.mesh import shard_map
+from hadoop_bam_tpu.parallel.staging import FeedPipeline
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.vcf import VariantBatch, VCFHeader
 from hadoop_bam_tpu.parallel.pipeline import (
-    _STEP_CACHE, _StatTotals, _bucket_cap, _iter_windowed,
-    pipeline_span_count,
+    _STEP_CACHE, _StatTotals, _iter_windowed, pipeline_span_count,
 )
+from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.utils.pools import decode_pool, decode_pool_size
 
 # dispatch-bucket granularity for variant tiles (no Pallas block
 # constraint on this path; 64 keeps the jit shape ladder tiny)
@@ -379,8 +380,15 @@ def _iter_variant_tiles(cols_stream, cap: int, geometry: VariantGeometry
     The tile schema is taken from the first span's dict, so the feed
     accepts both the stats schema (chrom/pos/flags/dosage) and extended
     columnar dicts (e.g. formats/bcf_columns.py's rlen/qual/n_allele/
-    n_fmt columns) without either side hard-coding the other."""
-    parts: List[Dict[str, np.ndarray]] = []
+    n_fmt columns) without either side hard-coding the other.
+
+    Serial tiler — the live drivers feed through the shared
+    parallel/staging.FeedPipeline (via _variant_feed_specs below); this
+    stays as the byte-identity oracle for its tests."""
+    from collections import deque
+
+    # deque: parts.pop(0) was O(n^2) on many-small-span plans
+    parts: "deque[Dict[str, np.ndarray]]" = deque()
     have = 0
     proto: Dict[str, np.ndarray] = {}
 
@@ -406,7 +414,7 @@ def _iter_variant_tiles(cols_stream, cap: int, geometry: VariantGeometry
             for k in tile:
                 tile[k][filled:filled + m] = head[k][:m]
             if m == head["chrom"].shape[0]:
-                parts.pop(0)
+                parts.popleft()
             else:
                 parts[0] = {k: v[m:] for k, v in head.items()}
             filled += m
@@ -423,6 +431,43 @@ def _iter_variant_tiles(cols_stream, cap: int, geometry: VariantGeometry
             yield emit(cap)
     if have:
         yield emit(have)
+
+
+def _variant_feed_specs(proto: Dict[str, np.ndarray]):
+    """Key order + TileSpecs for feeding schema-dict variant tiles
+    through the shared FeedPipeline (parallel/staging.py).  The schema
+    comes from the first span's dict — same genericity as
+    _iter_variant_tiles — and pads mirror its empty_tile: -1 for
+    dosage, NaN for qual, 0 elsewhere."""
+    from hadoop_bam_tpu.parallel.staging import TileSpec
+
+    keys = list(proto)
+    specs = []
+    for k in keys:
+        v = proto[k]
+        pad = -1 if k == "dosage" else (np.nan if k == "qual" else 0)
+        specs.append(TileSpec(tuple(v.shape[1:]), v.dtype, pad))
+    return keys, specs
+
+
+def variant_feed(cols_stream, n_dev: int, cap: int,
+                 config: HBamConfig = DEFAULT_CONFIG, **fp_kwargs):
+    """Peek the first span's column dict for the tile schema and build
+    the shared feed over it.  Returns ``(keys, fp, tuples)`` — or
+    ``(None, None, None)`` for an empty stream — where ``tuples`` is
+    the dict stream re-threaded as key-ordered array tuples for
+    ``fp.feed``/``fp.stream``.  The one place the
+    stats driver and VcfDataset.tensor_batches share their wiring, so
+    schema handling cannot drift between them."""
+    stream = iter(cols_stream)
+    first = next(stream, None)
+    if first is None:
+        return None, None, None
+    keys, specs = _variant_feed_specs(first)
+    fp = FeedPipeline(n_dev, cap, specs, config=config, **fp_kwargs)
+    tuples = (tuple(d[k] for k in keys)
+              for d in itertools.chain([first], stream))
+    return keys, fp, tuples
 
 
 def make_variant_stats_step(mesh: Mesh, geometry: VariantGeometry,
@@ -504,60 +549,43 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
                                                        config))
     step = make_variant_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
-    n_workers = min(32, max(4, (os.cpu_count() or 4) * 4))
-    window = max(1, prefetch) * n_workers
+    pool = decode_pool(config)
+    window = max(1, prefetch) * decode_pool_size(config)
     totals = _StatTotals()
-    with cf.ThreadPoolExecutor(max_workers=n_workers) as pool:
-        from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
+    from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
 
-        def decode(span):
-            def inner(s):
-                text = ds.read_span_text(s)
-                if text is not None:  # fast tokenizer, no record objects
-                    return pack_variant_tiles_from_text(text, header,
-                                                        geometry)
-                return bcf_span_stat_columns(ds.path, s, header, geometry,
-                                             ds._is_bgzf_bcf)
+    def decode(span):
+        def inner(s):
+            text = ds.read_span_text(s)
+            if text is not None:  # fast tokenizer, no record objects
+                return pack_variant_tiles_from_text(text, header,
+                                                    geometry)
+            return bcf_span_stat_columns(ds.path, s, header, geometry,
+                                         ds._is_bgzf_bcf)
+        with METRICS.wall_timer("pipeline.host_decode_wall"):
             out = decode_with_retry(inner, span, config)
-            if out is not None:
-                return out
-            return pack_variant_tiles(VariantBatch([], header), geometry)
+        if out is not None:
+            return out
+        return pack_variant_tiles(VariantBatch([], header), geometry)
 
-        stream = _iter_windowed(pool, spans, decode, window)
-        group: List[Dict[str, np.ndarray]] = []
-        counts: List[int] = []
+    stream = _iter_windowed(pool, spans, decode, window)
+    # ring-fed groups (variant_feed peeks the schema): rows write in
+    # place, a skewed device no longer makes the other seven copy its
+    # padding, and the balanced FINAL group spreads over all shards and
+    # shrinks to a dispatch bucket
+    keys, fp, tuples = variant_feed(stream, n_dev, cap, config,
+                                    block_n=_VARIANT_BLOCK_N,
+                                    balance=True)
+    if fp is not None:
+        def dispatch(arrays, counts):
+            named = dict(zip(keys, arrays))
+            args = [jax.device_put(named[k], sharding)
+                    for k in ("chrom", "pos", "flags", "dosage")]
+            c = jax.device_put(counts, sharding)
+            totals.add(*step(*args, c))  # async; drained once at the end
+            return (*args, c)  # in-flight handles: the ring waits on them
 
-        def dispatch():
-            # the dispatch height is shared across the mesh (one
-            # shard_map step), but each device only pays copy work for
-            # its own rows: a skewed device no longer makes the other
-            # seven copy its padding, and the FINAL partial group
-            # shrinks to the smallest bucket that holds the largest
-            # per-device count (the small-input dispatch floor,
-            # mirroring pipeline.py's payload emit)
-            b = max(_bucket_cap(c, cap, _VARIANT_BLOCK_N)
-                    for c in counts)
-            cvec = np.zeros((n_dev,), dtype=np.int32)
-            cvec[:len(counts)] = counts
-            args = []
-            for k in ("chrom", "pos", "flags", "dosage"):
-                proto = group[0][k]
-                out = np.zeros((n_dev, b) + proto.shape[1:], proto.dtype)
-                for i, g in enumerate(group):
-                    out[i, :counts[i]] = g[k][:counts[i]]
-                args.append(jax.device_put(out, sharding))
-            c = jax.device_put(cvec, sharding)
-            totals.add(*step(*args, c))   # async; drained once at the end
-            group.clear()
-            counts.clear()
-
-        for tile, count in _iter_variant_tiles(stream, cap, geometry):
-            group.append(tile)
-            counts.append(count)
-            if len(group) == n_dev:
-                dispatch()
-        if group:
-            dispatch()
+        fp.feed(tuples, dispatch)
     if not totals:
         return {"n_variants": 0, "n_snp": 0, "n_pass": 0, "mean_af": 0.0,
                 "n_af": 0, "sample_callrate": np.zeros(header.n_samples)}

@@ -20,6 +20,9 @@ class Metrics:
         self.counters: Dict[str, int] = defaultdict(int)
         self.timers: Dict[str, float] = defaultdict(float)
         self.timer_calls: Dict[str, int] = defaultdict(int)
+        self.wall_timers: Dict[str, float] = defaultdict(float)
+        self.wall_calls: Dict[str, int] = defaultdict(int)
+        self._wall_active: Dict[str, list] = {}
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -39,7 +42,8 @@ class Metrics:
         with self._lock:
             return {"counters": dict(self.counters),
                     "timers": dict(self.timers),
-                    "timer_calls": dict(self.timer_calls)}
+                    "timer_calls": dict(self.timer_calls),
+                    "wall_timers": dict(self.wall_timers)}
 
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:
@@ -51,6 +55,40 @@ class Metrics:
             with self._lock:
                 self.timers[name] += dt
                 self.timer_calls[name] += 1
+
+    @contextlib.contextmanager
+    def wall_timer(self, name: str) -> Iterator[None]:
+        """WALL-CLOCK span aggregation, distinct from ``timer``: spans of
+        the same name that overlap in time (pool threads decoding
+        concurrently) merge into their union, so the aggregate reports
+        how long the stage occupied the wall — not thread-summed work
+        seconds, which can exceed wall time and make pipeline overlap
+        invisible (the bench's stage_timer_note caveat)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            st = self._wall_active.setdefault(name, [0, t0])
+            if st[0] == 0:
+                st[1] = t0
+            st[0] += 1
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            with self._lock:
+                st = self._wall_active.get(name)
+                if st is None:      # reset() raced an active span
+                    return
+                st[0] -= 1
+                if st[0] == 0:
+                    self.wall_timers[name] += t1 - st[1]
+                    self.wall_calls[name] += 1
+
+    def add_wall(self, name: str, seconds: float) -> None:
+        """Record an externally-measured wall span (the FeedPipeline's
+        packer/dispatch accounting measures its own intervals)."""
+        with self._lock:
+            self.wall_timers[name] += seconds
+            self.wall_calls[name] += 1
 
     @contextlib.contextmanager
     def trace(self, name: str) -> Iterator[None]:
@@ -68,6 +106,9 @@ class Metrics:
             tot = self.timers[k]
             lines.append(f"timer   {k} = {tot:.4f}s over {calls} calls "
                          f"({tot / max(calls, 1) * 1e3:.2f} ms/call)")
+        for k in sorted(self.wall_timers):
+            lines.append(f"wall    {k} = {self.wall_timers[k]:.4f}s over "
+                         f"{self.wall_calls[k]} span(s)")
         return "\n".join(lines)
 
     def reset(self) -> None:
@@ -75,6 +116,9 @@ class Metrics:
             self.counters.clear()
             self.timers.clear()
             self.timer_calls.clear()
+            self.wall_timers.clear()
+            self.wall_calls.clear()
+            self._wall_active.clear()
 
 
 METRICS = Metrics()

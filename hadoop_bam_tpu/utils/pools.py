@@ -1,0 +1,72 @@
+"""The process-wide shared decode pool.
+
+Every driver call used to spin up (and tear down) its own
+``ThreadPoolExecutor`` — a per-call tax of worker-thread creation plus a
+join on exit, multiplied by the number of driver invocations in a run
+(the bench alone makes dozens).  Decode work is uniform across drivers
+(fetch + inflate + pack a span), so one pool sized once from the host's
+CPU count serves them all; ``set_decode_pool`` injects a replacement for
+tests (a recording pool, a single-thread pool for determinism).
+
+The pool is created lazily on first use.  ``config.decode_pool_workers``
+overrides the size at creation time only — the first caller wins, later
+configs get the existing pool (one process, one pool, by design).
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import threading
+from typing import Optional, Tuple
+
+_LOCK = threading.Lock()
+_POOL: Optional[cf.ThreadPoolExecutor] = None
+_POOL_SIZE = 0
+
+
+def default_pool_size(config=None) -> int:
+    """Worker count for a fresh pool: config.decode_pool_workers when
+    set, else the measured sweet spot of 4x CPUs in [4, 32] (decode
+    threads block on I/O about as often as they inflate)."""
+    n = getattr(config, "decode_pool_workers", None) if config else None
+    if n:
+        return max(1, int(n))
+    return min(32, max(4, (os.cpu_count() or 4) * 4))
+
+
+def decode_pool(config=None) -> cf.ThreadPoolExecutor:
+    """The shared decode executor (created on first call, never torn
+    down — idle workers cost nothing, re-creation per driver call cost
+    thread spawns + a join on every invocation)."""
+    global _POOL, _POOL_SIZE
+    with _LOCK:
+        if _POOL is None:
+            _POOL_SIZE = default_pool_size(config)
+            _POOL = cf.ThreadPoolExecutor(
+                max_workers=_POOL_SIZE, thread_name_prefix="hbam-decode")
+        return _POOL
+
+
+def decode_pool_size(config=None) -> int:
+    """Worker count of the shared pool (materializing it if needed) —
+    what the drivers size their prefetch windows from."""
+    decode_pool(config)
+    return _POOL_SIZE
+
+
+def set_decode_pool(pool: Optional[cf.ThreadPoolExecutor],
+                    size: Optional[int] = None
+                    ) -> Tuple[Optional[cf.ThreadPoolExecutor], int]:
+    """Injection hook for tests: install ``pool`` (with its advertised
+    ``size``) and return the previous (pool, size) for restoration.
+    ``set_decode_pool(None)`` drops the override so the next
+    ``decode_pool`` call creates a fresh default pool.  The caller owns
+    shutdown of any pool it injects (and of a returned previous pool it
+    chooses not to restore)."""
+    global _POOL, _POOL_SIZE
+    with _LOCK:
+        prev, prev_size = _POOL, _POOL_SIZE
+        _POOL = pool
+        _POOL_SIZE = 0 if pool is None else int(
+            size if size is not None else getattr(pool, "_max_workers", 1))
+        return prev, prev_size

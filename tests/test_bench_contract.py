@@ -58,7 +58,15 @@ def _fill_state(bench, n_notes=6):
                 {"n_devices": n, "jax_devices": n, "file_records": 100000,
                  "flagstat_records_per_sec": 862000.0 / n,
                  "flagstat_stage_seconds_per_run": {"pipeline.inflate": 0.2},
+                 "flagstat_wall_seconds_per_run":
+                     {"pipeline.feed_wall": 0.31,
+                      "pipeline.dispatch_wall": 0.24,
+                      "pipeline.host_decode_wall": 0.28},
+                 "flagstat_overlap_efficiency": 0.774,
+                 "flagstat_dispatch_bytes": 3301400,
                  "seq_stats_records_per_sec": 250000.0 / n,
+                 "seq_stats_overlap_efficiency": 0.61,
+                 "seq_stats_dispatch_bytes": 76600000,
                  "coverage_records_per_sec": 400000.0 / n}
                 for n in (1, 8, 2, 4)],
         },
@@ -97,6 +105,23 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     assert any("note" in c for c in full["components"])
     assert "flagstat_stage_seconds_per_run" in \
         full["scaling"]["devices"][0]
+
+
+def test_scaling_rows_pin_feed_overlap_fields(bench):
+    """The r8 feed-pipeline fields ride the full scaling rows (and the
+    compact final line still fits the budget with them aboard): per
+    driver, ``*_overlap_efficiency`` (device-busy wall / feed wall from
+    Metrics.wall_timer spans) and ``*_dispatch_bytes``."""
+    _fill_state(bench)
+    full = bench._snapshot("ok")
+    for row in full["scaling"]["devices"]:
+        for prefix in ("flagstat", "seq_stats"):
+            assert f"{prefix}_overlap_efficiency" in row
+            assert 0.0 <= row[f"{prefix}_overlap_efficiency"] <= 1.0
+            assert row[f"{prefix}_dispatch_bytes"] > 0
+        assert "pipeline.feed_wall" in row["flagstat_wall_seconds_per_run"]
+    line = json.dumps(bench._compact_snapshot(full))
+    assert len(line) <= bench.FINAL_LINE_BUDGET
 
 
 def test_snapshot_mutation_not_duplicated_by_compact(bench):

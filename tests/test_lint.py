@@ -274,6 +274,83 @@ def test_lc_spec_table_self_check():
 
 
 # ---------------------------------------------------------------------------
+# feed-path allocation discipline (PF5xx)
+# ---------------------------------------------------------------------------
+
+_PF_BAD = '''
+import numpy as np
+
+def driver(stream, n_dev, cap, w):
+    group = []
+
+    def dispatch():
+        out = np.zeros((n_dev, cap, w), dtype=np.uint8)    # PF501: emit fn
+        return out
+
+    def emit_group():
+        return np.empty((n_dev, cap), dtype=np.int8)       # PF501: emit fn
+
+    for tile in stream:
+        pad = np.full((n_dev, cap, w), -1, np.int8)        # PF501: loop
+        group.append(pad)
+    return dispatch(), emit_group()
+'''
+
+_PF_CLEAN = '''
+import numpy as np
+
+def stack_span_group(source, n_dev, cap):
+    # top-level body, not a loop, not an emit helper: one-shot staging
+    data = np.zeros((n_dev, cap), dtype=np.uint8)
+    return data
+
+def dispatch(counts, n_dev):
+    cvec = np.zeros((n_dev,), dtype=np.int32)   # 1-D count vector: noise
+    return cvec
+
+def per_tile(stream, cap, w):
+    for t in stream:
+        tile = np.zeros((cap, w), np.uint8)     # no device leading dim
+        yield tile
+'''
+
+
+def test_pf_seeded_violations_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/bad_feed.py": _PF_BAD},
+        only=["feedpath"])
+    assert rules_of(findings) == {"PF501"}
+    assert len(findings) == 3
+    assert all(f.severity == "error" for f in findings)
+    assert "staging ring" in findings[0].message
+
+
+def test_pf_clean_idioms_and_staging_ring_pass():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/clean_feed.py": _PF_CLEAN},
+        only=["feedpath"])
+    assert findings == []
+    # the staging ring module itself is the allowed owner of group
+    # buffers — allocations there are exempt even inside loops
+    findings = lint_sources({"hadoop_bam_tpu/parallel/staging.py": '''
+import numpy as np
+
+def ring(n_dev, cap, slots):
+    out = []
+    for _ in range(slots):
+        out.append(np.full((n_dev, cap), 0, np.uint8))
+    return out
+'''}, only=["feedpath"])
+    assert findings == []
+
+
+def test_pf_outside_parallel_not_scoped():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/ops/elsewhere.py": _PF_BAD}, only=["feedpath"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip / suppression
 # ---------------------------------------------------------------------------
 
