@@ -650,11 +650,24 @@ def bench_vcf(path: str):
 
     from hadoop_bam_tpu.formats.vcf import VcfRecord
     from hadoop_bam_tpu.parallel.variant_pipeline import variant_stats_file
+    from hadoop_bam_tpu.utils.metrics import METRICS
 
     def run():
         return variant_stats_file(path)
 
     stats, dt = _median_time(run)
+
+    # per-stage wall spans (satellite of the r9 query round): one extra
+    # isolated run so the stage union-walls aren't summed over the
+    # median reps.  Progress-line detail only — the compact final line
+    # keeps just the numeric value.
+    METRICS.reset()
+    run()
+    snap = METRICS.snapshot()
+    vcf_stages = {k.split(".", 1)[1]: round(v, 4)
+                  for k, v in snap["wall_timers"].items()
+                  if k.startswith("vcf.")}
+    METRICS.reset()
 
     def base_run():
         n = 0
@@ -669,7 +682,11 @@ def bench_vcf(path: str):
     meas, base = stats["n_variants"] / dt, bn / bdt
     return {"metric": "vcf_variants_per_sec",
             "value": round(meas, 1), "unit": "variants/s",
-            "vs_baseline": round(meas / base, 3)}
+            "vs_baseline": round(meas / base, 3),
+            # wall-clock union spans per stage (Metrics.wall_timer):
+            # inflate = BGZF span read, tokenize = grid tokenizer,
+            # dosage_pack = GT columns, dispatch = device_put + step
+            "vcf_stage_seconds": vcf_stages}
 
 
 def bench_bcf(path: str):
@@ -695,6 +712,84 @@ def bench_bcf(path: str):
         out["note"] = ("no vs_baseline: vcf_variants_per_sec row missing "
                        "or fixture sizes differ")
     return out
+
+
+def _region_query_fixture(path: str):
+    """(bam_path, regions): the 100k scaling BAM with a .bai sidecar and
+    a zipf-skewed batch of >= 200 regions over it — hot windows repeat,
+    so the warm pass exercises chunk-cache reuse the way a serving
+    workload would."""
+    bam = _scaling_fixture(path)
+    if not os.path.exists(bam + ".bai"):
+        from hadoop_bam_tpu.split.bai import write_bai
+        write_bai(bam)
+    rng = random.Random(4242)
+    n_windows, width = 64, 200_000
+    # fixture positions advance ~20/record from 1: ~100k records span
+    # ~2 Mbp of chr20; windows tile that head
+    starts = [1 + i * 30_000 for i in range(n_windows)]
+    weights = [1.0 / (i + 1) for i in range(n_windows)]  # zipf s=1
+    regions = []
+    for _ in range(250):
+        w = rng.choices(range(n_windows), weights=weights)[0]
+        lo = starts[w]
+        regions.append(f"chr20:{lo}-{lo + width - 1}")
+    return bam, regions
+
+
+def bench_region_query(path: str):
+    """The query subsystem's serving row: zipf-skewed region queries via
+    QueryEngine (BAI chunk resolution -> cached chunk decode -> device
+    interval predicate).  Cold = fresh engine/cache; warm = same engine
+    again; vs_baseline = warm/cold speedup (the cache's whole point)."""
+    import numpy as np
+
+    from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+
+    bam, regions = _region_query_fixture(path)
+
+    def run_pass(engine):
+        matched = 0
+        for region in regions:
+            for out in engine.tensor_batches(
+                    [QueryRequest(bam, region)]):
+                matched += int(np.asarray(out["keep"]).sum())
+        return matched
+
+    engine = QueryEngine()
+    run_pass(engine)              # warmup: jit compile only (fresh
+    #                               engines below re-measure cold decode)
+    cold_engine = QueryEngine()
+    t0 = time.perf_counter()
+    n_matched = run_pass(cold_engine)
+    cold_dt = time.perf_counter() - t0
+
+    s0 = cold_engine.stats()      # instance counters: warm-pass delta
+    t0 = time.perf_counter()
+    warm_matched = run_pass(cold_engine)       # same engine: warm cache
+    warm_dt = time.perf_counter() - t0
+    s1 = cold_engine.stats()
+    d_hits = s1["hits"] - s0["hits"]
+    d_total = d_hits + s1["misses"] - s0["misses"]
+    stats = {"hit_rate": d_hits / d_total if d_total else 0.0}
+
+    if warm_matched != n_matched:
+        raise AssertionError(
+            f"warm pass matched {warm_matched} records vs cold "
+            f"{n_matched} — cache served stale chunks")
+    cold_qps = len(regions) / cold_dt
+    warm_qps = len(regions) / warm_dt
+    return {"metric": "region_query_queries_per_sec",
+            "value": round(warm_qps, 1), "unit": "queries/s",
+            # baseline = the cold pass: > 1 means cache reuse is real;
+            # acceptance bar is >= 2x
+            "vs_baseline": round(warm_qps / cold_qps, 3),
+            "cold_queries_per_sec": round(cold_qps, 1),
+            "cache_hit_rate": round(stats["hit_rate"], 4),
+            "regions": len(regions),
+            "records_matched": int(n_matched),
+            "note": "zipf-skewed 250-region batch over the 100k BAM; "
+                    "warm pass re-serves decoded chunks from the LRU"}
 
 
 # ---------------------------------------------------------------------------
@@ -1415,6 +1510,8 @@ def main() -> None:
                    "vcf_variants_per_sec", est_s=25)
     _run_component(lambda: bench_bcf(build_bcf_fixture()),
                    "bcf_variants_per_sec", est_s=25)
+    _run_component(lambda: bench_region_query(path),
+                   "region_query_queries_per_sec", est_s=45)
     _run_component(lambda: bench_fastq(build_fastq_fixture()),
                    "fastq_reads_per_sec", est_s=25)
     _run_component(lambda: bench_bam_write(path),

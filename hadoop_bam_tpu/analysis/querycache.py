@@ -1,0 +1,70 @@
+"""QE5xx — query-cache key discipline: keys must carry file identity.
+
+The chunk cache (``query/cache.py``) serves DECODED bytes; a key that
+identifies a chunk only by its path and offsets keeps serving the old
+decode after the file on disk is replaced — the classic stale-cache
+corruption, invisible until a consumer diffs results against a fresh
+read.  The engine therefore keys every entry on ``file_identity(path)``
+(abspath + size + mtime_ns).  This analyzer keeps that contract:
+
+- QE501: inside ``query/``, a cache ``get``/``put``/``pop`` call whose
+  key expression mentions a path-like name but carries no identity
+  component (no ``file_identity``/``identity`` call, no ``ident``/
+  ``mtime``/``size`` name) is a raw-path key.  Build the key from
+  ``file_identity(path)`` instead.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/query",)
+
+_CACHE_METHODS = {"get", "put", "pop", "setdefault"}
+_PATHISH = ("path", "filename", "fname", "file_name")
+_IDENTITY = ("ident", "identity", "mtime", "size", "fingerprint")
+
+
+def _identifiers(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _is_cache_call(call: ast.Call) -> bool:
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _CACHE_METHODS:
+        return False
+    recv = func.value
+    name = recv.id if isinstance(recv, ast.Name) else (
+        recv.attr if isinstance(recv, ast.Attribute) else "")
+    return "cache" in name.lower()
+
+
+@register("querycache")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call) or not _is_cache_call(node):
+                continue
+            if not node.args:
+                continue
+            key = node.args[0]
+            names = [n.lower() for n in _identifiers(key)]
+            has_path = any(p in n for n in names for p in _PATHISH)
+            has_ident = any(i in n for n in names for i in _IDENTITY)
+            if has_path and not has_ident:
+                findings.append(Finding(
+                    rule="QE501", severity="error", path=m.path,
+                    line=node.lineno,
+                    message="cache key built from a raw path without file "
+                            "identity — a replaced file would keep serving "
+                            "stale decoded chunks; key on "
+                            "file_identity(path) (abspath + size + "
+                            "mtime_ns) from query/cache.py instead"))
+    return findings

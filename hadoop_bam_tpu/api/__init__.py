@@ -16,3 +16,4 @@ from hadoop_bam_tpu.api.read_datasets import (  # noqa: F401
     FastaDataset, FastqDataset, QseqDataset, open_fasta, open_fastq,
     open_qseq,
 )
+from hadoop_bam_tpu.api.query import query_regions  # noqa: F401

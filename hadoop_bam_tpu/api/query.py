@@ -1,0 +1,49 @@
+"""``api.query_regions`` — the tensor-batch face of the query engine.
+
+Where ``BamDataset.tensor_batches`` streams a whole file, this streams
+the union of a BATCH of region queries: the engine resolves every
+region through the genomic indexes, decodes each needed chunk once
+(LRU-cached across calls), and yields device groups whose ``keep`` mask
+was computed by the interval-overlap predicate on the mesh.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Sequence, Tuple, Union
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.query.engine import QueryEngine, QueryRequest
+
+RequestLike = Union[QueryRequest, Tuple[str, str]]
+
+
+def query_regions(requests: "Sequence[RequestLike] | RequestLike",
+                  regions: Optional[Sequence[str]] = None,
+                  *, config: HBamConfig = DEFAULT_CONFIG,
+                  engine: Optional[QueryEngine] = None,
+                  mesh=None,
+                  deadline_s: Optional[float] = None) -> Iterator[Dict]:
+    """Serve a batch of region queries as sharded device tensor batches.
+
+    Two calling shapes::
+
+        query_regions([("a.bam", "chr1:1-5000"), ("b.bam", "chr2")])
+        query_regions("a.bam", ["chr1:1-5000", "chr2:100-200"])
+
+    Yields ``{rid, pos, end, req, keep, n_records}`` groups —
+    ``[n_dev, cap]`` int32 columns sharded over the mesh's data axis,
+    ``keep`` the mesh-computed boolean overlap mask, ``req`` mapping each
+    row back to its request index.  Pass a long-lived ``engine`` to reuse
+    its chunk cache across calls (the warm serving path); otherwise a
+    fresh engine (and cold cache) is built per call.
+    """
+    if isinstance(requests, (str, bytes)):
+        if regions is None:
+            raise TypeError(
+                "query_regions(path, regions): regions list required")
+        batch = [QueryRequest(str(requests), r) for r in regions]
+    else:
+        batch = [r if isinstance(r, QueryRequest) else QueryRequest(*r)
+                 for r in requests]
+    if engine is None:
+        engine = QueryEngine(config=config, mesh=mesh)
+    yield from engine.tensor_batches(batch, deadline_s=deadline_s)

@@ -82,6 +82,14 @@ _HADOOP_KEY_MAP = {
     "hbam.feed-ring-slots": "feed_ring_slots",
     "hbam.feed-dispatch-depth": "feed_dispatch_depth",
     "hbam.decode-pool-workers": "decode_pool_workers",
+    # region-query serving knobs (query/; no reference analog — Hadoop-BAM
+    # only ever trimmed scan plans with intervals, it never served them)
+    "hbam.query-cache-bytes": "query_cache_bytes",
+    "hbam.query-chunk-bytes": "query_chunk_bytes",
+    "hbam.query-tile-records": "query_tile_records",
+    "hbam.query-max-in-flight": "query_max_in_flight",
+    "hbam.query-queue-depth": "query_queue_depth",
+    "hbam.query-deadline-s": "query_deadline_s",
 }
 
 
@@ -160,6 +168,20 @@ class HBamConfig:
     #                                  First driver call in the process
     #                                  sizes the pool (utils/pools.py)
 
+    # --- region-query serving (query/) ---
+    query_cache_bytes: int = 256 << 20  # decoded-chunk LRU byte budget
+    query_chunk_bytes: int = 1 << 20    # max compressed bytes coalesced
+    #                                     into one cacheable chunk
+    query_tile_records: int = 8192      # rows per device per predicate
+    #                                     dispatch (FeedPipeline cap)
+    query_max_in_flight: int = 8        # admission: concurrent queries
+    query_queue_depth: int = 32         # admission: bounded wait queue;
+    #                                     overflow sheds load with
+    #                                     TransientIOError
+    query_deadline_s: Optional[float] = None  # per-request wall budget;
+    #                                     blown deadlines raise
+    #                                     TransientIOError (retryable)
+
     # --- TPU backend ---
     backend: str = "tpu"                  # "tpu" | "cpu" (host NumPy decode)
     blocks_per_batch: int = 512           # BGZF blocks per device batch
@@ -197,11 +219,15 @@ def _coerce(kwargs: dict) -> dict:
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
     for k in ("max_bad_span_fraction", "retry_backoff_base_s",
-              "retry_backoff_max_s", "io_read_deadline_s"):
+              "retry_backoff_max_s", "io_read_deadline_s",
+              "query_deadline_s"):
         if k in out and isinstance(out[k], str):
             out[k] = float(out[k])
     for k in ("span_retries", "io_read_retries", "feed_ring_slots",
-              "feed_dispatch_depth", "decode_pool_workers"):
+              "feed_dispatch_depth", "decode_pool_workers",
+              "query_cache_bytes", "query_chunk_bytes",
+              "query_tile_records", "query_max_in_flight",
+              "query_queue_depth"):
         if k in out and isinstance(out[k], str):
             out[k] = int(out[k])
     return out

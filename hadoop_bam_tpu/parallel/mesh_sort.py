@@ -60,8 +60,28 @@ import numpy as np
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.utils.errors import PlanError
 
 _I32_SENTINEL = np.int32(2**31 - 1)
+GLOBAL_INDEX_CEILING = 2**31 - 2     # int32 global record indices
+
+
+def check_global_index_ceiling(n_records: int, where: str) -> None:
+    """Raise ``PlanError`` when a record count cannot fit the mesh sort's
+    int32 global-index layout.  PlanError (never a bare ValueError): a
+    too-large input is a configuration fault — the retry policy must
+    neither re-attempt it nor quarantine it, and the message has to tell
+    the operator what to do instead of letting indices silently wrap."""
+    if n_records > GLOBAL_INDEX_CEILING:
+        raise PlanError(
+            f"{where}: {n_records} records exceed the mesh sort's int32 "
+            f"global-index ceiling ({GLOBAL_INDEX_CEILING}). The spill "
+            f"exchange (`--run-records N` / round_records=N) bounds "
+            f"device memory but shares the same global index — sort the "
+            f"input as <2^31-record chunks (each through the spill-mode "
+            f"mesh sort), then merge the sorted chunks with "
+            f"utils/mergers.py or utils.sort.sort_bam, or run "
+            f"utils.sort.sort_bam directly.")
 
 
 def _round_up(x: int, m: int) -> int:
@@ -515,6 +535,10 @@ def _sort_bam_mesh_bytes_spill_impl(input_path: str, output_path: str, *,
         n_samples = max(1, len(index.voffsets) - 1)
         if index.total_records > 0:
             total_est = index.total_records
+            # UP-FRONT ceiling check (VERDICT r5 #8): a stored exact
+            # record count lets the overflow surface before any round
+            # decodes, not 2^31 records into the run
+            check_global_index_ceiling(total_est, "mesh spill sort plan")
         else:
             total_est = n_samples * max(1, index.granularity)
         want = -(-total_est // max(1, round_records))
@@ -595,10 +619,8 @@ def _sort_bam_mesh_bytes_spill_impl(input_path: str, output_path: str, *,
             blo_g = replicated(blo, jnp.uint32)
 
         round_total = int(counts_vec.sum())
-        if prefix_total + round_total > 2**31 - 2:
-            raise ValueError(
-                f"{prefix_total + round_total} records exceed the int32 "
-                f"global-index layout; use utils.sort.sort_bam")
+        check_global_index_ceiling(prefix_total + round_total,
+                                   "mesh spill sort (mid-run backstop)")
         base_vec = prefix_total + np.concatenate(
             [[0], np.cumsum(counts_vec[:-1])])
         prefix_total += round_total
@@ -799,9 +821,7 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
     counts_vec, max_len, shis, slos = _agree_round_geometry(
         counts_vec, max_len, his, los, err=decode_err)
     total = int(counts_vec.sum())
-    if total > 2**31 - 2:
-        raise ValueError(f"{total} records exceed the int32 global-index "
-                         f"layout; use utils.sort.sort_bam")
+    check_global_index_ceiling(total, "mesh sort (post-decode backstop)")
     bhi, blo = _sample_bounds(shis, slos, n_dev)
 
     records_cap = _round_up(int(counts_vec.max()) if total else 1, 8)
@@ -989,6 +1009,13 @@ def sort_bam_mesh(input_path: str, output_path: str, *,
     if round_records is not None and exchange != "bytes":
         raise ValueError("round_records (the spill exchange) requires "
                          "exchange='bytes'")
+    # UP-FRONT int32 global-index ceiling (VERDICT r5 #8): when a
+    # splitting-index sidecar records the exact total, refuse oversized
+    # inputs BEFORE planning/decoding instead of wrapping mid-run
+    from hadoop_bam_tpu.split.splitting_index import SplittingIndex
+    _sidx = SplittingIndex.load_for(input_path)
+    if _sidx is not None and _sidx.total_records > 0:
+        check_global_index_ceiling(_sidx.total_records, "mesh sort plan")
     if mesh is None:
         mesh = make_mesh()
     if exchange == "bytes":

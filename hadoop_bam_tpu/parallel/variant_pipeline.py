@@ -197,13 +197,15 @@ def bcf_span_stat_columns(path: str, span, header: VCFHeader,
     )
     from hadoop_bam_tpu.split.vcf_planners import read_bcf_span_frames
 
-    raw, starts = read_bcf_span_frames(path, span, is_bgzf)
-    cols = decode_bcf_columns(raw, header, geometry.samples_pad,
-                              starts=starts)
-    if cols is not None:
-        return stat_columns(cols)
-    from hadoop_bam_tpu.formats.bcf import scan_variant_columns
-    return scan_variant_columns(raw, header, geometry.samples_pad)
+    with METRICS.wall_timer("vcf.inflate_wall"):
+        raw, starts = read_bcf_span_frames(path, span, is_bgzf)
+    with METRICS.wall_timer("vcf.tokenize_wall"):
+        cols = decode_bcf_columns(raw, header, geometry.samples_pad,
+                                  starts=starts)
+        if cols is not None:
+            return stat_columns(cols)
+        from hadoop_bam_tpu.formats.bcf import scan_variant_columns
+        return scan_variant_columns(raw, header, geometry.samples_pad)
 
 
 _ALT_W = 16            # widest ALT the vectorized SNP test gathers
@@ -335,38 +337,43 @@ def _pack_variant_text_vectorized(text: bytes, header: VCFHeader,
                      | is_snp.astype(np.uint8) * FLAG_SNP)
 
     # genotypes: FORMAT (field 8) must start "GT"; per sample, dosage
-    # from the first 1 or 3 characters of the GT subfield
+    # from the first 1 or 3 characters of the GT subfield.  Wall-spanned
+    # separately (vcf.dosage_pack_wall): the GT columns are the dominant
+    # tokenizer cost on wide cohorts and the bench's vcf_stage_seconds
+    # row wants them attributable
     if S:
-        gb8, glen8 = gather(8, 2)
-        has_gt = (glen8 >= 2) & (gb8[:, 0] == ord("G")) \
-            & (gb8[:, 1] == ord("T")) & (ntab >= 9)
-        for s in range(S):
-            f = 9 + s
-            present = has_gt & (ntab >= f)   # field exists on the line
-            sb, sln = gather(f, _GT_W)
-            colon = np.where((sb == ord(":")) & (np.arange(_GT_W) <
-                                                 sln[:, None]),
-                             np.arange(_GT_W), _GT_W).min(axis=1)
-            gtlen = np.minimum(sln, colon)
-            c0, c1, c2 = sb[:, 0], sb[:, 1], sb[:, 2]
-            d0 = (c0 >= 0x30) & (c0 <= 0x39)
-            d2 = (c2 >= 0x30) & (c2 <= 0x39)
-            sep = (c1 == ord("/")) | (c1 == ord("|"))
-            one = gtlen == 1
-            tri = (gtlen == 3) & sep
-            dot0, dot2 = c0 == ord("."), c2 == ord(".")
-            val1 = np.where(d0, (c0 > 0x30).astype(np.int8), np.int8(-1))
-            val3 = np.where(d0 & d2,
-                            ((c0 > 0x30).astype(np.int8)
-                             + (c2 > 0x30).astype(np.int8)),
-                            np.int8(-1))
-            # '.' anywhere -> missing (scalar: first non-digit allele
-            # aborts to -1); handled by d0/d2 being False for '.'
-            val = np.where(one, val1, np.where(tri, val3, np.int8(0)))
-            regular = one | tri
-            odd |= present & ~regular & (gtlen > 0)
-            row_ok = present & regular
-            cols["dosage"][row_ok, s] = val[row_ok]
+        with METRICS.wall_timer("vcf.dosage_pack_wall"):
+            gb8, glen8 = gather(8, 2)
+            has_gt = (glen8 >= 2) & (gb8[:, 0] == ord("G")) \
+                & (gb8[:, 1] == ord("T")) & (ntab >= 9)
+            for s in range(S):
+                f = 9 + s
+                present = has_gt & (ntab >= f)  # field exists on the line
+                sb, sln = gather(f, _GT_W)
+                colon = np.where((sb == ord(":")) & (np.arange(_GT_W) <
+                                                     sln[:, None]),
+                                 np.arange(_GT_W), _GT_W).min(axis=1)
+                gtlen = np.minimum(sln, colon)
+                c0, c1, c2 = sb[:, 0], sb[:, 1], sb[:, 2]
+                d0 = (c0 >= 0x30) & (c0 <= 0x39)
+                d2 = (c2 >= 0x30) & (c2 <= 0x39)
+                sep = (c1 == ord("/")) | (c1 == ord("|"))
+                one = gtlen == 1
+                tri = (gtlen == 3) & sep
+                dot0, dot2 = c0 == ord("."), c2 == ord(".")
+                val1 = np.where(d0, (c0 > 0x30).astype(np.int8),
+                                np.int8(-1))
+                val3 = np.where(d0 & d2,
+                                ((c0 > 0x30).astype(np.int8)
+                                 + (c2 > 0x30).astype(np.int8)),
+                                np.int8(-1))
+                # '.' anywhere -> missing (scalar: first non-digit allele
+                # aborts to -1); handled by d0/d2 being False for '.'
+                val = np.where(one, val1, np.where(tri, val3, np.int8(0)))
+                regular = one | tri
+                odd |= present & ~regular & (gtlen > 0)
+                row_ok = present & regular
+                cols["dosage"][row_ok, s] = val[row_ok]
     odd_rows = np.flatnonzero(odd)
     return cols, [(int(r), int(starts[r]), int(ends[r]))
                   for r in odd_rows]
@@ -556,10 +563,15 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
 
     def decode(span):
         def inner(s):
-            text = ds.read_span_text(s)
+            # per-stage wall spans (Metrics.wall_timer: overlapping pool
+            # threads union, so values are wall seconds, not thread-sums)
+            # feed the bench's vcf_stage_seconds row
+            with METRICS.wall_timer("vcf.inflate_wall"):
+                text = ds.read_span_text(s)
             if text is not None:  # fast tokenizer, no record objects
-                return pack_variant_tiles_from_text(text, header,
-                                                    geometry)
+                with METRICS.wall_timer("vcf.tokenize_wall"):
+                    return pack_variant_tiles_from_text(text, header,
+                                                        geometry)
             return bcf_span_stat_columns(ds.path, s, header, geometry,
                                          ds._is_bgzf_bcf)
         with METRICS.wall_timer("pipeline.host_decode_wall"):
@@ -578,11 +590,12 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
                                     balance=True)
     if fp is not None:
         def dispatch(arrays, counts):
-            named = dict(zip(keys, arrays))
-            args = [jax.device_put(named[k], sharding)
-                    for k in ("chrom", "pos", "flags", "dosage")]
-            c = jax.device_put(counts, sharding)
-            totals.add(*step(*args, c))  # async; drained once at the end
+            with METRICS.wall_timer("vcf.dispatch_wall"):
+                named = dict(zip(keys, arrays))
+                args = [jax.device_put(named[k], sharding)
+                        for k in ("chrom", "pos", "flags", "dosage")]
+                c = jax.device_put(counts, sharding)
+                totals.add(*step(*args, c))  # async; drained at the end
             return (*args, c)  # in-flight handles: the ring waits on them
 
         fp.feed(tuples, dispatch)

@@ -1,0 +1,32 @@
+"""Batched random-access region serving: the query subsystem.
+
+The scan drivers (parallel/pipeline.py) answer "process the whole
+file"; this package answers the serving shape the north star actually
+describes — many concurrent small *region* queries against the same
+files, where warm-path throughput comes from reusing decoded chunks
+rather than from scan parallelism:
+
+- ``engine.py``  QueryEngine: a batch of (path, region) requests is
+  resolved through the genomic indexes (BAI/CSI for BAM, tabix for
+  BGZF VCF and BCF, the container table for CRAM) to a minimal list of
+  virtual-offset chunks, coalesced and deduplicated ACROSS requests,
+  decoded once each, then filtered on the device mesh by an
+  interval-overlap predicate fed through parallel/staging.FeedPipeline.
+- ``cache.py``   ChunkCache: byte-budgeted LRU over decoded chunks,
+  keyed by file identity (path + mtime + size) and virtual-offset
+  range, with hit/miss/eviction counters in utils/metrics.py.
+- ``scheduler.py``  QueryScheduler: admission control (bounded
+  in-flight queries + a bounded wait queue) and per-request deadlines,
+  raising through the PR-1 error taxonomy (``TransientIOError`` for
+  shed load / blown deadlines, ``PlanError`` for misconfiguration) so
+  the existing retry / circuit-breaker layers apply unchanged.
+
+CLI: ``hbam query``.  API: ``api.query_regions``.
+"""
+from hadoop_bam_tpu.query.cache import ChunkCache, file_identity  # noqa: F401
+from hadoop_bam_tpu.query.scheduler import (  # noqa: F401
+    Deadline, QueryScheduler,
+)
+from hadoop_bam_tpu.query.engine import (  # noqa: F401
+    QueryEngine, QueryRequest, QueryResult,
+)

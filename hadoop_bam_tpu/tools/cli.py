@@ -420,6 +420,40 @@ def _alen(r) -> int:
 
 
 # ---------------------------------------------------------------------------
+# query
+# ---------------------------------------------------------------------------
+
+def cmd_query(args) -> int:
+    """Batched random-access region serving (query/engine.py): resolve
+    every region through the file's genomic index (.bai/.csi for BAM,
+    .tbi for BGZF VCF and BCF, container coordinates for CRAM), decode
+    the union of needed chunks once, and filter on the mesh."""
+    import dataclasses
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+
+    cfg = DEFAULT_CONFIG
+    if args.deadline is not None:
+        cfg = dataclasses.replace(cfg, query_deadline_s=args.deadline)
+    engine = QueryEngine(config=cfg)
+    reqs = [QueryRequest(args.path, region) for region in args.regions]
+    results = engine.query_records(reqs)
+    for res in results:
+        if args.count:
+            print(f"{res.request.region}\t{len(res.records)}")
+        else:
+            for rec in res.records:
+                print(rec.to_line())
+    if args.metrics:
+        stats = engine.stats()
+        print("-- query cache --", file=sys.stderr)
+        for k in sorted(stats):
+            print(f"{k}\t{stats[k]}", file=sys.stderr)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # lint
 # ---------------------------------------------------------------------------
 
@@ -558,6 +592,23 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("output")
     f.set_defaults(fn=cmd_fixmate, uses_device=False)
 
+    q = sub.add_parser("query",
+                       help="batched random-access region queries via the "
+                            "genomic index (.bai/.csi, .tbi, CRAM "
+                            "containers); device interval predicate + "
+                            "chunk cache")
+    q.add_argument("path")
+    q.add_argument("regions", nargs="+",
+                   help='samtools-style regions, e.g. "chr20:1,000-2,000"')
+    q.add_argument("-c", "--count", action="store_true",
+                   help="print per-region match counts instead of records")
+    q.add_argument("--deadline", type=float, default=None,
+                   help="per-batch deadline in seconds (blown deadlines "
+                        "raise the retryable TransientIOError)")
+    q.add_argument("--metrics", action="store_true",
+                   help="dump chunk-cache hit/miss stats to stderr")
+    q.set_defaults(fn=cmd_query, uses_device=True)
+
     ln = sub.add_parser("lint",
                         help="static analysis: trace safety (TS1xx), "
                              "collective lockstep (CL2xx), error taxonomy "
@@ -567,7 +618,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="package directory to analyze")
     ln.add_argument("--only", action="append", metavar="ANALYZER",
                     help="run one analyzer (trace_safety, lockstep, "
-                         "taxonomy, layout); repeatable")
+                         "taxonomy, layout, feedpath, querycache); "
+                         "repeatable")
     ln.add_argument("--baseline", default=None,
                     help="baseline file (default analysis/baseline.json)")
     ln.add_argument("--no-baseline", action="store_true")
@@ -623,7 +675,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         _resilient_backend()
     try:
         return args.fn(args)
-    except (ValueError, FileNotFoundError) as e:
+    except (ValueError, OSError) as e:
+        # covers the classified taxonomy too: PlanError is a ValueError,
+        # TransientIOError (shed load / blown deadline) an OSError
         print(f"error: {e}", file=sys.stderr)
         return 1
 

@@ -28,6 +28,7 @@ def _fill_state(bench, n_notes=6):
         ("cram_tensor_records_per_sec", 432087.1, "records/s", 6.7),
         ("vcf_variants_per_sec", 507001.2, "variants/s", 1.5),
         ("bcf_variants_per_sec", 612345.7, "variants/s", 1.21),
+        ("region_query_queries_per_sec", 41.7, "queries/s", 2.4),
         ("fastq_reads_per_sec", 188001.0, "reads/s", 2.37),
         ("bam_write_records_per_sec", 301222.5, "records/s", 2.1),
         ("deflate_tokenize_gbps", 0.41, "GB/s", 0.8),
@@ -43,6 +44,15 @@ def _fill_state(bench, n_notes=6):
                "note": "x" * 120}          # progress lines carry detail
         if vs is not None:
             row["vs_baseline"] = vs
+        if m == "vcf_variants_per_sec":
+            # per-stage wall spans ride the FULL row only; the compact
+            # line keeps just the numeric value
+            row["vcf_stage_seconds"] = {
+                "inflate_wall": 0.21, "tokenize_wall": 0.33,
+                "dosage_pack_wall": 0.12, "dispatch_wall": 0.18}
+        if m == "region_query_queries_per_sec":
+            row.update(cold_queries_per_sec=17.1, cache_hit_rate=0.93,
+                       regions=250, records_matched=2_551_000)
         comps.append(row)
     comps.append({"metric": "broken_row", "error": "RuntimeError: boom"})
     comps.append({"metric": "late_row", "skipped": "deadline"})
@@ -105,6 +115,19 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     assert any("note" in c for c in full["components"])
     assert "flagstat_stage_seconds_per_run" in \
         full["scaling"]["devices"][0]
+    by_metric = {c.get("metric"): c for c in full["components"]}
+    # r9: VCF per-stage walls + region-query cache detail stay on the
+    # progress lines (the compact line keeps only the numeric values)
+    assert set(by_metric["vcf_variants_per_sec"]["vcf_stage_seconds"]) \
+        == {"inflate_wall", "tokenize_wall", "dosage_pack_wall",
+            "dispatch_wall"}
+    rq = by_metric["region_query_queries_per_sec"]
+    assert 0.0 <= rq["cache_hit_rate"] <= 1.0
+    assert rq["regions"] >= 200
+    line = json.dumps(bench._compact_snapshot(full))
+    assert len(line) <= bench.FINAL_LINE_BUDGET
+    assert json.loads(line)["components"][
+        "region_query_queries_per_sec"] == 41.7
 
 
 def test_scaling_rows_pin_feed_overlap_fields(bench):

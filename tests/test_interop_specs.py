@@ -494,3 +494,386 @@ def test_cram31_divergence_notes():
 
     import hadoop_bam_tpu.formats.cram_encode as ce
     assert "HBAM_CRAM31_NAMES" in pathlib.Path(ce.__file__).read_text()
+
+
+# ---------------------------------------------------------------------------
+# CRAM 3.1 WRITER frames through independent clean-room decoders
+# (VERDICT r5 missing #4): bytes produced by this repo's 3.1 encoders
+# (cram_encode's bulk-series codec, cram_name_tok3, cram_fqzcomp,
+# cram_arith) decoded by transcriptions that share NO decode code with
+# the implementation — one test per codec.  A failure here is a
+# DIVERGENCE-LEDGER event: record it in test_cram31_divergence_notes
+# (and fix the constant) rather than papering over it, because these
+# oracles re-derive the published algorithms from the spec text alone.
+# ---------------------------------------------------------------------------
+
+def _uint7_get(buf: bytes, pos: int):
+    """[SPEC-derived] uint7 varint: big-endian 7-bit groups, high bit =
+    continuation (independent of cram_codecs_nx16.var_get_u32)."""
+    v = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        v = (v << 7) | (b & 0x7F)
+        if not b & 0x80:
+            return v, pos
+
+
+def _oracle_nx16_payload(payload: bytes) -> bytes:
+    """Decode one FRAMED rANS Nx16 stream (flag byte + uint7 size +
+    payload) via the independent order-0 state-machine decoder above.
+    Only the shapes this repo's encoder emits for small/plain inputs are
+    accepted: CAT (0x20) and order-0; anything else means the fixture
+    drifted and the test should be rewritten, not silently skipped."""
+    flags = payload[0]
+    pos = 1
+    assert not flags & 0x10, "NOSZ frame needs an external size"
+    size, pos = _uint7_get(payload, pos)
+    if flags & 0x20:                         # CAT: stored bytes
+        assert len(payload) - pos == size
+        return payload[pos:pos + size]
+    assert flags & ~0x20 == 0, f"unexpected Nx16 flags 0x{flags:02x}"
+    return _rans_nx16_reference_decode_order0(payload[pos:], size)
+
+
+def test_cram31_rans_nx16_written_frames_decode_via_oracle():
+    """cram_encode.py's 3.1 bulk-series codec (rans_nx16_encode, plain
+    order-0 frame) must decode under the independent state-machine
+    transcription — including the frame header (flag byte + uint7 size)
+    parsed by spec-derived rules alone."""
+    import random
+
+    from hadoop_bam_tpu.formats.cram_codecs_nx16 import rans_nx16_encode
+
+    rng = random.Random(41)
+    # BAM-flavoured byte series: qualities, flags, small ints
+    for data in (bytes(rng.choice(b"!#$%&'()*+,-.") for _ in range(4096)),
+                 bytes(rng.randrange(4) for _ in range(1000)),
+                 b"Q" * 500):
+        payload = rans_nx16_encode(data, 0)
+        assert _oracle_nx16_payload(payload) == data
+
+
+class _OracleRangeDecoder:
+    """Clean-room incremental transcription of the CRAM 3.1 adaptive
+    coders' LZMA-style range decoder (skip the initial cache byte,
+    32-bit big-endian code, 24-bit renormalization) — the stateful twin
+    of _range_coder_reference_decode above, shared by the fqzcomp and
+    arith oracles."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos + 1                   # skip the cache byte
+        self.code = int.from_bytes(buf[self.pos:self.pos + 4], "big")
+        self.pos += 4
+        self.range = 0xFFFFFFFF
+
+    def get_freq(self, tot: int) -> int:
+        self.range //= tot
+        return self.code // self.range
+
+    def advance(self, cum: int, freq: int) -> None:
+        self.code -= cum * self.range
+        self.range *= freq
+        while self.range < (1 << 24):
+            self.range <<= 8
+            b = self.buf[self.pos] if self.pos < len(self.buf) else 0
+            self.code = ((self.code << 8) | b) & 0xFFFFFFFF
+            self.pos += 1
+
+
+class _OracleAdaptiveModel:
+    """Clean-room transcription of the published fqzcomp adaptive
+    frequency model: all symbols start at frequency 1, a used symbol
+    bumps by 8, totals rescale at 2^16-8 (each freq loses its own half,
+    f -= f>>1), and a used symbol swaps one slot toward the front when
+    it overtakes its neighbour.  The constants are the [SPEC-recalled]
+    ones the divergence ledger pins — a mismatch desyncs here loudly."""
+
+    STEP = 8
+    MAX_TOTAL = (1 << 16) - 8
+
+    def __init__(self, nsym: int):
+        self.total = nsym
+        self.freqs = [1] * nsym
+        self.syms = list(range(nsym))
+
+    def decode(self, rc: _OracleRangeDecoder) -> int:
+        f = rc.get_freq(self.total)
+        acc = i = 0
+        while acc + self.freqs[i] <= f:
+            acc += self.freqs[i]
+            i += 1
+        rc.advance(acc, self.freqs[i])
+        sym = self.syms[i]
+        self.freqs[i] += self.STEP
+        self.total += self.STEP
+        if i > 0 and self.freqs[i] > self.freqs[i - 1]:
+            fr, sy = self.freqs, self.syms
+            fr[i - 1], fr[i] = fr[i], fr[i - 1]
+            sy[i - 1], sy[i] = sy[i], sy[i - 1]
+        if self.total > self.MAX_TOTAL:
+            t = 0
+            for j in range(len(self.freqs)):
+                self.freqs[j] -= self.freqs[j] >> 1
+                t += self.freqs[j]
+            self.total = t
+        return sym
+
+
+def test_cram31_arith_stream_decodes_via_oracle():
+    """cram_arith.py order-0 frames (flag byte + uint7 size + max_sym +
+    range-coded symbols) must decode under the independent adaptive
+    model + range decoder."""
+    import random
+
+    from hadoop_bam_tpu.formats.cram_arith import arith_encode
+
+    rng = random.Random(43)
+    data = bytes(rng.choice(b"ACGTN") for _ in range(3000))
+    payload = arith_encode(data, 0)
+    assert payload[0] == 0                   # plain order-0 frame
+    size, pos = _uint7_get(payload, 1)
+    assert size == len(data)
+    max_sym = payload[pos]
+    pos += 1
+    model = _OracleAdaptiveModel(max_sym)
+    rc = _OracleRangeDecoder(payload, pos)
+    out = bytes(model.decode(rc) for _ in range(size))
+    assert out == data
+
+
+def _oracle_read_runlen_array(buf: bytes, p: int, n: int):
+    """[SPEC-recalled transcription] fqzcomp table: run length per value
+    0,1,2,... with 255-extension."""
+    a = [0] * n
+    i = v = 0
+    while i < n:
+        run = 0
+        while True:
+            b = buf[p]
+            p += 1
+            run += b
+            if b != 255:
+                break
+        for _ in range(run):
+            a[i] = v
+            i += 1
+        v += 1
+    return a, p
+
+
+def test_cram31_fqzcomp_stream_decodes_via_oracle():
+    """cram_fqzcomp.py quality streams must decode under an independent
+    transcription of the published fqzcomp decoder: parameter block,
+    quantizer tables, context mixing, and the adaptive model/range
+    coder above — no code shared with _fqz_decode."""
+    import random
+    import struct as _struct
+
+    from hadoop_bam_tpu.formats.cram_fqzcomp import fqz_encode
+
+    rng = random.Random(47)
+    n_rec, rec_len = 40, 100
+    quals = bytes(rng.choice((2, 12, 25, 37)) for _ in range(n_rec *
+                                                             rec_len))
+    lens = [rec_len] * n_rec
+    buf = fqz_encode(quals, lens)
+
+    # --- header + single parameter set (gflags 0: our encoder) ---
+    assert buf[0] == 5 and buf[1] == 0       # vers, gflags
+    p = 2
+    context0 = _struct.unpack_from("<H", buf, p)[0]
+    pflags, max_sym = buf[p + 2], buf[p + 3]
+    qbits, qshift = buf[p + 4] >> 4, buf[p + 4] & 15
+    qloc, sloc = buf[p + 5] >> 4, buf[p + 5] & 15
+    ploc, dloc = buf[p + 6] >> 4, buf[p + 6] & 15
+    p += 7
+    HAVE_QMAP, HAVE_PTAB, HAVE_DTAB, HAVE_QTAB, DO_LEN = 16, 32, 64, 128, 4
+    qmap = None
+    if pflags & HAVE_QMAP:
+        qmap = list(buf[p:p + max_sym])
+        p += max_sym
+    qtab = list(range(256))
+    if pflags & HAVE_QTAB:
+        qtab, p = _oracle_read_runlen_array(buf, p, 256)
+    ptab = [0] * 1024
+    if pflags & HAVE_PTAB:
+        ptab, p = _oracle_read_runlen_array(buf, p, 1024)
+    dtab = [0] * 256
+    if pflags & HAVE_DTAB:
+        dtab, p = _oracle_read_runlen_array(buf, p, 256)
+
+    # --- adaptive decode loop [SPEC transcription] ---
+    rc = _OracleRangeDecoder(buf, p)
+    nsym = max_sym + 1
+    qual_models = {}
+    len_models = [_OracleAdaptiveModel(256) for _ in range(4)]
+    qmask = (1 << qbits) - 1
+    out = bytearray()
+    last_len = 0
+    while len(out) < len(quals):
+        if (pflags & DO_LEN) or last_len == 0:
+            b0 = len_models[0].decode(rc)
+            b1 = len_models[1].decode(rc)
+            b2 = len_models[2].decode(rc)
+            b3 = len_models[3].decode(rc)
+            last_len = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+        qctx = 0
+        pos_left = last_len
+        delta = prevq = 0
+        ctx = context0
+        for _ in range(last_len):
+            m = qual_models.get(ctx)
+            if m is None:
+                m = qual_models[ctx] = _OracleAdaptiveModel(nsym)
+            q = m.decode(rc)
+            out.append(qmap[q] if qmap is not None else q)
+            qctx = ((qctx << qshift) + qtab[q]) & 0xFFFFFFFF
+            nxt = context0 + ((qctx & qmask) << qloc)
+            if pflags & HAVE_PTAB:
+                pos_left -= 1
+                nxt += ptab[min(1023, pos_left)] << ploc
+            if pflags & HAVE_DTAB:
+                nxt += dtab[min(255, delta)] << dloc
+                delta += 1 if prevq != q else 0
+                prevq = q
+            ctx = nxt & 0xFFFF
+    assert bytes(out) == quals
+
+
+def _oracle_tokenize(name: bytes):
+    """[SPEC transcription] tok3 token split: digit runs (DIGITS, or
+    DIGITS0 when zero-padded; >uint32 degrades to ALPHA), single
+    non-digit bytes CHAR, longer runs ALPHA; token list capped at 128
+    with the tail folded into one ALPHA."""
+    T_ALPHA, T_CHAR, T_DIGITS0, T_DIGITS = 1, 2, 4, 7
+    toks = []
+    i, n = 0, len(name)
+    while i < n:
+        if 0x30 <= name[i] <= 0x39:
+            j = i + 1
+            while j < n and 0x30 <= name[j] <= 0x39:
+                j += 1
+            run = name[i:j]
+            if len(run) > 9 or int(run) > 0xFFFFFFFF:
+                toks.append((T_ALPHA, run))
+            elif run[0] == 0x30 and len(run) > 1:
+                toks.append((T_DIGITS0, run))
+            else:
+                toks.append((T_DIGITS, run))
+            i = j
+        else:
+            j = i + 1
+            while j < n and not (0x30 <= name[j] <= 0x39):
+                j += 1
+            run = name[i:j]
+            toks.append((T_CHAR, run) if len(run) == 1
+                        else (T_ALPHA, run))
+            i = j
+    if len(toks) >= 128:
+        head, tail = toks[:127], toks[127:]
+        head.append((T_ALPHA, b"".join(t for _, t in tail)))
+        toks = head
+    return toks
+
+
+def test_cram31_tok3_frames_decode_via_oracle():
+    """cram_name_tok3.py name frames must reconstruct under an
+    independent walk of the frame (descriptors + uint7 lengths + Nx16
+    streams via the order-0 oracle) and the published token model
+    (DUP/DIFF selectors, per-position typed token streams)."""
+    import struct as _struct
+
+    from hadoop_bam_tpu.formats.cram_name_tok3 import tok3_encode
+
+    T_TYPE, T_ALPHA, T_CHAR, T_DZLEN, T_DIGITS0 = 0, 1, 2, 3, 4
+    T_DUP, T_DIFF, T_DIGITS, T_DDELTA, T_DDELTA0 = 5, 6, 7, 11, 12
+    T_MATCH, T_NOP, T_END = 13, 14, 15
+
+    names = [b"IL3:6:1:100:0042", b"IL3:6:1:101:0043",
+             b"IL3:6:1:101:0043", b"IL3:6:2:7:0999", b"read*odd",
+             b"IL3:6:2:8:1000"]
+    payload = b"".join(n + b"\0" for n in names)
+    frame = tok3_encode(payload)
+
+    ulen, nnames = _struct.unpack_from("<II", frame, 0)
+    assert (ulen, nnames) == (len(payload), len(names))
+    flags = frame[8]
+    assert not flags & 0x01                  # rANS streams, not arith
+    sep = b"\n" if flags & 0x02 else b"\0"
+
+    streams = {}
+    i, pos = 9, 0
+    while i < len(frame):
+        desc = frame[i]
+        i += 1
+        assert not desc & 0x40               # no duplicate-stream frames
+        if desc & 0x80:
+            pos += 1
+        clen, i = _uint7_get(frame, i)
+        streams[(pos, desc & 0x0F)] = [_oracle_nx16_payload(
+            frame[i:i + clen]), 0]
+        i += clen
+
+    def take(p, t, n):
+        data, cur = streams[(p, t)]
+        assert cur + n <= len(data)
+        streams[(p, t)][1] = cur + n
+        return data[cur:cur + n]
+
+    def take_cstr(p, t):
+        data, cur = streams[(p, t)]
+        end = data.index(b"\0", cur)
+        streams[(p, t)][1] = end + 1
+        return data[cur:end]
+
+    got = []
+    for _ in range(nnames):
+        sel = take(0, T_TYPE, 1)[0]
+        if sel == T_DUP:
+            (dist,) = _struct.unpack("<I", take(0, T_DUP, 4))
+            name = got[len(got) - dist]
+        else:
+            assert sel == T_DIFF
+            (dist,) = _struct.unpack("<I", take(0, T_DIFF, 4))
+            ref = _oracle_tokenize(got[len(got) - dist]) if dist else []
+            parts = []
+            p = 1
+            while True:
+                t = take(p, T_TYPE, 1)[0]
+                if t == T_END:
+                    break
+                if t == T_NOP:
+                    p += 1
+                    continue
+                rtok = ref[p - 1] if p - 1 < len(ref) else None
+                if t == T_MATCH:
+                    parts.append(rtok[1])
+                elif t == T_ALPHA:
+                    parts.append(take_cstr(p, T_ALPHA))
+                elif t == T_CHAR:
+                    parts.append(take(p, T_CHAR, 1))
+                elif t == T_DIGITS:
+                    (v,) = _struct.unpack("<I", take(p, T_DIGITS, 4))
+                    parts.append(b"%d" % v)
+                elif t == T_DIGITS0:
+                    (v,) = _struct.unpack("<I", take(p, T_DIGITS0, 4))
+                    w = take(p, T_DZLEN, 1)[0]
+                    parts.append(b"%0*d" % (w, v))
+                elif t == T_DDELTA:
+                    d = take(p, T_DDELTA, 1)[0]
+                    parts.append(b"%d" % (int(rtok[1]) + d))
+                elif t == T_DDELTA0:
+                    d = take(p, T_DDELTA0, 1)[0]
+                    parts.append(b"%0*d" % (len(rtok[1]),
+                                            int(rtok[1]) + d))
+                else:
+                    raise AssertionError(f"unknown token type {t}")
+                p += 1
+            name = b"".join(parts)
+        got.append(name)
+    assert b"".join(n + sep for n in got) == payload
+    # every stream fully consumed: nothing the oracle failed to model
+    for (p, t), (data, cur) in streams.items():
+        assert cur == len(data), (p, t)

@@ -351,6 +351,63 @@ def test_pf_outside_parallel_not_scoped():
 
 
 # ---------------------------------------------------------------------------
+# query-cache key identity (QE5xx)
+# ---------------------------------------------------------------------------
+
+_QE_BAD = '''
+def lookup(cache, path, lo, hi):
+    hit = cache.get((path, lo, hi))            # QE501: raw-path key
+    if hit is None:
+        hit = decode(path, lo, hi)
+        cache.put((path, lo, hi), hit, 128)    # QE501 again
+    return hit
+
+def decode(path, lo, hi):
+    return path
+'''
+
+_QE_CLEAN = '''
+from hadoop_bam_tpu.query.cache import file_identity
+
+def lookup(cache, path, lo, hi):
+    ident = file_identity(path)
+    hit = cache.get((ident, lo, hi))                 # identity name: ok
+    if hit is None:
+        hit = decode(path, lo, hi)
+        cache.put((file_identity(path), lo, hi), hit, 128)  # call: ok
+    stats = cache.get("toc")                         # no path at all: ok
+    return hit, stats
+
+def decode(path, lo, hi):
+    return path
+'''
+
+
+def test_qe_seeded_violations_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/query/bad_keys.py": _QE_BAD},
+        only=["querycache"])
+    assert rules_of(findings) == {"QE501"}
+    assert len(findings) == 2
+    assert all(f.severity == "error" for f in findings)
+    assert "file_identity" in findings[0].message
+
+
+def test_qe_identity_keys_pass():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/query/good_keys.py": _QE_CLEAN},
+        only=["querycache"])
+    assert findings == []
+
+
+def test_qe_outside_query_not_scoped():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/split/elsewhere.py": _QE_BAD},
+        only=["querycache"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip / suppression
 # ---------------------------------------------------------------------------
 

@@ -290,3 +290,32 @@ def test_spill_dir_removed_on_success_and_failure(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="injected merge failure"):
         sort_bam_mesh(path, out + "3", round_records=100, config=cfg)
     assert os.path.isdir(out + "3.mesh-spill")      # kept for autopsy
+
+
+def test_int32_ceiling_raises_plan_error_up_front(tmp_path, monkeypatch):
+    """Past 2^31 records the int32 global-index layout would silently
+    wrap; the guard must be a clearly-messaged PlanError — and when a
+    splitting-index sidecar records the exact total, it must fire UP
+    FRONT, before any planning or decoding touches the file (VERDICT r5
+    next #8)."""
+    from hadoop_bam_tpu.parallel import mesh_sort as ms
+    from hadoop_bam_tpu.split.splitting_index import SplittingIndex
+    from hadoop_bam_tpu.utils.errors import PlanError
+
+    with pytest.raises(PlanError, match="global-index ceiling"):
+        ms.check_global_index_ceiling(2**31, "unit")
+    with pytest.raises(ValueError):      # PlanError stays a ValueError
+        ms.check_global_index_ceiling(2**31, "unit")
+    ms.check_global_index_ceiling(ms.GLOBAL_INDEX_CEILING, "unit")  # at cap
+
+    class _Huge:
+        total_records = 2**31 + 5
+        granularity = 4096
+        voffsets = [0, 1 << 16]
+
+    monkeypatch.setattr(SplittingIndex, "load_for",
+                        classmethod(lambda cls, p: _Huge()))
+    # a nonexistent input proves the check fires before any file I/O
+    with pytest.raises(PlanError, match="spill"):
+        sort_bam_mesh(str(tmp_path / "absent.bam"),
+                      str(tmp_path / "out.bam"))

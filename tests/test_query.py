@@ -1,0 +1,500 @@
+"""Query subsystem tests (``pytest -m query``): byte-identity of region
+queries against the full-scan + host-filter oracle for every container,
+chunk coalescing/caching behavior, file-identity invalidation, and the
+admission/deadline/fault policies riding the PR-1 taxonomy.
+"""
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG
+from hadoop_bam_tpu.query import (
+    ChunkCache, QueryEngine, QueryRequest, QueryScheduler, file_identity,
+)
+from hadoop_bam_tpu.utils.errors import (
+    CorruptDataError, PlanError, TransientIOError,
+)
+from hadoop_bam_tpu.utils.metrics import METRICS
+
+from fixtures import make_header, make_records
+
+pytestmark = pytest.mark.query
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def _coord_sorted(header, recs):
+    def key(r):
+        rid = (header.ref_names.index(r.rname) if r.rname != "*"
+               else 1 << 30)
+        return (rid, r.pos)
+    return sorted(recs, key=key)
+
+
+@pytest.fixture(scope="module")
+def indexed_bam(tmp_path_factory):
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.split.bai import write_bai
+
+    path = str(tmp_path_factory.mktemp("query") / "q.bam")
+    header = make_header(2)
+    recs = _coord_sorted(header, make_records(header, 2500, seed=11))
+    with BamWriter(path, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    write_bai(path)
+    return path, header
+
+
+def _write_vcf_records(path, n, seed):
+    import random
+
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+
+    hdr_text = (
+        "##fileformat=VCFv4.2\n"
+        "##contig=<ID=chr20,length=64444167>\n"
+        "##contig=<ID=chr21,length=46709983>\n"
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="Depth">\n'
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="GT">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\ts0\ts1\n")
+    header = VCFHeader.from_text(hdr_text)
+    rng = random.Random(seed)
+    gts = ["0/0", "0/1", "1/1", "./."]
+    with open_vcf_writer(path, header) as w:
+        for chrom in ("chr20", "chr21"):
+            pos = 1
+            for i in range(n // 2):
+                pos += rng.randint(1, 60)
+                ref = rng.choice("ACGT")
+                alt = rng.choice([c for c in "ACGT" if c != ref])
+                g = "\t".join(rng.choice(gts) for _ in range(2))
+                w.write_record(VcfRecord.from_line(
+                    f"{chrom}\t{pos}\t.\t{ref}\t{alt}\t{30 + i % 40}\t"
+                    f"PASS\tDP={i % 90}\tGT\t{g}"))
+    return header
+
+
+@pytest.fixture(scope="module")
+def indexed_vcf(tmp_path_factory):
+    from hadoop_bam_tpu.split.tabix import write_tabix
+
+    path = str(tmp_path_factory.mktemp("query") / "q.vcf.gz")
+    _write_vcf_records(path, 3000, seed=21)
+    write_tabix(path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def indexed_bcf(tmp_path_factory):
+    from hadoop_bam_tpu.split.tabix import write_tabix
+
+    path = str(tmp_path_factory.mktemp("query") / "q.bcf")
+    _write_vcf_records(path, 3000, seed=22)
+    write_tabix(path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def cram_path(tmp_path_factory):
+    from hadoop_bam_tpu.api.writers import CramShardWriter
+
+    path = str(tmp_path_factory.mktemp("query") / "q.cram")
+    header = make_header(2)
+    recs = _coord_sorted(
+        header, [r for r in make_records(header, 1200, seed=31)
+                 if r.flag != 4])
+    with CramShardWriter(path, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    return path, header
+
+
+# ---------------------------------------------------------------------------
+# byte-identity vs the full-scan + host-filter oracle
+# ---------------------------------------------------------------------------
+
+_BAM_REGIONS = ["chr1:1000-200000", "chr1:500,000-650,000", "chr2",
+                "chr2:1-5000", "chr1:999999-1000000"]
+
+
+def _bam_oracle(path, header, region):
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.split.intervals import (
+        batch_overlap_mask, resolve_interval,
+    )
+    iv = resolve_interval(region, header.ref_names)
+    want = []
+    for batch in open_bam(path).batches():
+        m = batch_overlap_mask(batch, [iv], header)
+        for i in np.nonzero(m)[0]:
+            want.append(batch.to_sam_line(int(i)))
+    return want
+
+
+def test_bam_query_matches_full_scan_oracle(indexed_bam):
+    path, header = indexed_bam
+    engine = QueryEngine()
+    res = engine.query_records(
+        [QueryRequest(path, r) for r in _BAM_REGIONS])
+    for region, out in zip(_BAM_REGIONS, res):
+        got = [r.to_line() for r in out.records]
+        assert got == _bam_oracle(path, header, region), region
+    # at least one region matched something or the test is vacuous
+    assert sum(len(r.records) for r in res) > 0
+
+
+def _variant_oracle(path, region):
+    from hadoop_bam_tpu.api.vcf_dataset import open_vcf
+    from hadoop_bam_tpu.split.intervals import resolve_interval
+    ds = open_vcf(path)
+    iv = resolve_interval(region, ds.header.contigs)
+    want = []
+    for rec in ds.records():
+        if rec.chrom != iv.rname:
+            continue
+        if rec.pos <= iv.end and rec.pos + max(rec.rlen, 1) - 1 >= iv.start:
+            want.append(rec.to_line())
+    return want
+
+
+@pytest.mark.parametrize("fixture", ["indexed_vcf", "indexed_bcf"])
+def test_variant_query_matches_full_scan_oracle(fixture, request):
+    path = request.getfixturevalue(fixture)
+    engine = QueryEngine()
+    regions = ["chr20:1-30000", "chr20:40,000-60,000", "chr21",
+               "chr21:1-10"]
+    res = engine.query_records([QueryRequest(path, r) for r in regions])
+    for region, out in zip(regions, res):
+        got = [r.to_line() for r in out.records]
+        assert got == _variant_oracle(path, region), region
+    assert sum(len(r.records) for r in res) > 0
+
+
+def test_cram_query_matches_full_scan_oracle(cram_path):
+    from hadoop_bam_tpu.api.cram_dataset import open_cram
+    from hadoop_bam_tpu.query.engine import _ref_span_of_cigar
+    from hadoop_bam_tpu.split.intervals import resolve_interval
+
+    path, header = cram_path
+    engine = QueryEngine()
+    regions = ["chr1:1-400000", "chr2:100,000-1,500,000"]
+    res = engine.query_records([QueryRequest(path, r) for r in regions])
+    for region, out in zip(regions, res):
+        iv = resolve_interval(region, header.ref_names)
+        want = []
+        for rec in open_cram(path).records():
+            if rec.rname != iv.rname:
+                continue
+            end1 = rec.pos + max(_ref_span_of_cigar(rec.cigar, rec.seq),
+                                 1) - 1
+            if rec.pos <= iv.end and end1 >= iv.start:
+                want.append(rec.to_line())
+        assert [r.to_line() for r in out.records] == want, region
+    assert sum(len(r.records) for r in res) > 0
+
+
+def test_tensor_batches_mask_agrees_with_records(indexed_bam):
+    import jax
+
+    from hadoop_bam_tpu.api import query_regions
+
+    path, _header = indexed_bam
+    engine = QueryEngine()
+    res = engine.query_records(
+        [QueryRequest(path, r) for r in _BAM_REGIONS])
+    total = 0
+    for out in query_regions(path, _BAM_REGIONS, engine=engine):
+        assert isinstance(out["keep"], jax.Array)   # mesh-computed mask
+        total += int(np.asarray(out["keep"]).sum())
+    assert total == sum(len(r.records) for r in res)
+
+
+# ---------------------------------------------------------------------------
+# coalescing + cache behavior
+# ---------------------------------------------------------------------------
+
+def test_overlapping_requests_share_chunk_decodes(indexed_bam):
+    path, _header = indexed_bam
+    engine = QueryEngine()
+    batch = [
+        QueryRequest(path, "chr1:10000-60000"),
+        QueryRequest(path, "chr1:30000-90000"),
+        QueryRequest(path, "chr1:10000-60000"),   # exact duplicate
+    ]
+    before = METRICS.get("query.chunks_decoded")
+    engine.query_records(batch)
+    first = METRICS.get("query.chunks_decoded") - before
+    # three overlapping requests coalesce into ONE decoded chunk set —
+    # never one decode per request
+    assert 1 <= first < len(batch)
+    # the identical batch again: fully warm, zero fresh decodes (chunk
+    # identity = the batch's coalesced ranges + file identity, so
+    # repeated queries — the zipf-hot serving shape — always hit)
+    before = METRICS.get("query.chunks_decoded")
+    engine.query_records(batch)
+    assert METRICS.get("query.chunks_decoded") == before
+    # a single hot region repeated as its own batch also self-hits
+    solo = [QueryRequest(path, "chr1:10000-60000")]
+    engine.query_records(solo)
+    before = METRICS.get("query.chunks_decoded")
+    engine.query_records(solo)
+    assert METRICS.get("query.chunks_decoded") == before
+    assert engine.stats()["hits"] > 0
+
+
+def test_same_file_through_two_path_spellings(indexed_bam):
+    """Two spellings of one file (absolute vs relative) share one file
+    identity — ranges must ACCUMULATE per identity, not overwrite per
+    path string (review finding: the second spelling's chunk set used to
+    replace the first's, silently emptying its results)."""
+    path, header = indexed_bam
+    rel = os.path.relpath(path)
+    assert rel != path and os.path.abspath(rel) == path
+    res = QueryEngine().query_records([
+        QueryRequest(path, "chr1:1000-200000"),
+        QueryRequest(rel, "chr2:1-300000"),
+    ])
+    assert [r.to_line() for r in res[0].records] == \
+        _bam_oracle(path, header, "chr1:1000-200000")
+    assert [r.to_line() for r in res[1].records] == \
+        _bam_oracle(path, header, "chr2:1-300000")
+    assert res[0].records and res[1].records
+
+
+def test_coalesce_gap_arithmetic_per_kind(indexed_bam):
+    path, _header = indexed_bam
+    engine = QueryEngine()
+    v = lambda c, u=0: (c << 16) | u
+    # voffset ranges 8 KiB apart compressed: coalesce into one chunk
+    merged = engine._coalesce([(v(0), v(4096)), (v(12288), v(16384))],
+                              "bam")
+    assert merged == [(v(0), v(16384))]
+    # raw CRAM byte ranges 1 MiB apart must NOT merge (>>16 on raw bytes
+    # used to shrink the gap 65536x and coalesce across whole files)
+    apart = [(0, 4096), (1 << 20, (1 << 20) + 4096)]
+    assert engine._coalesce(apart, "cram") == apart
+    # ...but 8 KiB apart in raw bytes still merges
+    near = [(0, 4096), (12288, 16384)]
+    assert engine._coalesce(near, "cram") == [(0, 16384)]
+
+
+def test_skip_bad_spans_serves_quarantined_chunk_as_empty(indexed_bam):
+    from hadoop_bam_tpu.utils.resilient import FaultSpec, chaos_on
+
+    path, header = indexed_bam
+    cfg = dataclasses.replace(DEFAULT_CONFIG, skip_bad_spans=True,
+                              span_retries=0)
+    engine = QueryEngine(config=cfg)
+    engine.query_records([QueryRequest(path, "chr1:1-2000")])  # meta warm
+    region = "chr2:500000-700000"
+    before = METRICS.get("query.chunks_skipped")
+    with chaos_on(path, [FaultSpec("bitflip", at_read=0, count=64,
+                                   xor_mask=0xFF)]):
+        res = engine.query_records([QueryRequest(path, region)])
+    assert res[0].records == []                # skipped, not crashed
+    assert METRICS.get("query.chunks_skipped") > before
+    # nothing poisonous cached: the same region heals once chaos is off
+    res = engine.query_records([QueryRequest(path, region)])
+    assert [r.to_line() for r in res[0].records] == \
+        _bam_oracle(path, header, region)
+
+
+def test_cache_stats_are_per_instance():
+    a, b = ChunkCache(1 << 20), ChunkCache(1 << 20)
+    a.put(("k",), "v", 10)
+    a.get(("k",))
+    b.get(("absent",))
+    assert a.stats()["hits"] == 1 and a.stats()["misses"] == 0
+    assert b.stats()["hits"] == 0 and b.stats()["misses"] == 1
+
+
+def test_cache_invalidation_on_mtime_change(tmp_path):
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.split.bai import write_bai
+
+    path = str(tmp_path / "inval.bam")
+    header = make_header(1)
+
+    def build(seed, n):
+        recs = _coord_sorted(header, make_records(header, n, seed=seed))
+        with BamWriter(path, header) as w:
+            for r in recs:
+                w.write_sam_record(r)
+        write_bai(path)
+
+    build(1, 400)
+    engine = QueryEngine()
+    region = "chr1:1-1000000"
+    first = engine.query_records([QueryRequest(path, region)])[0]
+    assert first.records
+
+    build(2, 150)    # replace the file in place
+    # force a visible mtime bump even on coarse-grained filesystems
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    second = engine.query_records([QueryRequest(path, region)])[0]
+    assert [r.to_line() for r in second.records] == \
+        _bam_oracle(path, header, region)
+    assert [r.to_line() for r in second.records] != \
+        [r.to_line() for r in first.records]
+
+
+def test_chunk_cache_budget_evicts_lru():
+    cache = ChunkCache(byte_budget=100)
+    cache.put(("a",), "A", 60)
+    cache.put(("b",), "B", 30)
+    assert cache.get(("a",)) == "A"          # refresh a: b becomes LRU
+    cache.put(("c",), "C", 40)               # evicts b (then maybe a)
+    assert cache.get(("b",)) is None
+    assert cache.bytes_used <= 100
+    # an entry larger than the whole budget is never admitted
+    cache.put(("huge",), "X", 1000)
+    assert cache.get(("huge",)) is None
+
+
+def test_chunk_cache_rejects_bad_budget():
+    with pytest.raises(PlanError):
+        ChunkCache(byte_budget=0)
+
+
+def test_file_identity_changes_with_content(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"one")
+    a = file_identity(p)
+    p.write_bytes(b"three!")
+    st = os.stat(p)
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    b = file_identity(p)
+    assert a != b
+    with pytest.raises(FileNotFoundError):   # PLAN class downstream
+        file_identity(tmp_path / "missing.bin")
+
+
+# ---------------------------------------------------------------------------
+# admission control + deadlines (PR-1 taxonomy)
+# ---------------------------------------------------------------------------
+
+def test_admission_rejects_when_saturated():
+    sched = QueryScheduler(max_in_flight=1, queue_depth=0)
+    before = METRICS.get("query.rejected")
+    with sched.admit():
+        assert sched.in_flight == 1
+        with pytest.raises(TransientIOError):
+            with sched.admit():
+                pass
+    assert METRICS.get("query.rejected") == before + 1
+    # slot freed: admission works again
+    with sched.admit():
+        pass
+
+
+def test_admission_wait_deadline_expires_with_injected_clock():
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.5              # every look at the clock advances it
+        return t[0]
+
+    sched = QueryScheduler(max_in_flight=1, queue_depth=4,
+                           default_deadline_s=1.0, clock=clock)
+    with sched.admit():
+        with pytest.raises(TransientIOError):
+            with sched.admit():          # waits, then blows the deadline
+                pass
+
+
+def test_query_deadline_raises_transient(indexed_bam):
+    path, _header = indexed_bam
+    engine = QueryEngine(scheduler=QueryScheduler(default_deadline_s=0.0))
+    before = METRICS.get("query.deadline_exceeded")
+    with pytest.raises(TransientIOError):
+        engine.query_records([QueryRequest(path, "chr1:1-100")])
+    assert METRICS.get("query.deadline_exceeded") == before + 1
+
+
+def test_per_request_deadline_override(indexed_bam):
+    path, _header = indexed_bam
+    engine = QueryEngine()          # no batch deadline at all
+    with pytest.raises(TransientIOError):
+        engine.query_records(
+            [QueryRequest(path, "chr1:1-100", deadline_s=0.0)])
+
+
+def test_scheduler_bad_parameters_are_plan_errors():
+    with pytest.raises(PlanError):
+        QueryScheduler(max_in_flight=0)
+    with pytest.raises(PlanError):
+        QueryScheduler(queue_depth=-1)
+    with pytest.raises(PlanError):
+        QueryScheduler(default_deadline_s=-1.0)
+
+
+def test_missing_index_is_plan_error(tmp_path):
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+
+    path = str(tmp_path / "noindex.bam")
+    header = make_header(1)
+    with BamWriter(path, header) as w:
+        for r in _coord_sorted(header, make_records(header, 20, seed=5)):
+            w.write_sam_record(r)
+    with pytest.raises(PlanError, match="bai"):
+        QueryEngine().query_records([QueryRequest(path, "chr1:1-100")])
+
+
+def test_unknown_contig_and_container_are_plan_errors(indexed_bam,
+                                                      tmp_path):
+    path, _header = indexed_bam
+    with pytest.raises(PlanError, match="reference dictionary"):
+        QueryEngine().query_records([QueryRequest(path, "chrZ:1-100")])
+    other = tmp_path / "x.fastq"
+    other.write_text("@r\nACGT\n+\n!!!!\n")
+    with pytest.raises(PlanError, match="region-query"):
+        QueryEngine().query_records(
+            [QueryRequest(str(other), "chr1:1-100")])
+
+
+# ---------------------------------------------------------------------------
+# fault injection through the classified retry policy
+# ---------------------------------------------------------------------------
+
+def test_transient_chunk_faults_heal_under_retry(indexed_bam):
+    from hadoop_bam_tpu.utils.resilient import FaultSpec, chaos_on
+
+    path, header = indexed_bam
+    cfg = dataclasses.replace(DEFAULT_CONFIG, span_retries=3,
+                              retry_backoff_base_s=0.001,
+                              retry_backoff_max_s=0.002)
+    engine = QueryEngine(config=cfg)
+    # resolve metadata cleanly first (header/index reads are not under
+    # the span-retry policy; only chunk decodes are)
+    engine.query_records([QueryRequest(path, "chr1:1-2000")])
+    region = "chr2:1-120000"       # cold chunk for the faulted pass
+    with chaos_on(path, [FaultSpec("transient", at_read=0, count=2)]):
+        res = engine.query_records([QueryRequest(path, region)])
+    assert [r.to_line() for r in res[0].records] == \
+        _bam_oracle(path, header, region)
+
+
+def test_corrupt_chunk_fails_fast(indexed_bam):
+    from hadoop_bam_tpu.utils.resilient import FaultSpec, chaos_on
+
+    path, _header = indexed_bam
+    cfg = dataclasses.replace(DEFAULT_CONFIG, span_retries=3,
+                              retry_backoff_base_s=0.001,
+                              retry_backoff_max_s=0.002)
+    engine = QueryEngine(config=cfg)
+    engine.query_records([QueryRequest(path, "chr1:1-2000")])
+    before = METRICS.get("pipeline.transient_retries")
+    with chaos_on(path, [FaultSpec("bitflip", at_read=0, count=64,
+                                   xor_mask=0xFF)]):
+        with pytest.raises((CorruptDataError, ValueError)):
+            engine.query_records(
+                [QueryRequest(path, "chr2:200000-400000")])
+    # corruption is never retried: zero transient re-attempts burned
+    assert METRICS.get("pipeline.transient_retries") == before
