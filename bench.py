@@ -80,6 +80,9 @@ _EMITTED = threading.Event()
 _EMIT_LOCK = threading.Lock()
 _STATE = {"platform": None, "notes": [], "components": [],
           "headline": None, "scaling": None}
+# --trace FILE: record stage spans for the whole run and write a
+# Chrome-trace JSON at the final emit (watchdog paths included)
+_TRACE = {"path": None}
 
 
 def _remaining() -> float:
@@ -140,6 +143,15 @@ def _compact_snapshot(full: dict) -> dict:
     }
     if "vs_baseline" in full:
         out["vs_baseline"] = full["vs_baseline"]
+    # compact latency component (r9): warm region-query p50/p99 ms from
+    # the query.latency_s histogram — the serving numbers a deadline
+    # contract is written against, small enough to ride the final line
+    rq = next((c for c in full["components"]
+               if c.get("metric") == "region_query_queries_per_sec"
+               and isinstance(c.get("latency_p50_ms"), (int, float))),
+              None)
+    if rq is not None:
+        out["latency"] = [rq["latency_p50_ms"], rq["latency_p99_ms"]]
     scaling = full.get("scaling")
     if isinstance(scaling, dict):
         rows = [[r["n_devices"], r["flagstat_records_per_sec"]]
@@ -151,7 +163,7 @@ def _compact_snapshot(full: dict) -> dict:
     if full.get("notes"):
         out["notes"] = "; ".join(full["notes"])[:160]
     while len(json.dumps(out)) > FINAL_LINE_BUDGET:
-        for k in ("notes", "scaling", "components"):
+        for k in ("notes", "latency", "scaling", "components"):
             if k in out:
                 del out[k]
                 break
@@ -170,6 +182,21 @@ def _emit_pair(status: str) -> None:
     print(json.dumps(_compact_snapshot(full)), flush=True)
 
 
+def _save_trace() -> None:
+    """Flush the --trace span ring to its Chrome-trace file (called on
+    every final-emit path so the watchdog's timeout exit keeps whatever
+    was recorded)."""
+    if not _TRACE["path"]:
+        return
+    try:
+        from hadoop_bam_tpu.obs import active_recorder
+        rec = active_recorder()
+        if rec is not None:
+            rec.save(_TRACE["path"])
+    except Exception:  # noqa: BLE001 — tracing must never cost the run
+        pass
+
+
 def _emit_progress() -> None:
     with _EMIT_LOCK:
         if _EMITTED.is_set():
@@ -185,6 +212,7 @@ def _emit(status: str) -> None:
             return
         _EMITTED.set()
         _emit_pair(status)
+        _save_trace()
 
 
 _CHILD = {"proc": None}   # in-flight scaling subprocess, for watchdog kill
@@ -745,6 +773,7 @@ def bench_region_query(path: str):
     import numpy as np
 
     from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+    from hadoop_bam_tpu.utils.metrics import MetricsContext
 
     bam, regions = _region_query_fixture(path)
 
@@ -766,8 +795,13 @@ def bench_region_query(path: str):
 
     s0 = cold_engine.stats()      # instance counters: warm-pass delta
     t0 = time.perf_counter()
-    warm_matched = run_pass(cold_engine)       # same engine: warm cache
+    # run-scoped metrics: each region is a single-request batch, so the
+    # warm pass's query.latency_s histogram IS the per-query latency
+    # distribution — the p50/p99 a serving deadline is written against
+    with MetricsContext() as warm_metrics:
+        warm_matched = run_pass(cold_engine)   # same engine: warm cache
     warm_dt = time.perf_counter() - t0
+    lat = warm_metrics.hist_summary("query.latency_s")
     s1 = cold_engine.stats()
     d_hits = s1["hits"] - s0["hits"]
     d_total = d_hits + s1["misses"] - s0["misses"]
@@ -788,8 +822,77 @@ def bench_region_query(path: str):
             "cache_hit_rate": round(stats["hit_rate"], 4),
             "regions": len(regions),
             "records_matched": int(n_matched),
+            # warm-pass per-query latency from the query.latency_s
+            # histogram (run-scoped MetricsContext, so concurrent rows
+            # cannot smear into it); also rides the compact FINAL line
+            # as the "latency" component
+            "latency_p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+            "latency_p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
             "note": "zipf-skewed 250-region batch over the 100k BAM; "
                     "warm pass re-serves decoded chunks from the LRU"}
+
+
+def bench_obs_overhead(path: str):
+    """What the always-on instrumentation itself costs (tracing
+    DISABLED, the default state): flagstat through an isolated normal
+    MetricsContext vs the same run through NullMetrics (every span/
+    counter/histogram a no-op).  The acceptance bar for the obs layer
+    is < 2% — pinned here so span creep shows up as a bench regression,
+    not a slow mystery."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.pipeline import (
+        flagstat_file, pipeline_span_count,
+    )
+    from hadoop_bam_tpu.split.planners import plan_spans_cached
+    from hadoop_bam_tpu.utils.metrics import MetricsContext, NullMetrics
+    import jax
+
+    bam = _scaling_fixture(path)
+    header, _ = read_bam_header(bam)
+    spans = plan_spans_cached(
+        bam, header, DEFAULT_CONFIG,
+        num_spans=pipeline_span_count(bam, len(jax.devices()),
+                                      DEFAULT_CONFIG))
+
+    from hadoop_bam_tpu.obs import install_recorder
+    from hadoop_bam_tpu.utils.metrics import Metrics
+
+    def run(metrics_cls):
+        with MetricsContext(metrics_cls()):
+            return flagstat_file(bam, header=header, spans=spans)
+
+    # interleaved best-of-N: on this 1-core host the run-to-run jitter
+    # (GC, page cache, the shared decode pool warming) is larger than
+    # the overhead being measured, so alternate the two variants and
+    # compare their MINIMA — drift hits both arms equally.  The trace
+    # recorder is SUSPENDED for the row: under `bench.py --trace` a
+    # live ring would make the instrumented arm pay tracing-enabled
+    # costs (the row's bar is the tracing-DISABLED state) and flood
+    # the trace file with this row's 12 flagstat runs.
+    prev_recorder = install_recorder(None)
+    try:
+        run(Metrics)
+        run(NullMetrics)          # warmup both arms (jit, pool, cache)
+        dt_on, dt_off = [], []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            run(Metrics)
+            dt_on.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run(NullMetrics)
+            dt_off.append(time.perf_counter() - t0)
+    finally:
+        install_recorder(prev_recorder)
+    on, off = min(dt_on), min(dt_off)
+    overhead = (on - off) / off * 100.0
+    return {"metric": "obs_overhead_pct",
+            "value": round(overhead, 2), "unit": "%",
+            "note": ("flagstat with live spans/counters/histograms "
+                     "(tracing disabled) vs NullMetrics, interleaved "
+                     "best-of-5; bar is < 2%"),
+            "instrumented_s": round(on, 4),
+            "null_s": round(off, 4)}
 
 
 # ---------------------------------------------------------------------------
@@ -1512,6 +1615,8 @@ def main() -> None:
                    "bcf_variants_per_sec", est_s=25)
     _run_component(lambda: bench_region_query(path),
                    "region_query_queries_per_sec", est_s=45)
+    _run_component(lambda: bench_obs_overhead(path),
+                   "obs_overhead_pct", est_s=25)
     _run_component(lambda: bench_fastq(build_fastq_fixture()),
                    "fastq_reads_per_sec", est_s=25)
     _run_component(lambda: bench_bam_write(path),
@@ -1547,6 +1652,17 @@ if __name__ == "__main__":
     if "--scaling-child" in sys.argv:
         _scaling_child(int(sys.argv[sys.argv.index("--scaling-child") + 1]))
         sys.exit(0)
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace") + 1
+        if i < len(sys.argv):
+            _TRACE["path"] = sys.argv[i]
+            from hadoop_bam_tpu.obs import enable_tracing
+            enable_tracing(1 << 18)
+        else:
+            # the rc-0/JSON-out contract covers bad invocations too:
+            # record the problem as a note instead of tracebacking
+            _STATE["notes"].append("--trace given without a file path; "
+                                   "tracing disabled for this run")
     try:
         main()
     except BaseException as e:   # the contract: JSON out, rc 0, always

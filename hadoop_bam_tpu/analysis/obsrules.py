@@ -1,0 +1,172 @@
+"""OB6xx — observability discipline: stage timing must flow through Metrics.
+
+The obs layer (``obs/``, ``utils/metrics.py``) only works if the hot
+paths actually report through it: a stage timed with a bare
+``time.perf_counter()`` pair never reaches the trace ring, the
+histograms, or the mesh-wide merge — it is invisible exactly where the
+waterfall matters.  And the wrong PRIMITIVE is as bad as none:
+``Metrics.timer`` sums thread-seconds, so a timer inside a function the
+decode pool runs concurrently reports work-seconds that exceed wall
+time and hide overlap (the PR-4 lesson that created ``wall_timer``).
+
+Scope: ``parallel/``, ``query/``, ``ops/`` (the pipeline hot paths).
+
+- OB601: a ``time.perf_counter()`` / ``time.time()`` call inside a
+  function that never feeds Metrics (no ``METRICS.*`` /
+  ``current_metrics`` / ``observe`` / ``add_wall`` / ``_account``
+  reference anywhere in the function) is untracked stage timing.
+  Measure with ``Metrics.span``/``timer``/``wall_timer``/``observe``,
+  or feed the measured interval into ``Metrics.add_wall``.
+
+- OB602: ``Metrics.timer`` used in a function handed to the shared
+  decode pool (via ``_iter_windowed`` / ``pools.submit`` /
+  ``pool.submit`` / ``executor.map``) without a ``wall_timer``/``span``
+  alongside — pool tasks overlap, so the timer's thread-sum misreports
+  the stage; use ``wall_timer``/``span`` (keeping a paired ``timer``
+  for work-seconds is fine, alone it is not).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/parallel", "hadoop_bam_tpu/query",
+         "hadoop_bam_tpu/ops")
+
+_CLOCK_CALLS = {"perf_counter", "time"}
+# identifiers that mark a function as feeding the metrics layer
+_METRICS_FEEDERS = {"metrics", "observe", "add_wall", "timer",
+                    "wall_timer", "span", "current_metrics", "_account",
+                    "hist_summary"}
+_POOL_DISPATCHERS = {"_iter_windowed", "submit", "pool_submit", "map"}
+
+
+def _func_defs(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _direct_children_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes within ``fn`` but not within a nested function def."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_clock_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _CLOCK_CALLS:
+        base = f.value
+        return isinstance(base, ast.Name) and base.id == "time"
+    # `from time import perf_counter` style
+    return isinstance(f, ast.Name) and f.id == "perf_counter"
+
+
+def _identifiers(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _feeds_metrics(fn: ast.AST) -> bool:
+    return any(i.lower() in _METRICS_FEEDERS or "metrics" in i.lower()
+               for i in _identifiers(fn))
+
+
+def _metrics_attr_calls(fn: ast.AST, attr: str) -> List[ast.Call]:
+    """Calls of ``<something metrics-ish>.<attr>(...)`` within fn."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute) or f.attr != attr:
+            continue
+        recv = f.value
+        name = recv.id if isinstance(recv, ast.Name) else (
+            recv.attr if isinstance(recv, ast.Attribute) else "")
+        if "metrics" in name.lower():
+            out.append(node)
+    return out
+
+
+def _uses_wall_primitive(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in ("wall_timer",
+                                                             "span"):
+            return True
+    return False
+
+
+def _pooled_callee_names(fn: ast.AST) -> Set[str]:
+    """Names of nested functions this function hands to the decode
+    pool: arguments of _iter_windowed / submit / pool_submit / .map /
+    .submit calls."""
+    names: Set[str] = set()
+    for call in ast.walk(fn):
+        if not isinstance(call, ast.Call):
+            continue
+        f = call.func
+        fname = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if fname not in _POOL_DISPATCHERS:
+            continue
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+    return names
+
+
+@register("obs")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+        # OB601: raw clock stage timing that never reaches Metrics
+        for fn in _func_defs(m.tree):
+            if _feeds_metrics(fn):
+                continue
+            for call in _direct_children_calls(fn):
+                if _is_clock_call(call):
+                    findings.append(Finding(
+                        rule="OB601", severity="error", path=m.path,
+                        line=call.lineno,
+                        message=f"raw {ast.unparse(call.func)}() stage "
+                                "timing in a hot path that never feeds "
+                                "Metrics — the interval is invisible to "
+                                "spans, histograms, and the mesh-wide "
+                                "merge; use Metrics.span/timer/observe "
+                                "or feed the value into "
+                                "Metrics.add_wall"))
+
+        # OB602: Metrics.timer inside a pool-dispatched function without
+        # a wall-clock primitive alongside
+        for fn in _func_defs(m.tree):
+            pooled = _pooled_callee_names(fn)
+            if not pooled:
+                continue
+            nested = {n.name: n for n in _func_defs(fn) if n is not fn}
+            for name in pooled & set(nested):
+                target = nested[name]
+                if _uses_wall_primitive(target):
+                    continue
+                for call in _metrics_attr_calls(target, "timer"):
+                    findings.append(Finding(
+                        rule="OB602", severity="error", path=m.path,
+                        line=call.lineno,
+                        message="Metrics.timer in a decode-pool task: "
+                                "pool tasks overlap, so the timer's "
+                                "thread-sum exceeds wall time and hides "
+                                "pipeline overlap — use "
+                                "Metrics.wall_timer or Metrics.span "
+                                "(alone or alongside the timer)"))
+    return findings

@@ -93,7 +93,7 @@ class CramDataset:
         yield from stream_read_tensor_batches(
             self.spans(num_spans) if spans is None else spans, None,
             self.config, mesh, geometry, tiles_fn=tiles,
-            quarantine=quarantine)
+            quarantine=quarantine, fmt="cram")
 
     def flagstat(self, mesh=None) -> Dict[str, int]:
         """Host-side flagstat over decoded CRAM records (same counters as

@@ -129,7 +129,7 @@ class FastqDataset(_SpannedDataset):
         )
         yield from stream_read_tensor_batches(
             self.spans(num_spans), self.read_span, self.config, mesh,
-            geometry)
+            geometry, fmt="fastq")
 
 
 class QseqDataset(_SpannedDataset):
@@ -155,7 +155,7 @@ class QseqDataset(_SpannedDataset):
         )
         yield from stream_read_tensor_batches(
             self.spans(num_spans), self.read_span, self.config, mesh,
-            geometry)
+            geometry, fmt="qseq")
 
 
 class FastaDataset(_SpannedDataset):
@@ -216,7 +216,7 @@ class FastaDataset(_SpannedDataset):
 
         yield from stream_read_tensor_batches(
             self.spans(num_spans), read_windows, self.config, mesh,
-            geometry)
+            geometry, fmt="fasta")
 
 
 def open_fastq(path: str, config: HBamConfig = DEFAULT_CONFIG) -> FastqDataset:

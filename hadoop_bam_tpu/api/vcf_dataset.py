@@ -211,7 +211,7 @@ class VcfDataset:
         # historical contract that every variant tensor batch carries
         # full tile_records rows
         keys, fp, tuples = variant_feed(stream, n_dev, cap, self.config,
-                                        fixed_shape=True)
+                                        fixed_shape=True, fmt="vcf")
         if fp is None:
             return
 

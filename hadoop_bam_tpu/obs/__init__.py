@@ -1,0 +1,27 @@
+"""hbam-trace: the observability layer — spans, histograms, exporters.
+
+Three pieces, threaded through every pipeline stage via
+``utils/metrics.py``:
+
+- ``trace.py``   ring-buffer-bounded structured span recording with
+  Chrome trace-event JSON export (``chrome://tracing`` / Perfetto) and
+  ``jax.profiler`` layering — ``enable_tracing()`` turns it on,
+  ``Metrics.span`` feeds it;
+- ``hist.py``    log-bucketed mergeable latency/size histograms with
+  p50/p95/p99 extraction — ``Metrics.observe`` feeds them, and their
+  bucket merge is associative so per-host histograms allgather into
+  one mesh-wide distribution (``parallel/distributed.merge_metrics``);
+- ``export.py``  Prometheus text exposition + snapshot JSON files —
+  the ``hbam metrics`` CLI surface.
+
+Run-scoped isolation lives in ``utils.metrics.MetricsContext`` (the
+contextvar-scoped instance the ``METRICS`` proxy resolves to).
+"""
+from hadoop_bam_tpu.obs.hist import Histogram  # noqa: F401
+from hadoop_bam_tpu.obs.trace import (  # noqa: F401
+    TraceRecorder, active_recorder, disable_tracing, enable_tracing,
+    install_recorder,
+)
+from hadoop_bam_tpu.obs.export import (  # noqa: F401
+    load_metrics_json, prometheus_text, render_metrics, save_metrics_json,
+)

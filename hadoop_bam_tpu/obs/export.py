@@ -1,0 +1,107 @@
+"""Metric exporters: Prometheus text exposition + snapshot JSON files.
+
+Two consumer shapes (the reference had neither — Hadoop counters died
+with the job):
+
+- **Prometheus text exposition** (``prometheus_text``): counters as
+  ``_total`` counters, timers as seconds+calls counter pairs, wall
+  spans as gauges, histograms as native Prometheus histograms with
+  cumulative ``le`` buckets derived from the log-bucket grid — a
+  ``hbam serve`` scrape endpoint (ROADMAP item 2) can return this
+  string verbatim.
+- **Snapshot JSON** (``save_metrics_json`` / ``load_metrics_json``):
+  the full mergeable ``Metrics.to_dict`` state on disk, so a run's
+  numbers survive the process and ``hbam metrics FILE`` can re-render
+  or re-export them later (and snapshots from several hosts/runs merge
+  with ``Metrics.merge_dict`` — bucket merge is associative).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Optional
+
+from hadoop_bam_tpu.obs.hist import Histogram
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(prefix: str, name: str, suffix: str = "") -> str:
+    return f"{prefix}_{_NAME_RE.sub('_', name)}{suffix}"
+
+
+def _fmt(v: float) -> str:
+    return repr(round(float(v), 9))
+
+
+def prometheus_text(metrics_or_dict, prefix: str = "hbam",
+                    labels: Optional[Dict[str, str]] = None) -> str:
+    """Render a ``Metrics`` instance (or its ``to_dict`` payload) in the
+    Prometheus text exposition format (version 0.0.4)."""
+    d = metrics_or_dict if isinstance(metrics_or_dict, dict) \
+        else metrics_or_dict.to_dict()
+    lab = ""
+    if labels:
+        lab = "{" + ",".join(f'{k}="{v}"'
+                             for k, v in sorted(labels.items())) + "}"
+    lines = []
+    for k in sorted(d.get("counters", {})):
+        n = _prom_name(prefix, k, "_total")
+        lines += [f"# TYPE {n} counter",
+                  f"{n}{lab} {int(d['counters'][k])}"]
+    timer_calls = d.get("timer_calls", {})
+    for k in sorted(d.get("timers", {})):
+        n = _prom_name(prefix, k, "_seconds_total")
+        lines += [f"# TYPE {n} counter",
+                  f"{n}{lab} {_fmt(d['timers'][k])}"]
+        c = _prom_name(prefix, k, "_calls_total")
+        lines += [f"# TYPE {c} counter",
+                  f"{c}{lab} {int(timer_calls.get(k, 0))}"]
+    for k in sorted(d.get("wall_timers", {})):
+        n = _prom_name(prefix, k, "_seconds")
+        lines += [f"# TYPE {n} gauge",
+                  f"{n}{lab} {_fmt(d['wall_timers'][k])}"]
+    for k in sorted(d.get("histograms", {})):
+        h = d["histograms"][k]
+        if not isinstance(h, dict) or "buckets" not in h:
+            continue           # a summary snapshot, not mergeable state
+        n = _prom_name(prefix, k)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for idx in sorted(int(i) for i in h["buckets"]):
+            cum += int(h["buckets"][str(idx)])
+            _, upper = Histogram.bucket_bounds(idx)
+            le = f'le="{_fmt(upper)}"'
+            sep = "," if labels else ""
+            inner = (lab[1:-1] + sep + le) if labels else le
+            lines.append(f"{n}_bucket{{{inner}}} {cum}")
+        inf = 'le="+Inf"'
+        inner = (lab[1:-1] + "," + inf) if labels else inf
+        lines.append(f"{n}_bucket{{{inner}}} {int(h.get('count', cum))}")
+        lines.append(f"{n}_sum{lab} {_fmt(h.get('total', 0.0))}")
+        lines.append(f"{n}_count{lab} {int(h.get('count', cum))}")
+    return "\n".join(lines) + "\n"
+
+
+def save_metrics_json(metrics_or_dict, path: str) -> str:
+    """Write the full mergeable snapshot (``Metrics.to_dict``) to disk."""
+    d = metrics_or_dict if isinstance(metrics_or_dict, dict) \
+        else metrics_or_dict.to_dict()
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(d, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_metrics_json(path: str) -> Dict[str, object]:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def render_metrics(d: Dict[str, object]) -> str:
+    """Human-readable text of a snapshot dict (``Metrics.render``)."""
+    from hadoop_bam_tpu.utils.metrics import Metrics
+    return Metrics.from_dict(d).render()

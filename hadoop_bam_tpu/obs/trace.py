@@ -1,0 +1,193 @@
+"""Structured span tracing: a bounded ring of begin/end events.
+
+The reference exposed only Hadoop task counters and stderr warnings
+(PAPER.md section 5); ``utils/metrics.py`` rebuilt the counters.  This
+module adds the missing half — WHERE the time went, per thread, as
+spans: every pipeline stage (plan / fetch / inflate / host_decode /
+staging pack / dispatch / kernel / combine, and the query engine's
+resolve / fetch / filter) records a ``(name, t0, dur, thread, args)``
+event through ``Metrics.span``, and the whole run exports as ONE
+Chrome trace-event JSON file loadable in ``chrome://tracing`` /
+Perfetto — pool threads, the staging packer and the dispatch thread
+side by side on a real timeline, which is the waterfall view the
+rapidgzip and SAGe papers (PAPERS.md) credit their pipeline wins to.
+
+Design constraints, in order:
+
+- **Disabled is (near) free.**  Tracing is off by default; the only
+  always-on cost is one module-global read per span.  The bench's
+  ``obs_overhead_pct`` row pins the whole instrumentation layer (spans
+  + histograms, tracing disabled) under 2% of flagstat throughput.
+- **Enabled is bounded.**  Events land in a preallocated ring of
+  ``capacity`` slots (config ``trace_ring_events``); once full, the
+  OLDEST events are overwritten and ``dropped`` counts them — an
+  always-on recorder can never grow without bound.
+- **Thread-safe by construction.**  One lock per recorded event; the
+  event payload is a plain tuple built outside the lock.
+
+``jax.profiler`` interop: when tracing is enabled and jax is already
+imported, spans are ALSO wrapped in ``jax.profiler.TraceAnnotation``
+so they show up inside TPU profiler traces; when jax is absent or not
+yet imported, spans degrade to ring events alone (no import is ever
+triggered from the hot path).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# event tuple layout: (name, ts_s, dur_s, tid, thread_name, args_or_None)
+_Event = Tuple[str, float, float, int, str, Optional[dict]]
+
+
+class TraceRecorder:
+    """Bounded ring buffer of completed spans + instant events."""
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = max(16, int(capacity))
+        self._buf: List[Optional[_Event]] = [None] * self.capacity
+        self._next = 0          # monotonically increasing write cursor
+        self.dropped = 0        # events overwritten after the ring filled
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()   # trace epoch (ts are relative)
+        # TraceAnnotation class, resolved once at enable time iff jax is
+        # already imported — never triggers a jax import itself
+        self._annotation = _resolve_jax_annotation()
+
+    # -- recording -----------------------------------------------------------
+
+    def complete(self, name: str, t0: float, dur: float,
+                 args: Optional[dict] = None) -> None:
+        """Record one finished span (perf_counter begin + duration)."""
+        t = threading.current_thread()
+        ev = (name, t0 - self._t0, dur, t.ident or 0, t.name, args)
+        with self._lock:
+            i = self._next
+            if i >= self.capacity:   # overwriting the oldest event
+                self.dropped += 1
+            self._buf[i % self.capacity] = ev
+            self._next = i + 1
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        """Record a zero-duration marker event."""
+        self.complete(name, time.perf_counter(), 0.0, args)
+
+    def annotation(self, name: str):
+        """A ``jax.profiler.TraceAnnotation`` for ``name`` when jax is
+        importable and already imported; None otherwise."""
+        return self._annotation(name) if self._annotation else None
+
+    # -- export --------------------------------------------------------------
+
+    def events(self) -> List[_Event]:
+        """Events in record order (oldest surviving first)."""
+        with self._lock:
+            n, cap = self._next, self.capacity
+            if n <= cap:
+                return [e for e in self._buf[:n] if e is not None]
+            start = n % cap
+            return [e for e in self._buf[start:] + self._buf[:start]
+                    if e is not None]
+
+    def chrome_trace(self, process_label: Optional[str] = None,
+                     process_index: int = 0) -> Dict[str, object]:
+        """The trace as a Chrome trace-event JSON document
+        (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU):
+        ``ph: "X"`` complete events with microsecond timestamps, plus
+        metadata events naming the process and each thread.  Loadable
+        directly in ``chrome://tracing`` and Perfetto."""
+        pid = int(process_index)
+        events: List[dict] = [{
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": process_label
+                     or f"hbam host {pid} (pid {os.getpid()})"},
+        }]
+        seen_tids = {}
+        for name, ts, dur, tid, tname, args in self.events():
+            if tid not in seen_tids:
+                seen_tids[tid] = tname
+                events.append({"ph": "M", "pid": pid, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": tname}})
+            ev = {"ph": "X", "pid": pid, "tid": tid, "name": name,
+                  "ts": round(ts * 1e6, 3), "dur": round(dur * 1e6, 3),
+                  "cat": name.split(".", 1)[0]}
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        doc: Dict[str, object] = {"traceEvents": events,
+                                  "displayTimeUnit": "ms"}
+        if self.dropped:
+            doc["otherData"] = {"dropped_events": self.dropped}
+        return doc
+
+    def save(self, path: str, process_label: Optional[str] = None,
+             process_index: int = 0) -> str:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(process_label, process_index), f)
+        os.replace(tmp, path)
+        return path
+
+
+def _resolve_jax_annotation():
+    """jax.profiler.TraceAnnotation iff jax is ALREADY imported (a
+    minimal install without jax, or a pure-IO CLI verb that never
+    touched jax, must not pay the import here)."""
+    import sys
+    if "jax" not in sys.modules:
+        return None
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation
+    except Exception:  # noqa: BLE001 — tracing must never break a run
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the process-wide active recorder (None = tracing disabled, the default)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[TraceRecorder] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def enable_tracing(capacity: Optional[int] = None) -> TraceRecorder:
+    """Install (and return) the process-wide recorder.  Idempotent: an
+    already-active recorder is returned unchanged unless ``capacity``
+    asks for a different ring size."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is None or (capacity is not None
+                               and _ACTIVE.capacity != int(capacity)):
+            _ACTIVE = TraceRecorder(capacity or 65536)
+        return _ACTIVE
+
+
+def disable_tracing() -> Optional[TraceRecorder]:
+    """Uninstall and return the recorder (so a caller can still export)."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        rec, _ACTIVE = _ACTIVE, None
+        return rec
+
+
+def install_recorder(rec: Optional[TraceRecorder]
+                     ) -> Optional[TraceRecorder]:
+    """Swap the active recorder in (None = disable), returning the
+    previous one — the suspend/resume primitive for code that must not
+    pollute a live trace (the bench's overhead row measures the
+    tracing-DISABLED cost and would otherwise wrap the ring with its
+    own 12 flagstat runs)."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        prev, _ACTIVE = _ACTIVE, rec
+        return prev
+
+
+def active_recorder() -> Optional[TraceRecorder]:
+    """The hot-path read ``Metrics.span`` does per span: one global."""
+    return _ACTIVE

@@ -239,6 +239,51 @@ def merge_quarantine_manifests(manifest: QuarantineManifest,
     return per_host[0].merged_with(per_host[1:])
 
 
+def merge_metrics(metrics=None, max_bytes: int = 1 << 20,
+                  timeout_s: Optional[float] = None):
+    """Mesh-wide metric merge: every host contributes its local Metrics
+    state over one fixed-size allgather, and all hosts return the SAME
+    merged ``Metrics`` — the job-level view the reference's Hadoop
+    counters gave for free and per-host stderr dumps cannot.
+
+    Merge semantics (``Metrics.merge_dict``): counters and timers SUM
+    (work adds across hosts); histograms merge by bucket addition —
+    associative, so the fold order across hosts cannot change the
+    result (pinned in tests/test_obs.py); wall spans take the MAX
+    across hosts (each host's value is already its local union, and
+    hosts run concurrently — the mesh-wide wall is the slowest host's,
+    not the sum).  Single-process: returns a detached copy of the
+    current state, so callers can render/export it uniformly."""
+    from hadoop_bam_tpu.utils.metrics import Metrics, current_metrics
+
+    if metrics is None:
+        metrics = current_metrics()
+    if jax.process_count() == 1:
+        return Metrics.from_dict(metrics.to_dict())
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps(metrics.to_dict()).encode()
+    if len(payload) + 8 > max_bytes:
+        raise PlanError(f"metrics snapshot serializes to {len(payload)} "
+                        f"bytes — exceeds the {max_bytes}-byte allgather "
+                        f"buffer; raise max_bytes")
+    buf = np.zeros(max_bytes, dtype=np.uint8)
+    buf[:8] = np.frombuffer(np.int64(len(payload)).tobytes(), np.uint8)
+    buf[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
+    rows = _run_collective(
+        lambda: np.asarray(multihost_utils.process_allgather(buf)),
+        "merge_metrics", timeout_s=timeout_s)
+    rows = rows.astype(np.uint8, copy=False)  # see broadcast_plan: some
+    #                                           collectives widen uint8
+    merged = Metrics()
+    for host in range(rows.shape[0]):
+        n = int(np.frombuffer(rows[host, :8].tobytes(), np.int64)[0])
+        merged.merge_dict(json.loads(rows[host, 8:8 + n].tobytes()
+                                     .decode()))
+    merged.count("obs.hosts_merged", int(rows.shape[0]))
+    return merged
+
+
 def assign_spans(spans: Sequence[FileVirtualSpan],
                  index: Optional[int] = None,
                  count: Optional[int] = None) -> List[FileVirtualSpan]:

@@ -45,7 +45,9 @@ from hadoop_bam_tpu.split.spans import FileVirtualSpan
 from hadoop_bam_tpu.utils import errors as hberrors
 from hadoop_bam_tpu.utils.errors import PlanError, classify_error
 from hadoop_bam_tpu.utils.metrics import METRICS
-from hadoop_bam_tpu.utils.pools import decode_pool, decode_pool_size
+from hadoop_bam_tpu.utils.pools import (
+    decode_pool, decode_pool_size, submit as pool_submit,
+)
 from hadoop_bam_tpu.utils.resilient import (
     QuarantineManifest, RetryPolicy, RetryingByteSource,
 )
@@ -140,16 +142,18 @@ def _decode_span_core(source, span: FileVirtualSpan,
     #    block AT end_c when the span ends inside it (end_u > 0): reading it
     #    up front folds it into the one native batched-inflate call instead
     #    of a per-block Python zlib + whole-buffer concatenate afterwards.
-    raw = src.pread(start_c, max(end_c - start_c, 0))
-    end_block_size = 0
-    if end_u > 0 and end_c < src.size:
-        head = src.pread(end_c, bgzf.MAX_BLOCK_SIZE)
-        info = bgzf.parse_block_header(head, 0)
-        end_block_size = info.block_size
-        raw = raw + head[:end_block_size]
+    with METRICS.span("bam.fetch_wall", nbytes=max(end_c - start_c, 0)):
+        raw = src.pread(start_c, max(end_c - start_c, 0))
+        end_block_size = 0
+        if end_u > 0 and end_c < src.size:
+            head = src.pread(end_c, bgzf.MAX_BLOCK_SIZE)
+            info = bgzf.parse_block_header(head, 0)
+            end_block_size = info.block_size
+            raw = raw + head[:end_block_size]
     if raw:
         table = inflate_ops.block_table(raw)
-        with METRICS.timer("pipeline.inflate"):
+        with METRICS.timer("pipeline.inflate"), \
+                METRICS.span("bam.inflate_wall", nbytes=len(raw)):
             data, ubase = inflate_ops.inflate_span(raw, table,
                                                    backend=inflate_backend)
         METRICS.count("pipeline.blocks", int(table["isize"].size))
@@ -230,7 +234,8 @@ def _decode_span_core(source, span: FileVirtualSpan,
     #    split's end voffset).
     rows = None
     while True:
-        with METRICS.timer("pipeline.walk"):
+        with METRICS.timer("pipeline.walk"), \
+                METRICS.span("bam.walk_wall"):
             if packed_walker is not None:
                 rows, offs, tail = packed_walker(data, start_u, end_inflated)
             else:
@@ -669,14 +674,17 @@ def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
     it = iter(items)
     dq: "deque[cf.Future]" = deque()
     try:
+        # pools.submit, not pool.submit: the task carries the caller's
+        # MetricsContext onto the worker thread and records its queue
+        # wait + run into the pool.task_* histograms
         for item in it:
-            dq.append(pool.submit(fn, item))
+            dq.append(pool_submit(pool, fn, item))
             if len(dq) >= window:
                 break
         while dq:
             fut = dq.popleft()
             for item in it:
-                dq.append(pool.submit(fn, item))
+                dq.append(pool_submit(pool, fn, item))
                 break
             yield fut.result()
     finally:
@@ -830,7 +838,8 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                 src, s, geometry, check_crc,
                 intervals=intervals, header=header)
             return prefix, seq, qual
-        with METRICS.wall_timer("pipeline.host_decode_wall"):
+        with METRICS.wall_timer("pipeline.host_decode_wall"), \
+                METRICS.span("bam.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
         return out if out is not None else (
@@ -845,7 +854,7 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     fp = FeedPipeline(n_dev, cap, [TileSpec((w,), np.uint8) for w in widths],
                       block_n=geometry.block_n,
                       fixed_shape=geometry.fixed_shape, balance=balance,
-                      config=config)
+                      config=config, fmt="bam")
     if emit_fn is not None:
         yield from fp.stream(stream, emit_fn)
     else:
@@ -874,9 +883,10 @@ class _StatTotals:
         f0, i0 = self._pairs[0]
         tf = np.zeros(np.shape(f0), np.float64)
         ti = np.zeros(np.shape(i0), np.int64)
-        for f, i in self._pairs:
-            tf += np.asarray(jax.device_get(f), np.float64)
-            ti += np.asarray(jax.device_get(i), np.int64)
+        with METRICS.span("pipeline.combine_wall", groups=len(self._pairs)):
+            for f, i in self._pairs:
+                tf += np.asarray(jax.device_get(f), np.float64)
+                ti += np.asarray(jax.device_get(i), np.int64)
         return tf, ti
 
 
@@ -963,6 +973,7 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
                                geometry: "Optional[PayloadGeometry]",
                                tiles_fn=None,
                                quarantine: Optional[QuarantineManifest] = None,
+                               fmt: str = "read",
                                ) -> Iterator[Dict]:
     """Shared tensor-batch generator for text/record read formats
     (FASTQ/QSEQ/CRAM): ``read_span_fn(span)`` returns a list of objects
@@ -995,7 +1006,8 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
             return fragments_to_payload_tiles(
                 read_span_fn(s), geometry.seq_stride,
                 geometry.qual_stride, geometry.max_len)
-        with METRICS.wall_timer("pipeline.host_decode_wall"):
+        with METRICS.wall_timer("pipeline.host_decode_wall"), \
+                METRICS.span(f"{fmt}.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
         return out if out is not None else (
@@ -1007,7 +1019,8 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
                             2 * decode_pool_size(config))
     specs = (geometry.seq_stride, geometry.qual_stride, (None, np.int32))
     fp = FeedPipeline(n_dev, cap, specs, block_n=geometry.block_n,
-                      fixed_shape=geometry.fixed_shape, config=config)
+                      fixed_shape=geometry.fixed_shape, config=config,
+                      fmt=fmt)
 
     def emit(arrays, counts) -> Dict:
         # the returned device dict doubles as the slot's in-flight
@@ -1105,16 +1118,18 @@ def cram_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         # pipeline-grain spans so container decode overlaps dispatch
         # (the 128 MiB job grain would serialize them)
         n_dev = int(np.prod(mesh.devices.shape))
-        spans = ds.spans(num_spans=pipeline_span_count(path, n_dev,
-                                                       config))
+        with METRICS.span("cram.plan_wall"):
+            spans = ds.spans(num_spans=pipeline_span_count(path, n_dev,
+                                                           config))
     step = make_read_stats_step(mesh, geometry)
     totals = _StatTotals()
     if quarantine is None:
         quarantine = QuarantineManifest()
     for b in ds.tensor_batches(mesh=mesh, geometry=geometry, spans=spans,
                                quarantine=quarantine):
-        totals.add(*step(b["seq_packed"], b["qual"], b["lengths"],
-                         b["n_records"]))
+        with METRICS.span("cram.kernel_wall"):
+            totals.add(*step(b["seq_packed"], b["qual"], b["lengths"],
+                             b["n_records"]))
     return _attach_quarantine(_payload_stats_result(totals), quarantine)
 
 
@@ -1142,6 +1157,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     cap = geometry.tile_records
     lower = path.lower()
     is_qseq = lower.endswith(QSEQ_EXTS)
+    fmt = "qseq" if is_qseq else "fastq"
     ds = open_qseq(path, config) if is_qseq else open_fastq(path, config)
     # Vectorized tokenize (no per-read Python objects) whenever the config
     # doesn't force the object path: failed-QC filtering needs parsed
@@ -1155,8 +1171,9 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         qual_offset = config.fastq_base_quality_encoding.value
         text_to_tiles = fastq_text_to_payload_tiles
     if spans is None:
-        spans = ds.spans(
-            num_spans=pipeline_span_count(path, n_dev, config))
+        with METRICS.span(f"{fmt}.plan_wall"):
+            spans = ds.spans(
+                num_spans=pipeline_span_count(path, n_dev, config))
     spans = list(spans)
     if quarantine is None:
         quarantine = QuarantineManifest()
@@ -1170,15 +1187,19 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
 
     def decode(span):
         def inner(s):
-            if fast_tiles:
-                return text_to_tiles(
-                    ds.read_span_text(s), geometry.seq_stride,
-                    geometry.qual_stride, geometry.max_len, qual_offset)
-            frags = ds.read_span(s)
-            return fragments_to_payload_tiles(
-                frags, geometry.seq_stride, geometry.qual_stride,
-                geometry.max_len)
-        with METRICS.wall_timer("pipeline.host_decode_wall"):
+            with METRICS.span(f"{fmt}.fetch_wall"):
+                raw = ds.read_span_text(s) if fast_tiles \
+                    else ds.read_span(s)
+            with METRICS.span(f"{fmt}.tokenize_wall"):
+                if fast_tiles:
+                    return text_to_tiles(
+                        raw, geometry.seq_stride, geometry.qual_stride,
+                        geometry.max_len, qual_offset)
+                return fragments_to_payload_tiles(
+                    raw, geometry.seq_stride, geometry.qual_stride,
+                    geometry.max_len)
+        with METRICS.wall_timer("pipeline.host_decode_wall"), \
+                METRICS.span(f"{fmt}.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
         return out if out is not None else (
@@ -1195,12 +1216,13 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     specs = (geometry.seq_stride, geometry.qual_stride, (None, np.int32))
     fp = FeedPipeline(n_dev, cap, specs, block_n=geometry.block_n,
                       fixed_shape=geometry.fixed_shape, balance=True,
-                      config=config)
+                      config=config, fmt=fmt)
 
     def dispatch(arrays, counts):
         args = [jax.device_put(a, sharding) for a in arrays]
         c = jax.device_put(counts, sharding)
-        totals.add(*step(*args, c))   # async; drained once at the end
+        with METRICS.span(f"{fmt}.kernel_wall"):
+            totals.add(*step(*args, c))  # async; drained once at the end
         return (*args, c)  # in-flight handles: the ring waits before reuse
 
     fp.feed(stream, dispatch)
@@ -1237,8 +1259,9 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
         src.close()
         from hadoop_bam_tpu.split.planners import plan_spans_cached
-        spans = plan_spans_cached(path, header, config,
-                                  num_spans=n_spans)
+        with METRICS.span("bam.plan_wall", spans=n_spans):
+            spans = plan_spans_cached(path, header, config,
+                                      num_spans=n_spans)
 
     step = make_seq_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
@@ -1251,7 +1274,8 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
         # returned device arrays are the slot's in-flight handles
         args = [jax.device_put(a, sharding) for a in arrays]
         c = jax.device_put(counts, sharding)
-        totals.add(*step(*args, c))       # async; drained once at the end
+        with METRICS.span("bam.kernel_wall"):
+            totals.add(*step(*args, c))   # async; drained once at the end
         return (*args, c)
 
     for _ in iter_payload_tile_groups(
@@ -1304,8 +1328,9 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
         n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
         src.close()
         from hadoop_bam_tpu.split.planners import plan_spans_cached
-        spans = plan_spans_cached(path, header, config,
-                                  num_spans=n_spans)
+        with METRICS.span("bam.plan_wall", spans=n_spans):
+            spans = plan_spans_cached(path, header, config,
+                                      num_spans=n_spans)
 
     projection = FLAGSTAT_PROJECTION
     row_bytes = projection_row_bytes(projection)
@@ -1330,7 +1355,8 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                 want_voffs=False, intervals=intervals, header=header)
             return rows
         with METRICS.timer("pipeline.host_decode"), \
-                METRICS.wall_timer("pipeline.host_decode_wall"):
+                METRICS.wall_timer("pipeline.host_decode_wall"), \
+                METRICS.span("bam.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
         return out if out is not None \
@@ -1350,22 +1376,25 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     # inverse-scaling tax); the bucket ladder bounds the extra jit
     # shapes at two.
     fp = FeedPipeline(n_dev, cap, (TileSpec((row_bytes,), np.uint8),),
-                      balance=True, config=config)
+                      balance=True, config=config, fmt="bam")
 
     def dispatch(arrays, counts):
         nonlocal totals_vec
         with METRICS.timer("pipeline.device_put"):
             t = jax.device_put(arrays[0], sharding)
             c = jax.device_put(counts, sharding)
-        vec = step(t, c)
-        totals_vec = vec if totals_vec is None else _ADD(totals_vec, vec)
+        with METRICS.span("bam.kernel_wall"):
+            vec = step(t, c)
+            totals_vec = vec if totals_vec is None \
+                else _ADD(totals_vec, vec)
         return t, c      # in-flight handles: the ring waits before reuse
 
     fp.feed(((r,) for r in row_stream), dispatch)
     if totals_vec is None:
         host = np.zeros(len(FLAGSTAT_FIELDS), dtype=np.int64)
     else:
-        with METRICS.timer("pipeline.device_drain"):
+        with METRICS.timer("pipeline.device_drain"), \
+                METRICS.span("bam.combine_wall"):
             host = np.asarray(jax.device_get(totals_vec), dtype=np.int64)
     return _attach_quarantine(
         {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}, quarantine)
@@ -1514,15 +1543,16 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
         # through the config string form would misparse contig names
         # that themselves contain ':' (GRCh38 HLA alts)
         from hadoop_bam_tpu.split.bai import plan_interval_spans
-        spans = plan_interval_spans(path, [region], header)
-        if spans is None:                   # no .bai sidecar: whole file
-            span_bytes = 4 << 20
-            src = as_byte_source(path)
-            n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
-            src.close()
-            from hadoop_bam_tpu.split.planners import plan_spans_cached
-            spans = plan_spans_cached(path, header, config,
-                                      num_spans=n_spans)
+        with METRICS.span("bam.plan_wall"):
+            spans = plan_interval_spans(path, [region], header)
+            if spans is None:               # no .bai sidecar: whole file
+                span_bytes = 4 << 20
+                src = as_byte_source(path)
+                n_spans = max(n_dev, int(np.ceil(src.size / span_bytes)))
+                src.close()
+                from hadoop_bam_tpu.split.planners import plan_spans_cached
+                spans = plan_spans_cached(path, header, config,
+                                          num_spans=n_spans)
 
     sharding = NamedSharding(mesh, P("data"))
     rep = NamedSharding(mesh, P())
@@ -1542,7 +1572,8 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
         def inner(s):
             return decode_span_cigar_rows(src, s, max_cigar,
                                           check_crc)
-        with METRICS.wall_timer("pipeline.host_decode_wall"):
+        with METRICS.wall_timer("pipeline.host_decode_wall"), \
+                METRICS.span("bam.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
         return out if out is not None else np.zeros((0, row_w),
@@ -1557,7 +1588,8 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
     # ring views, so it counts the real transferred bytes itself
     fp = FeedPipeline(n_dev, tile_records,
                       (TileSpec((row_w,), np.uint8),),
-                      fixed_shape=True, count_bytes=False, config=config)
+                      fixed_shape=True, count_bytes=False, config=config,
+                      fmt="bam")
 
     def dispatch(arrays, counts):
         # most records carry far fewer ops than max_cigar; slice the
@@ -1585,15 +1617,17 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
                       int(cut.nbytes) + int(counts.nbytes))
         t = jax.device_put(cut, sharding)
         c = jax.device_put(counts, sharding)
-        out = step(t, c, tref, wstart)
-        nonlocal window_depth
-        window_depth = out if window_depth is None else \
-            window_depth + out        # shard-local add, no collective
+        with METRICS.span("bam.kernel_wall"):
+            out = step(t, c, tref, wstart)
+            nonlocal window_depth
+            window_depth = out if window_depth is None else \
+                window_depth + out    # shard-local add, no collective
         return t, c      # in-flight handles: the ring waits before reuse
 
     fp.feed(((r,) for r in stream), dispatch)
     if window_depth is None:
         return np.zeros(window, np.int32)
     # one cross-device reduce at the end instead of one psum per dispatch
-    total = jnp.sum(window_depth, axis=0)
-    return np.asarray(jax.device_get(total), dtype=np.int32)
+    with METRICS.span("bam.combine_wall"):
+        total = jnp.sum(window_depth, axis=0)
+        return np.asarray(jax.device_get(total), dtype=np.int32)

@@ -40,6 +40,7 @@ values cannot show overlap, the wall spans can.
 from __future__ import annotations
 
 import collections
+import contextvars
 import dataclasses
 import queue
 import threading
@@ -237,7 +238,8 @@ class FeedPipeline:
                  dispatch_depth: Optional[int] = None,
                  config: Optional[HBamConfig] = None,
                  count_bytes: bool = True,
-                 name: str = "pipeline"):
+                 name: str = "pipeline",
+                 fmt: Optional[str] = None):
         config = config if config is not None else DEFAULT_CONFIG
         self.n_dev, self.cap = int(n_dev), int(cap)
         self.specs = [TileSpec.normalize(s) for s in specs]
@@ -254,6 +256,11 @@ class FeedPipeline:
         # own pipeline.dispatch_bytes — the view nbytes would overstate
         self.count_bytes = bool(count_bytes)
         self.name = name
+        # driver-family taxonomy twin: with fmt="bam" the same walls
+        # ALSO land under bam.feed_wall / bam.dispatch_wall, so every
+        # driver family reports the same <fmt>.<stage> span set (the
+        # shared pipeline.* keys keep the bench contract)
+        self.fmt = fmt
         self.dispatches = 0
         self.dispatch_bytes = 0
         self._device_wall = 0.0
@@ -307,8 +314,10 @@ class FeedPipeline:
                 # the slot's previous dispatch may still be transferring
                 # from these buffers: wait HERE, on the packer thread,
                 # where the wait overlaps the consumer's next dispatch
-                _block_in_flight(slot.in_flight)
+                with METRICS.span("staging.transfer_wait"):
+                    _block_in_flight(slot.in_flight)
                 slot.in_flight = None
+            t_pack = time.perf_counter()
             counts = slot.counts
             counts[:] = 0
             target = self.cap
@@ -361,6 +370,13 @@ class FeedPipeline:
                     c = int(counts[dev])
                     if c < bucket:
                         dst[dev, c:bucket] = spec.pad
+            # pack span (packer thread): group assembly occupancy sits
+            # next to the consumer thread's dispatch spans in the trace
+            # — the double-buffer overlap made visible
+            METRICS.add_wall("staging.pack", time.perf_counter() - t_pack,
+                             t0=t_pack,
+                             args={"rows": int(counts.sum()),
+                                   "bucket": bucket})
             _put(q, (slot, bucket), cancel)
 
     # -- consumer side (the caller's thread) --------------------------------
@@ -389,8 +405,11 @@ class FeedPipeline:
             except _Cancelled:
                 pass
 
-        packer = threading.Thread(target=pack, name="hbam-feed-pack",
-                                  daemon=True)
+        # the packer runs in a COPY of the caller's context so its spans
+        # and walls land in the caller's MetricsContext, not the global
+        ctx = contextvars.copy_context()
+        packer = threading.Thread(target=lambda: ctx.run(pack),
+                                  name="hbam-feed-pack", daemon=True)
         self._device_wall = 0.0
         self.dispatches = 0
         self.dispatch_bytes = 0
@@ -411,7 +430,10 @@ class FeedPipeline:
             cancel.set()
             packer.join()
             self._total_wall = time.perf_counter() - t0
-            METRICS.add_wall(f"{self.name}.feed_wall", self._total_wall)
+            METRICS.add_wall(f"{self.name}.feed_wall", self._total_wall,
+                             t0=t0, args={"groups": self.dispatches})
+            if self.fmt:
+                METRICS.add_wall(f"{self.fmt}.feed_wall", self._total_wall)
         if errs:
             raise errs[0]
 
@@ -426,14 +448,22 @@ class FeedPipeline:
             yield arrays, slot.counts
 
     def _account(self, arrays: Tuple[np.ndarray, ...], counts: np.ndarray,
-                 dt: float) -> None:
+                 dt: float, t0: Optional[float] = None) -> None:
         self._device_wall += dt
         self.dispatches += 1
+        n = None
         if self.count_bytes:
             n = sum(int(a.nbytes) for a in arrays) + int(counts.nbytes)
             self.dispatch_bytes += n
             METRICS.count("pipeline.dispatch_bytes", n)
-        METRICS.add_wall(f"{self.name}.dispatch_wall", dt)
+        METRICS.add_wall(f"{self.name}.dispatch_wall", dt, t0=t0,
+                         args=None if n is None else {"bytes": n})
+        if self.fmt:
+            METRICS.add_wall(f"{self.fmt}.dispatch_wall", dt)
+        # per-group dispatch latency distribution: the p99 here is the
+        # stall a device feels when the host falls behind — invisible in
+        # the summed dispatch_wall
+        METRICS.observe("pipeline.dispatch_group_s", dt)
 
     def stream(self, span_stream: Iterable[Tuple[np.ndarray, ...]],
                emit_fn: Callable) -> Iterator:
@@ -449,7 +479,8 @@ class FeedPipeline:
         for slot, arrays in self._slots(span_stream):
             t0 = time.perf_counter()
             out = emit_fn(arrays, slot.counts)
-            self._account(arrays, slot.counts, time.perf_counter() - t0)
+            self._account(arrays, slot.counts, time.perf_counter() - t0,
+                          t0=t0)
             slot.in_flight = out
             yield out
 

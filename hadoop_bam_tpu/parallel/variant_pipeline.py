@@ -197,9 +197,9 @@ def bcf_span_stat_columns(path: str, span, header: VCFHeader,
     )
     from hadoop_bam_tpu.split.vcf_planners import read_bcf_span_frames
 
-    with METRICS.wall_timer("vcf.inflate_wall"):
+    with METRICS.span("vcf.inflate_wall"):
         raw, starts = read_bcf_span_frames(path, span, is_bgzf)
-    with METRICS.wall_timer("vcf.tokenize_wall"):
+    with METRICS.span("vcf.tokenize_wall"):
         cols = decode_bcf_columns(raw, header, geometry.samples_pad,
                                   starts=starts)
         if cols is not None:
@@ -342,7 +342,7 @@ def _pack_variant_text_vectorized(text: bytes, header: VCFHeader,
     # tokenizer cost on wide cohorts and the bench's vcf_stage_seconds
     # row wants them attributable
     if S:
-        with METRICS.wall_timer("vcf.dosage_pack_wall"):
+        with METRICS.span("vcf.dosage_pack_wall"):
             gb8, glen8 = gather(8, 2)
             has_gt = (glen8 >= 2) & (gb8[:, 0] == ord("G")) \
                 & (gb8[:, 1] == ord("T")) & (ntab >= 9)
@@ -552,8 +552,9 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
         geometry = VariantGeometry(n_samples=header.n_samples)
     cap = geometry.tile_records
     if spans is None:
-        spans = ds.spans(num_spans=pipeline_span_count(path, n_dev,
-                                                       config))
+        with METRICS.span("vcf.plan_wall"):
+            spans = ds.spans(
+                num_spans=pipeline_span_count(path, n_dev, config))
     step = make_variant_stats_step(mesh, geometry)
     sharding = NamedSharding(mesh, P("data"))
     pool = decode_pool(config)
@@ -566,15 +567,16 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
             # per-stage wall spans (Metrics.wall_timer: overlapping pool
             # threads union, so values are wall seconds, not thread-sums)
             # feed the bench's vcf_stage_seconds row
-            with METRICS.wall_timer("vcf.inflate_wall"):
+            with METRICS.span("vcf.inflate_wall"):
                 text = ds.read_span_text(s)
             if text is not None:  # fast tokenizer, no record objects
-                with METRICS.wall_timer("vcf.tokenize_wall"):
+                with METRICS.span("vcf.tokenize_wall"):
                     return pack_variant_tiles_from_text(text, header,
                                                         geometry)
             return bcf_span_stat_columns(ds.path, s, header, geometry,
                                          ds._is_bgzf_bcf)
-        with METRICS.wall_timer("pipeline.host_decode_wall"):
+        with METRICS.wall_timer("pipeline.host_decode_wall"), \
+                METRICS.span("vcf.host_decode_wall"):
             out = decode_with_retry(inner, span, config)
         if out is not None:
             return out
@@ -587,10 +589,10 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
     # shrinks to a dispatch bucket
     keys, fp, tuples = variant_feed(stream, n_dev, cap, config,
                                     block_n=_VARIANT_BLOCK_N,
-                                    balance=True)
+                                    balance=True, fmt="vcf")
     if fp is not None:
         def dispatch(arrays, counts):
-            with METRICS.wall_timer("vcf.dispatch_wall"):
+            with METRICS.span("vcf.dispatch_wall"):
                 named = dict(zip(keys, arrays))
                 args = [jax.device_put(named[k], sharding)
                         for k in ("chrom", "pos", "flags", "dosage")]

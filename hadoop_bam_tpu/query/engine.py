@@ -337,12 +337,20 @@ class QueryEngine:
         hit = self.cache.get(key)
         if hit is not None:
             return hit
+        import time
+
         from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
 
         span = FileVirtualSpan(meta.path, s, e)
-        with METRICS.wall_timer("query.decode_wall"):
+        t0 = time.perf_counter()
+        with METRICS.span("query.decode_wall", kind=meta.kind):
             value = decode_with_retry(
                 lambda sp: self._decode_chunk(meta, sp), span, self.config)
+        # per-chunk fetch+decode latency/size distributions: cache
+        # misses only — the p99 here is what a cold region costs
+        METRICS.observe("query.chunk_fetch_s", time.perf_counter() - t0)
+        if value is not None:
+            METRICS.observe("query.chunk_bytes", int(value["nbytes"]))
         if value is None:
             # config.skip_bad_spans quarantined the chunk: serve it as
             # empty (the scan drivers' skip semantics), and do NOT cache
@@ -509,7 +517,7 @@ class QueryEngine:
         for i, req in enumerate(requests):
             by_path.setdefault(req.path, []).append(i)
 
-        with METRICS.wall_timer("query.resolve_wall"):
+        with METRICS.span("query.resolve_wall", requests=len(requests)):
             plans = []           # (req_idx, meta, iv, ranges)
             # ranges accumulate BY FILE IDENTITY, not by path string —
             # two spellings of the same file (relative vs absolute)
@@ -594,7 +602,7 @@ class QueryEngine:
             return {"rid": dev[0], "pos": dev[1], "end": dev[2],
                     "req": dev[6], "keep": keep, "n_records": dev[7]}
 
-        with METRICS.wall_timer("query.filter_wall"):
+        with METRICS.span("query.filter_wall"):
             yield from fp.stream(iter(tuples), emit)
 
     def tensor_batches(self, requests: Sequence[QueryRequest],
@@ -605,9 +613,18 @@ class QueryEngine:
         to its request index."""
         requests = [r if isinstance(r, QueryRequest) else QueryRequest(*r)
                     for r in requests]
-        with self.scheduler.admit(deadline_s) as deadline:
-            tuples, _refs, _counts, _ivs = self._prepare(requests, deadline)
-            yield from self._stream_groups(tuples, deadline)
+        import time
+        t0 = time.perf_counter()
+        try:
+            with self.scheduler.admit(deadline_s) as deadline:
+                tuples, _refs, _counts, _ivs = self._prepare(requests,
+                                                             deadline)
+                yield from self._stream_groups(tuples, deadline)
+        finally:
+            # end-to-end batch latency (admission wait included): on a
+            # single-request batch this IS the per-query latency the
+            # bench's p50/p99 columns report
+            METRICS.observe("query.latency_s", time.perf_counter() - t0)
 
     def query_records(self, requests: Sequence[QueryRequest],
                       deadline_s: Optional[float] = None
@@ -617,6 +634,8 @@ class QueryEngine:
         across the batch."""
         requests = [r if isinstance(r, QueryRequest) else QueryRequest(*r)
                     for r in requests]
+        import time
+        t_start = time.perf_counter()
         with self.scheduler.admit(deadline_s) as deadline:
             tuples, refs, cand_counts, _ivs = self._prepare(requests,
                                                             deadline)
@@ -642,6 +661,7 @@ class QueryEngine:
                 recs.append(self._materialize(meta, value, int(row)))
         METRICS.count("query.rows_matched",
                       sum(len(r.records) for r in results))
+        METRICS.observe("query.latency_s", time.perf_counter() - t_start)
         return results
 
     def stats(self) -> Dict[str, float]:

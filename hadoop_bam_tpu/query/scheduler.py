@@ -100,6 +100,7 @@ class QueryScheduler:
     @contextlib.contextmanager
     def admit(self, deadline_s: Optional[float] = None) -> Iterator[Deadline]:
         deadline = self.deadline(deadline_s)
+        t0 = time.perf_counter()
         with self._cond:
             if self._in_flight >= self.max_in_flight \
                     and self._waiting >= self.queue_depth:
@@ -123,6 +124,9 @@ class QueryScheduler:
                 self._waiting -= 1
             self._in_flight += 1
         METRICS.count("query.admitted")
+        # admission-wait distribution: a deep p95 here means the limit,
+        # not the decode path, is what clients are waiting on
+        METRICS.observe("query.admit_wait_s", time.perf_counter() - t0)
         try:
             yield deadline
         finally:

@@ -200,20 +200,82 @@ def cmd_cat(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# observability plumbing shared by the device verbs
+# ---------------------------------------------------------------------------
+
+def _start_obs(args) -> None:
+    """--trace FILE: turn on the span trace ring before the verb runs."""
+    if getattr(args, "trace", None):
+        from hadoop_bam_tpu.obs import enable_tracing
+        enable_tracing()
+
+
+def _finish_obs(args, metrics=None) -> None:
+    """Write the --trace Chrome trace file and/or the --metrics-json
+    snapshot after the verb's work is done."""
+    if getattr(args, "trace", None):
+        from hadoop_bam_tpu.obs import disable_tracing
+        rec = disable_tracing()
+        if rec is not None:
+            try:
+                pid = (sys.modules["jax"].process_index()
+                       if "jax" in sys.modules else 0)
+            except Exception:  # noqa: BLE001 — labeling only
+                pid = 0
+            rec.save(args.trace, process_index=pid)
+            print(f"wrote trace {args.trace} ({len(rec.events())} spans, "
+                  f"{rec.dropped} dropped) — load in chrome://tracing or "
+                  f"https://ui.perfetto.dev", file=sys.stderr)
+    if getattr(args, "metrics_json", None):
+        from hadoop_bam_tpu.obs import save_metrics_json
+        if metrics is None:
+            from hadoop_bam_tpu.utils.metrics import current_metrics
+            metrics = current_metrics()
+        save_metrics_json(metrics, args.metrics_json)
+        print(f"wrote metrics snapshot {args.metrics_json} "
+              f"(render/export it with `hbam metrics`)", file=sys.stderr)
+
+
+def _add_obs_flags(sub) -> None:
+    sub.add_argument("--trace", metavar="FILE", default=None,
+                     help="record stage spans (all pipeline stages, all "
+                          "pool threads) and write a Chrome trace-event "
+                          "JSON file loadable in chrome://tracing / "
+                          "Perfetto")
+    sub.add_argument("--metrics-json", metavar="FILE", default=None,
+                     help="write the run's full metrics snapshot "
+                          "(counters, timers, walls, histogram buckets) "
+                          "as JSON for `hbam metrics`")
+
+
+# ---------------------------------------------------------------------------
 # summarize
 # ---------------------------------------------------------------------------
 
 def cmd_summarize(args) -> int:
     from hadoop_bam_tpu.ops.flagstat import format_flagstat
     from hadoop_bam_tpu.parallel.distributed import distributed_flagstat
+    _start_obs(args)
     # plan-once + per-host shares + one allgather under jax.distributed;
     # identical to flagstat_file in a single-process run
     stats = distributed_flagstat(args.path)
     sys.stdout.write(format_flagstat(stats))
+    merged = None
+    from hadoop_bam_tpu.parallel.distributed import (
+        merge_metrics, process_count,
+    )
+    if args.metrics or args.metrics_json or process_count() > 1:
+        # mesh-wide merge: under jax.distributed every host reports the
+        # same job-level counters/histograms; single-process this is a
+        # plain copy of the local state.  Multi-host runs enter the
+        # merge UNCONDITIONALLY: it is a collective, and gating it on
+        # per-host CLI flags would deadlock the mesh if the flags ever
+        # diverged across hosts (the CL2xx lockstep rule, applied here)
+        merged = merge_metrics()
     if args.metrics:
-        from hadoop_bam_tpu.utils.metrics import METRICS
-        print("\n-- pipeline metrics --", file=sys.stderr)
-        print(METRICS.render(), file=sys.stderr)
+        print("\n-- pipeline metrics (mesh-merged) --", file=sys.stderr)
+        print(merged.render(), file=sys.stderr)
+    _finish_obs(args, metrics=merged)
     return 0
 
 
@@ -433,9 +495,12 @@ def cmd_query(args) -> int:
     from hadoop_bam_tpu.config import DEFAULT_CONFIG
     from hadoop_bam_tpu.query import QueryEngine, QueryRequest
 
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
     cfg = DEFAULT_CONFIG
     if args.deadline is not None:
         cfg = dataclasses.replace(cfg, query_deadline_s=args.deadline)
+    _start_obs(args)
     engine = QueryEngine(config=cfg)
     reqs = [QueryRequest(args.path, region) for region in args.regions]
     results = engine.query_records(reqs)
@@ -450,6 +515,42 @@ def cmd_query(args) -> int:
         print("-- query cache --", file=sys.stderr)
         for k in sorted(stats):
             print(f"{k}\t{stats[k]}", file=sys.stderr)
+        lat = METRICS.hist_summary("query.latency_s")
+        if lat:
+            print(f"latency_s\tp50={lat['p50']:.4g} p95={lat['p95']:.4g} "
+                  f"p99={lat['p99']:.4g} n={lat['count']}",
+                  file=sys.stderr)
+    _finish_obs(args)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# metrics (snapshot render / export)
+# ---------------------------------------------------------------------------
+
+def cmd_metrics(args) -> int:
+    """Render or re-export a metrics snapshot written by
+    ``--metrics-json`` (or by bench.py): human text, Prometheus text
+    exposition, or passthrough JSON.  Multiple snapshots merge with the
+    same semantics as the mesh-wide allgather (counter sums, histogram
+    bucket merges, wall maxima)."""
+    from hadoop_bam_tpu.obs import (
+        load_metrics_json, prometheus_text, render_metrics,
+    )
+    from hadoop_bam_tpu.utils.metrics import Metrics
+
+    merged = Metrics()
+    for path in args.files:
+        merged.merge_dict(load_metrics_json(path))
+    d = merged.to_dict()
+    if args.format == "prometheus":
+        sys.stdout.write(prometheus_text(d))
+    elif args.format == "json":
+        import json
+        json.dump(d, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        print(render_metrics(d))
     return 0
 
 
@@ -533,7 +634,9 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("summarize", help="distributed flagstat")
     s.add_argument("path")
     s.add_argument("--metrics", action="store_true",
-                   help="dump pipeline stage counters/timers to stderr")
+                   help="dump mesh-merged pipeline counters/timers/"
+                        "histograms to stderr")
+    _add_obs_flags(s)
     s.set_defaults(fn=cmd_summarize, uses_device=True)
 
     sq = sub.add_parser("seq-stats",
@@ -606,19 +709,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-batch deadline in seconds (blown deadlines "
                         "raise the retryable TransientIOError)")
     q.add_argument("--metrics", action="store_true",
-                   help="dump chunk-cache hit/miss stats to stderr")
+                   help="dump chunk-cache hit/miss stats and latency "
+                        "percentiles to stderr")
+    _add_obs_flags(q)
     q.set_defaults(fn=cmd_query, uses_device=True)
+
+    mt = sub.add_parser("metrics",
+                        help="render/merge metrics snapshots written by "
+                             "--metrics-json (text, Prometheus "
+                             "exposition, or JSON)")
+    mt.add_argument("files", nargs="+",
+                    help="snapshot JSON file(s); several merge like the "
+                         "mesh-wide allgather")
+    mt.add_argument("--format", choices=("text", "prometheus", "json"),
+                    default="text")
+    mt.set_defaults(fn=cmd_metrics, uses_device=False)
 
     ln = sub.add_parser("lint",
                         help="static analysis: trace safety (TS1xx), "
                              "collective lockstep (CL2xx), error taxonomy "
-                             "(ET3xx), layout contracts (LC4xx); exits "
+                             "(ET3xx), layout contracts (LC4xx), "
+                             "observability discipline (OB6xx); exits "
                              "non-zero on unsuppressed findings")
     ln.add_argument("--root", default=None,
                     help="package directory to analyze")
     ln.add_argument("--only", action="append", metavar="ANALYZER",
                     help="run one analyzer (trace_safety, lockstep, "
-                         "taxonomy, layout, feedpath, querycache); "
+                         "taxonomy, layout, feedpath, querycache, obs); "
                          "repeatable")
     ln.add_argument("--baseline", default=None,
                     help="baseline file (default analysis/baseline.json)")

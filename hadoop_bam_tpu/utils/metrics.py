@@ -1,17 +1,43 @@
-"""Stage metrics: counters + timers with a text dump.
+"""Stage metrics: counters, timers, histograms, spans — context-scoped.
 
 The reference exposed only Hadoop task counters and stderr warnings
-(SURVEY.md section 5); here every pipeline stage (plan/fetch/inflate/walk/
-device) ticks named counters and timers, dumpable as text — and
-``jax.profiler`` traces can be layered on via ``trace()``.
+(SURVEY.md section 5); here every pipeline stage (plan/fetch/inflate/
+walk/host_decode/pack/dispatch/kernel/combine, and the query engine's
+resolve/fetch/filter) ticks named counters and timers, records
+latency/size distributions, and emits structured spans:
+
+- ``count`` / ``timer``       flat counters + thread-summed work seconds
+- ``wall_timer``              wall-clock UNION spans (overlapping pool
+                              threads merge; see the docstring below)
+- ``observe``                 log-bucketed mergeable histograms
+                              (``obs/hist.py``) with p50/p95/p99
+- ``span``                    wall_timer + a trace-ring event when
+                              tracing is enabled (``obs/trace.py``) +
+                              a ``jax.profiler`` annotation when jax is
+                              active — Chrome-trace exportable
+- ``trace``                   timer + jax.profiler annotation (degrades
+                              to a plain timer on minimal installs)
+
+**Context scoping.**  ``METRICS`` is a PROXY: attribute access resolves
+to the contextvar-scoped current ``Metrics`` instance, falling back to
+the process-global default — so every historical ``METRICS.count(...)``
+call site keeps working unchanged, while ``MetricsContext`` gives a
+concurrent engine batch or bench row its own isolated, attributable
+numbers.  ``utils/pools.submit`` and the staging packer thread carry
+the context across threads (a bare ``ThreadPoolExecutor.submit`` would
+silently fall back to the global).
 """
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, Iterator
+from typing import Dict, Iterator, Optional
+
+from hadoop_bam_tpu.obs.hist import Histogram
+from hadoop_bam_tpu.obs.trace import active_recorder
 
 
 class Metrics:
@@ -22,7 +48,12 @@ class Metrics:
         self.timer_calls: Dict[str, int] = defaultdict(int)
         self.wall_timers: Dict[str, float] = defaultdict(float)
         self.wall_calls: Dict[str, int] = defaultdict(int)
+        self.histograms: Dict[str, Histogram] = {}
         self._wall_active: Dict[str, list] = {}
+        # bumped by reset(): a wall span that straddles a reset() must
+        # not account into (or corrupt) the post-reset state — the span
+        # captures the epoch at entry and discards itself on mismatch
+        self._epoch = 0
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -34,16 +65,36 @@ class Metrics:
         with self._lock:
             return self.counters.get(name, 0)
 
+    def observe(self, name: str, value: float, n: int = 1) -> None:
+        """Record ``value`` into the named log-bucketed histogram
+        (latencies in seconds, sizes in bytes — the name's suffix says
+        which: ``*_s`` / ``*_bytes``)."""
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram()
+            h.record(value, n)
+
+    def hist_summary(self, name: str) -> Dict[str, float]:
+        """count/mean/p50/p95/p99/max of one histogram ({} when absent)."""
+        with self._lock:
+            h = self.histograms.get(name)
+            return h.summary() if h is not None else {}
+
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         """Consistent copy of all counters/timers (one lock acquisition) —
         the hook quarantine/failure reports use to embed resilience counts
         (pipeline.bad_spans / transient_retries / corrupt_spans,
-        io.read_retries, chaos.injected_faults) without racing the pool."""
+        io.read_retries, chaos.injected_faults) without racing the pool.
+        Histograms are included as their p-summaries; ``to_dict`` carries
+        the full mergeable buckets."""
         with self._lock:
             return {"counters": dict(self.counters),
                     "timers": dict(self.timers),
                     "timer_calls": dict(self.timer_calls),
-                    "wall_timers": dict(self.wall_timers)}
+                    "wall_timers": dict(self.wall_timers),
+                    "histograms": {k: h.summary()
+                                   for k, h in self.histograms.items()}}
 
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:
@@ -66,6 +117,7 @@ class Metrics:
         invisible (the bench's stage_timer_note caveat)."""
         t0 = time.perf_counter()
         with self._lock:
+            epoch = self._epoch
             st = self._wall_active.setdefault(name, [0, t0])
             if st[0] == 0:
                 st[1] = t0
@@ -75,27 +127,120 @@ class Metrics:
         finally:
             t1 = time.perf_counter()
             with self._lock:
+                if self._epoch != epoch:
+                    return     # reset() raced this span: discard it
                 st = self._wall_active.get(name)
-                if st is None:      # reset() raced an active span
+                if st is None:
                     return
                 st[0] -= 1
                 if st[0] == 0:
                     self.wall_timers[name] += t1 - st[1]
                     self.wall_calls[name] += 1
 
-    def add_wall(self, name: str, seconds: float) -> None:
+    def add_wall(self, name: str, seconds: float,
+                 t0: Optional[float] = None,
+                 args: Optional[dict] = None) -> None:
         """Record an externally-measured wall span (the FeedPipeline's
-        packer/dispatch accounting measures its own intervals)."""
+        packer/dispatch accounting measures its own intervals).  When
+        tracing is enabled and the caller passes its ``perf_counter``
+        start ``t0``, the interval also lands in the trace ring."""
         with self._lock:
             self.wall_timers[name] += seconds
             self.wall_calls[name] += 1
+        if t0 is not None:
+            rec = active_recorder()
+            if rec is not None:
+                rec.complete(name, t0, seconds, args)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        """A STAGE SPAN: ``wall_timer`` aggregation plus, when tracing is
+        enabled (``obs.trace.enable_tracing``), one trace-ring event per
+        occurrence — name, thread, duration, and the keyword ``args``
+        (byte counts, record counts) — and a ``jax.profiler``
+        TraceAnnotation when jax is active.  Tracing disabled, this IS
+        ``wall_timer`` plus one module-global read (the bench's
+        ``obs_overhead_pct`` row pins the cost <2%)."""
+        rec = active_recorder()
+        if rec is None:
+            with self.wall_timer(name):
+                yield
+            return
+        ann = rec.annotation(name)
+        t0 = time.perf_counter()
+        try:
+            if ann is not None:
+                with ann, self.wall_timer(name):
+                    yield
+            else:
+                with self.wall_timer(name):
+                    yield
+        finally:
+            rec.complete(name, t0, time.perf_counter() - t0, args or None)
 
     @contextlib.contextmanager
     def trace(self, name: str) -> Iterator[None]:
-        """Timer + jax.profiler annotation (shows up in TPU traces)."""
-        import jax.profiler
-        with jax.profiler.TraceAnnotation(name), self.timer(name):
-            yield
+        """Timer + jax.profiler annotation (shows up in TPU traces).
+
+        The profiler import is guarded: on a minimal install without
+        jax (or with a jax lacking the profiler module) this degrades
+        to the plain ``timer`` instead of raising ImportError from a
+        hot loop."""
+        try:
+            from jax.profiler import TraceAnnotation
+            ann = TraceAnnotation(name)
+        except Exception:  # noqa: BLE001 — profiling is optional
+            ann = None
+        if ann is None:
+            with self.timer(name):
+                yield
+        else:
+            with ann, self.timer(name):
+                yield
+
+    # -- mesh-wide merge (parallel/distributed.merge_metrics) ----------------
+
+    def to_dict(self) -> Dict[str, object]:
+        """Full mergeable state (histograms as buckets, not summaries) —
+        the allgather payload of ``merge_metrics``."""
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "timers": dict(self.timers),
+                    "timer_calls": dict(self.timer_calls),
+                    "wall_timers": dict(self.wall_timers),
+                    "wall_calls": dict(self.wall_calls),
+                    "histograms": {k: h.to_dict()
+                                   for k, h in self.histograms.items()}}
+
+    def merge_dict(self, d: Dict[str, object]) -> None:
+        """Merge one host's ``to_dict`` payload into this instance:
+        counters/timers SUM (work adds across hosts), histograms merge
+        by bucket addition (associative), and wall spans take the MAX
+        across hosts — each host's value is already its local union, and
+        hosts run concurrently, so the mesh-wide wall is bounded by the
+        slowest host, not the sum."""
+        with self._lock:
+            for k, v in dict(d.get("counters", {})).items():
+                self.counters[k] += int(v)
+            for k, v in dict(d.get("timers", {})).items():
+                self.timers[k] += float(v)
+            for k, v in dict(d.get("timer_calls", {})).items():
+                self.timer_calls[k] += int(v)
+            for k, v in dict(d.get("wall_timers", {})).items():
+                self.wall_timers[k] = max(self.wall_timers[k], float(v))
+            for k, v in dict(d.get("wall_calls", {})).items():
+                self.wall_calls[k] = max(self.wall_calls[k], int(v))
+            for k, hd in dict(d.get("histograms", {})).items():
+                h = self.histograms.get(k)
+                if h is None:
+                    h = self.histograms[k] = Histogram()
+                h.merge(Histogram.from_dict(hd))
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "Metrics":
+        m = cls()
+        m.merge_dict(d)
+        return m
 
     def render(self) -> str:
         lines = []
@@ -109,16 +254,123 @@ class Metrics:
         for k in sorted(self.wall_timers):
             lines.append(f"wall    {k} = {self.wall_timers[k]:.4f}s over "
                          f"{self.wall_calls[k]} span(s)")
+        for k in sorted(self.histograms):
+            s = self.histograms[k].summary()
+            lines.append(
+                f"hist    {k} = n={s['count']} mean={s['mean']:.4g} "
+                f"p50={s['p50']:.4g} p95={s['p95']:.4g} "
+                f"p99={s['p99']:.4g} max={s['max']:.4g}")
         return "\n".join(lines)
 
     def reset(self) -> None:
         with self._lock:
+            self._epoch += 1
             self.counters.clear()
             self.timers.clear()
             self.timer_calls.clear()
             self.wall_timers.clear()
             self.wall_calls.clear()
+            self.histograms.clear()
             self._wall_active.clear()
 
 
-METRICS = Metrics()
+class NullMetrics(Metrics):
+    """Every recording surface a no-op: the bench's ``obs_overhead_pct``
+    row runs flagstat under this to measure what the always-on
+    instrumentation itself costs (spans, counters, histogram ticks —
+    tracing disabled)."""
+
+    def count(self, name: str, n: int = 1) -> None:
+        pass
+
+    def observe(self, name: str, value: float, n: int = 1) -> None:
+        pass
+
+    def add_wall(self, name: str, seconds: float,
+                 t0: Optional[float] = None,
+                 args: Optional[dict] = None) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        yield
+
+    @contextlib.contextmanager
+    def wall_timer(self, name: str) -> Iterator[None]:
+        yield
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        yield
+
+    @contextlib.contextmanager
+    def trace(self, name: str) -> Iterator[None]:
+        yield
+
+
+# ---------------------------------------------------------------------------
+# context scoping: METRICS is a proxy over the contextvar-scoped instance
+# ---------------------------------------------------------------------------
+
+_BASE = Metrics()
+_CURRENT: "contextvars.ContextVar[Optional[Metrics]]" = \
+    contextvars.ContextVar("hbam_metrics", default=None)
+
+
+def current_metrics() -> Metrics:
+    """The Metrics instance this context records into: the innermost
+    active ``MetricsContext``, else the process-global default."""
+    m = _CURRENT.get()
+    return m if m is not None else _BASE
+
+
+def base_metrics() -> Metrics:
+    """The process-global default instance (what ``METRICS`` resolves to
+    outside any ``MetricsContext``)."""
+    return _BASE
+
+
+class MetricsContext:
+    """Run-scoped isolation: everything recorded inside the ``with``
+    block — including work handed to the shared decode pool via
+    ``utils.pools.submit`` and the staging packer thread — lands in this
+    context's own ``Metrics`` instead of the process global, so two
+    concurrent engine batches (or bench rows) get separately
+    attributable numbers::
+
+        with MetricsContext() as m:
+            engine.query_records(batch)
+        print(m.hist_summary("query.latency_s"))
+
+    Re-entrant and nestable; pass an existing instance (e.g.
+    ``NullMetrics()``) to substitute rather than isolate."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._token: Optional[contextvars.Token] = None
+
+    def __enter__(self) -> Metrics:
+        self._token = _CURRENT.set(self.metrics)
+        return self.metrics
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+
+
+class _MetricsProxy:
+    """Attribute access forwards to ``current_metrics()`` — the shim
+    that context-scopes every historical ``METRICS.x`` call site without
+    touching it."""
+
+    __slots__ = ()
+
+    def __getattr__(self, name: str):
+        return getattr(current_metrics(), name)
+
+    def __repr__(self) -> str:
+        return f"<METRICS proxy -> {current_metrics()!r}>"
+
+
+METRICS = _MetricsProxy()

@@ -15,8 +15,10 @@ configs get the existing pool (one process, one pool, by design).
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextvars
 import os
 import threading
+import time
 from typing import Optional, Tuple
 
 _LOCK = threading.Lock()
@@ -52,6 +54,38 @@ def decode_pool_size(config=None) -> int:
     what the drivers size their prefetch windows from."""
     decode_pool(config)
     return _POOL_SIZE
+
+
+def _timed_task(fn, t_submit: float, args, kwargs):
+    from hadoop_bam_tpu.utils.metrics import current_metrics
+
+    m = current_metrics()
+    t0 = time.perf_counter()
+    # queue wait + run durations as log-bucketed histograms: the pool is
+    # SHARED across drivers, so p95 task_wait is the direct saturation
+    # signal (a deep wait distribution means the pool, not the device,
+    # is the bottleneck) — a flat timer cannot show that
+    m.observe("pool.task_wait_s", t0 - t_submit)
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        m.observe("pool.task_run_s", time.perf_counter() - t0)
+
+
+def submit(pool: cf.ThreadPoolExecutor, fn, *args, **kwargs) -> cf.Future:
+    """Context-carrying, histogram-instrumented submit — what every
+    decode-path call site uses instead of bare ``pool.submit``:
+
+    - the submitter's ``contextvars`` context rides along, so work done
+      on a pool thread records into the submitter's ``MetricsContext``
+      (a bare submit silently falls back to the process-global Metrics
+      and two concurrent engine batches smear into each other);
+    - per-task queue-wait and run durations land in the
+      ``pool.task_wait_s`` / ``pool.task_run_s`` histograms.
+    """
+    ctx = contextvars.copy_context()
+    t_submit = time.perf_counter()
+    return pool.submit(ctx.run, _timed_task, fn, t_submit, args, kwargs)
 
 
 def set_decode_pool(pool: Optional[cf.ThreadPoolExecutor],

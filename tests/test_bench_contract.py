@@ -29,6 +29,7 @@ def _fill_state(bench, n_notes=6):
         ("vcf_variants_per_sec", 507001.2, "variants/s", 1.5),
         ("bcf_variants_per_sec", 612345.7, "variants/s", 1.21),
         ("region_query_queries_per_sec", 41.7, "queries/s", 2.4),
+        ("obs_overhead_pct", 1.3, "%", None),
         ("fastq_reads_per_sec", 188001.0, "reads/s", 2.37),
         ("bam_write_records_per_sec", 301222.5, "records/s", 2.1),
         ("deflate_tokenize_gbps", 0.41, "GB/s", 0.8),
@@ -52,7 +53,10 @@ def _fill_state(bench, n_notes=6):
                 "dosage_pack_wall": 0.12, "dispatch_wall": 0.18}
         if m == "region_query_queries_per_sec":
             row.update(cold_queries_per_sec=17.1, cache_hit_rate=0.93,
-                       regions=250, records_matched=2_551_000)
+                       regions=250, records_matched=2_551_000,
+                       latency_p50_ms=19.2, latency_p99_ms=88.4)
+        if m == "obs_overhead_pct":
+            row.update(instrumented_s=0.1301, null_s=0.1284)
         comps.append(row)
     comps.append({"metric": "broken_row", "error": "RuntimeError: boom"})
     comps.append({"metric": "late_row", "skipped": "deadline"})
@@ -97,6 +101,10 @@ def test_final_line_fits_budget_and_parses(bench):
     assert out["components"]["bcf_variants_per_sec"] == 612345.7
     assert out["components"]["broken_row"] == "error"
     assert out["components"]["late_row"] == "skipped"
+    # r9: the obs overhead row rides the compact matrix, and the warm
+    # region-query [p50_ms, p99_ms] pair rides as the latency component
+    assert out["components"]["obs_overhead_pct"] == 1.3
+    assert out["latency"] == [19.2, 88.4]
     # scaling compressed to [n_dev, flagstat rec/s] pairs, sorted
     assert out["scaling"][0] == [1, 862000.0]
     assert [r[0] for r in out["scaling"]] == [1, 2, 4, 8]
@@ -124,10 +132,31 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     rq = by_metric["region_query_queries_per_sec"]
     assert 0.0 <= rq["cache_hit_rate"] <= 1.0
     assert rq["regions"] >= 200
+    # r9: warm-pass latency percentiles from the query.latency_s
+    # histogram ride the full region-query row
+    assert rq["latency_p99_ms"] >= rq["latency_p50_ms"] > 0
+    ov = by_metric["obs_overhead_pct"]
+    assert ov["instrumented_s"] > 0 and ov["null_s"] > 0
     line = json.dumps(bench._compact_snapshot(full))
     assert len(line) <= bench.FINAL_LINE_BUDGET
     assert json.loads(line)["components"][
         "region_query_queries_per_sec"] == 41.7
+
+
+def test_latency_component_dropped_before_components(bench):
+    """Budget pressure sheds notes, then latency, then scaling —
+    components (the driver-parsed matrix) go last."""
+    _fill_state(bench, n_notes=0)
+    full = bench._snapshot("ok")
+    out = bench._compact_snapshot(full)
+    assert "latency" in out
+    # a region-query row without the percentile fields (old artifacts,
+    # error rows) must simply omit the component, not crash
+    for c in full["components"]:
+        c.pop("latency_p50_ms", None)
+    out2 = bench._compact_snapshot(full)
+    assert "latency" not in out2
+    assert len(json.dumps(out2)) <= bench.FINAL_LINE_BUDGET
 
 
 def test_scaling_rows_pin_feed_overlap_fields(bench):

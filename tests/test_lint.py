@@ -408,6 +408,106 @@ def test_qe_outside_query_not_scoped():
 
 
 # ---------------------------------------------------------------------------
+# observability discipline (OB6xx)
+# ---------------------------------------------------------------------------
+
+_OB_RAW_CLOCK = '''
+import time
+
+def decode_stage(spans):
+    t0 = time.perf_counter()     # OB601: interval never reaches Metrics
+    out = [s * 2 for s in spans]
+    dt = time.perf_counter() - t0
+    print("stage took", dt)
+    return out
+'''
+
+_OB_CLOCK_FEEDS_METRICS = '''
+import time
+from hadoop_bam_tpu.utils.metrics import METRICS
+
+def dispatch(arrays, do):
+    t0 = time.perf_counter()
+    out = do(arrays)
+    METRICS.add_wall("pipeline.dispatch_wall", time.perf_counter() - t0)
+    return out
+'''
+
+_OB_POOLED_TIMER = '''
+from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.parallel.pipeline import _iter_windowed
+
+def driver(pool, spans, work):
+    def decode(span):
+        with METRICS.timer("fmt.host_decode"):   # OB602: pool tasks
+            return work(span)                    # overlap; thread-sum
+    return list(_iter_windowed(pool, spans, decode, 8))
+'''
+
+_OB_POOLED_TIMER_WITH_WALL = '''
+from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.parallel.pipeline import _iter_windowed
+
+def driver(pool, spans, work):
+    def decode(span):
+        with METRICS.timer("fmt.host_decode"), \\
+                METRICS.wall_timer("fmt.host_decode_wall"):
+            return work(span)
+    return list(_iter_windowed(pool, spans, decode, 8))
+'''
+
+_OB_POOLED_SPAN = '''
+from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.parallel.pipeline import _iter_windowed
+
+def driver(pool, spans, work):
+    def decode(span):
+        with METRICS.span("fmt.host_decode_wall"):
+            return work(span)
+    return list(_iter_windowed(pool, spans, decode, 8))
+'''
+
+
+def test_ob_raw_clock_seeded_violation_fires():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/bad_clock.py": _OB_RAW_CLOCK},
+        only=["obs"])
+    assert rules_of(findings) == {"OB601"}
+    assert len(findings) == 2        # both perf_counter calls
+    assert all(f.severity == "error" for f in findings)
+    assert "Metrics" in findings[0].message
+
+
+def test_ob_clock_feeding_metrics_passes():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/ok_clock.py": _OB_CLOCK_FEEDS_METRICS},
+        only=["obs"])
+    assert findings == []
+
+
+def test_ob_timer_in_pooled_decode_fires():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/query/bad_timer.py": _OB_POOLED_TIMER},
+        only=["obs"])
+    assert rules_of(findings) == {"OB602"}
+    assert "wall_timer" in findings[0].message
+
+
+def test_ob_pooled_timer_with_wall_or_span_passes():
+    for src in (_OB_POOLED_TIMER_WITH_WALL, _OB_POOLED_SPAN):
+        findings = lint_sources(
+            {"hadoop_bam_tpu/query/ok_timer.py": src}, only=["obs"])
+        assert findings == []
+
+
+def test_ob_outside_hot_paths_not_scoped():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/formats/elsewhere.py": _OB_RAW_CLOCK},
+        only=["obs"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip / suppression
 # ---------------------------------------------------------------------------
 
