@@ -10,6 +10,10 @@ Five AST analyzers over correctness regimes generic linters cannot see:
 - ``feedpath``     (PF5xx) — fresh per-group device-tile allocations in
   the feed paths (group buffers belong to ``parallel/staging.py``'s
   rings; the memset tax scales with device count)
+- ``decodepath``   (DP7xx) — full-buffer ``.tobytes()`` /
+  ``np.frombuffer(...).copy()`` materializations of inflated spans on
+  the decode hot path (every extra sweep is a DRAM pass the fused
+  decode exists to remove)
 
 Findings carry file:line, rule id and severity; ``analysis/baseline.json``
 suppresses accepted legacy findings so CI fails only on regressions.

@@ -158,8 +158,8 @@ def analyzers() -> Dict[str, Analyzer]:
     """Name -> analyzer map (importing the analyzer modules on demand)."""
     # import for registration side effects
     from hadoop_bam_tpu.analysis import (  # noqa: F401
-        feedpath, layout, lockstep, obsrules, querycache, taxonomy,
-        trace_safety,
+        decodepath, feedpath, layout, lockstep, obsrules, querycache,
+        taxonomy, trace_safety,
     )
     return dict(_REGISTRY)
 
@@ -256,15 +256,16 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                     "collective lockstep (CL2xx), error taxonomy (ET3xx), "
                     "binary-layout contracts (LC4xx), feed-path "
                     "allocation discipline (PF5xx), query-cache key "
-                    "identity (QE5xx), observability discipline (OB6xx)")
+                    "identity (QE5xx), observability discipline (OB6xx), "
+                    "decode-path copy discipline (DP7xx)")
     p.add_argument("--root", default=None,
                    help="package directory to analyze (default: the "
                         "installed hadoop_bam_tpu package)")
     p.add_argument("--only", action="append", default=None,
                    metavar="ANALYZER",
                    help="run one analyzer (trace_safety, lockstep, "
-                        "taxonomy, layout, feedpath, querycache, obs); "
-                        "repeatable")
+                        "taxonomy, layout, feedpath, querycache, obs, "
+                        "decodepath); repeatable")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline file (default: analysis/baseline.json)")
     p.add_argument("--no-baseline", action="store_true",

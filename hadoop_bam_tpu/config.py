@@ -82,6 +82,10 @@ _HADOOP_KEY_MAP = {
     "hbam.feed-ring-slots": "feed_ring_slots",
     "hbam.feed-dispatch-depth": "feed_dispatch_depth",
     "hbam.decode-pool-workers": "decode_pool_workers",
+    # fused host decode knobs (ops/inflate.py FusedSpanDecode; the
+    # reference's analog was per-block zlib-over-JNI with no fusion)
+    "hbam.use-fused-decode": "use_fused_decode",
+    "hbam.decode-chunk-blocks": "decode_chunk_blocks",
     # region-query serving knobs (query/; no reference analog — Hadoop-BAM
     # only ever trimmed scan plans with intervals, it never served them)
     "hbam.query-cache-bytes": "query_cache_bytes",
@@ -167,6 +171,17 @@ class HBamConfig:
     #                                  None = min(32, max(4, 4*cpus)).
     #                                  First driver call in the process
     #                                  sizes the pool (utils/pools.py)
+    use_fused_decode: bool = True    # single-pass native inflate+walk+pack
+    #                                  (+CRC fold) per span, chunk-streamed
+    #                                  into the staging ring; falls back to
+    #                                  the two-pass oracle path when the
+    #                                  native library is unavailable
+    decode_chunk_blocks: int = 32    # BGZF blocks per fused decode chunk
+    #                                  (~2 MiB inflated: big enough to
+    #                                  amortize the walk handoff, small
+    #                                  enough to stay cache-resident and
+    #                                  stream tiles before the span tail
+    #                                  inflates)
 
     # --- region-query serving (query/) ---
     query_cache_bytes: int = 256 << 20  # decoded-chunk LRU byte budget
@@ -213,7 +228,7 @@ def _coerce(kwargs: dict) -> dict:
             out[k] = BaseQualityEncoding.parse(out[k], default)
     for k in ("trust_exts", "vcf_trust_exts", "fastq_filter_failed_qc",
               "qseq_filter_failed_qc", "write_header", "write_terminator",
-              "use_splitting_index", "use_native",
+              "use_splitting_index", "use_native", "use_fused_decode",
               "keep_paired_reads_together", "skip_bad_spans",
               "debug_keep_spill"):
         if k in out and isinstance(out[k], str):
@@ -225,6 +240,7 @@ def _coerce(kwargs: dict) -> dict:
             out[k] = float(out[k])
     for k in ("span_retries", "io_read_retries", "feed_ring_slots",
               "feed_dispatch_depth", "decode_pool_workers",
+              "decode_chunk_blocks",
               "query_cache_bytes", "query_chunk_bytes",
               "query_tile_records", "query_max_in_flight",
               "query_queue_depth"):

@@ -20,7 +20,7 @@ offsets.
 from __future__ import annotations
 
 import zlib
-from typing import List, Optional, Sequence, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -73,14 +73,23 @@ def inflate_span(raw: bytes, table: Optional[dict] = None,
     if backend == "auto":
         backend = "native" if native.available() else "zlib"
     if backend == "native":
-        native.inflate_batch(src, table["cdata_off"], table["cdata_len"],
-                             dst, ubase[:-1], isize, n_threads)
+        try:
+            native.inflate_batch(src, table["cdata_off"],
+                                 table["cdata_len"], dst, ubase[:-1],
+                                 isize, n_threads)
+        except ValueError as e:
+            # same class as the zlib backend and the fused path: bad
+            # DEFLATE bytes are a BGZF-level corruption either way
+            raise bgzf.BGZFError(str(e)) from e
     elif backend == "zlib":
         mv = memoryview(raw)
         for i in range(isize.size):
             o, l = int(table["cdata_off"][i]), int(table["cdata_len"][i])
             try:
-                out = zlib.decompress(bytes(mv[o:o + l]), wbits=-15)
+                # decompress straight off the memoryview slice — the old
+                # bytes(mv[...]) copy doubled this backend's allocation
+                # traffic (one copy per block before zlib even ran)
+                out = zlib.decompress(mv[o:o + l], wbits=-15)
             except zlib.error as e:
                 # classified at the policy boundary: bad DEFLATE bytes are
                 # deterministic corruption, not a retryable read fault
@@ -97,18 +106,23 @@ def inflate_span(raw: bytes, table: Optional[dict] = None,
     return dst, ubase[:-1]
 
 
+def footer_crcs(src: np.ndarray, table: dict) -> np.ndarray:
+    """Each block's expected CRC32, read from the BGZF footers (the CRC
+    sits 8 bytes before each block end)."""
+    foot = table["cdata_off"] + table["cdata_len"]
+    return (src[foot].astype(np.uint32)
+            | (src[foot + 1].astype(np.uint32) << 8)
+            | (src[foot + 2].astype(np.uint32) << 16)
+            | (src[foot + 3].astype(np.uint32) << 24))
+
+
 def verify_crcs(raw: bytes, table: dict, data: np.ndarray,
                 ubase: np.ndarray, n_threads: int = 0) -> None:
     """Validate every block's CRC32 footer against the inflated bytes
     (native batched CRC when available)."""
     n = table["isize"].size
     src = np.frombuffer(raw, dtype=np.uint8)
-    # footer CRC sits 8 bytes before each block end
-    foot = table["cdata_off"] + table["cdata_len"]
-    expect = (src[foot].astype(np.uint32)
-              | (src[foot + 1].astype(np.uint32) << 8)
-              | (src[foot + 2].astype(np.uint32) << 16)
-              | (src[foot + 3].astype(np.uint32) << 24))
+    expect = footer_crcs(src, table)
     if native.available():
         import ctypes
         lib = native.load()
@@ -141,8 +155,161 @@ def walk_records(data: np.ndarray, start: int = 0,
     if native.available():
         return native.walk_bam_records(np.ascontiguousarray(data), start, cap)
     from hadoop_bam_tpu.formats.bam import walk_record_offsets
-    offs = walk_record_offsets(data.tobytes(), start=start)
+    # walk straight over the array's buffer — the old data.tobytes() here
+    # duplicated the whole inflated span per walk (DP701's founding case)
+    offs = walk_record_offsets(np.ascontiguousarray(data), start=start)
     tail = int(offs[-1] + 4 + int.from_bytes(
         data[int(offs[-1]):int(offs[-1]) + 4].tobytes(), "little", signed=True)
         ) if offs.size else start
     return offs, tail
+
+
+# ---------------------------------------------------------------------------
+# Fused single-pass span decode (native/hbam_native.cpp hbam_fused_*)
+# ---------------------------------------------------------------------------
+
+def fused_available() -> bool:
+    """True when the native fused decode entry points are loadable."""
+    return native.available() and native.fused_available()
+
+
+def _raise_fused_error(rc: int, index: int) -> None:
+    """Map a fused-decode rc to the same exception CLASS the two-pass
+    path raises for the identical corruption (the fuzz tests pin this):
+    BGZF-level faults -> BGZFError, record-chain faults -> the CORRUPT
+    taxonomy (the two-pass walkers' bare ValueError classifies the same
+    way through classify_error)."""
+    from hadoop_bam_tpu.utils.errors import CorruptDataError
+
+    kind = -rc
+    if kind == 1:
+        raise bgzf.BGZFError(f"corrupt DEFLATE payload in block {index}")
+    if kind == 2:
+        raise bgzf.BGZFError(f"ISIZE mismatch in block {index}")
+    if kind == 3:
+        raise bgzf.BGZFError(f"CRC32 mismatch in block(s) [{index}]")
+    if kind == 5:
+        raise CorruptDataError(
+            f"record count exceeds capacity at offset {index}")
+    raise CorruptDataError("malformed BAM record chain")
+
+
+class FusedSpanDecode:
+    """One span's fused native inflate + walk + pack (+ CRC fold) job.
+
+    Wraps ``utils/native.FusedJob`` with the span-level geometry: builds
+    the inflated-offset table, sizes the packed outputs for the worst
+    case, and exposes the decode as a stream of completed row chunks::
+
+        dec = FusedSpanDecode(raw, table, start=s, stop=e, mode="rows",
+                              sel=ranges, row_stride=w, check_crc=True)
+        for lo, hi in dec.chunks():
+            consume(dec.rows[lo:hi])          # packed while cache-hot
+        n, tail = dec.finish()
+
+    ``chunks()`` yields ``[row_lo, row_hi)`` ranges the moment the native
+    walk publishes them — downstream tile packing starts before the
+    span's tail blocks are even inflated.  After ``finish()``:
+    ``data`` holds the fully inflated span, ``offsets[:n]`` the record
+    starts, and the mode-specific outputs (``rows`` / ``prefix``+
+    ``seq``+``qual``) their packed tiles.  Corruption raises the same
+    ``BGZFError``/``ValueError`` the two-pass path raises; closing the
+    stream early (generator abandoned) joins the native workers.
+
+    Modes: ``"offsets"`` (walk only — callers packing variable-length
+    series themselves), ``"rows"`` (fixed-prefix ``sel`` ranges packed
+    into ``row_stride``-byte rows), ``"payload"`` (prefix/seq/qual tiles,
+    ``hbam_walk_bam_payload`` layout)."""
+
+    def __init__(self, raw: bytes, table: Optional[dict] = None, *,
+                 start: int = 0, stop: Optional[int] = None,
+                 mode: str = "offsets",
+                 sel: Optional[Sequence[Tuple[int, int]]] = None,
+                 row_stride: int = 0, max_len: int = 0, seq_stride: int = 0,
+                 qual_stride: int = 0, check_crc: bool = False,
+                 chunk_blocks: int = 32, n_threads: int = 0):
+        if table is None:
+            table = block_table(raw)
+        isize = table["isize"]
+        ubase = np.zeros(isize.size + 1, dtype=np.int64)
+        np.cumsum(isize, out=ubase[1:])
+        total = int(ubase[-1])
+        self.data = np.empty(total, dtype=np.uint8)
+        self.ubase = ubase[:-1]
+        self.stop = total if stop is None else min(int(stop), total)
+        self.rows = self.prefix = self.seq = self.qual = None
+        src = np.frombuffer(raw, dtype=np.uint8)
+        expect = footer_crcs(src, table) if check_crc else None
+        cap = max(16, (self.stop - start) // 36 + 1)
+        self.offsets = np.empty(cap, dtype=np.int64)
+        mode_id = {"offsets": native.FUSED_OFFSETS,
+                   "rows": native.FUSED_ROWS,
+                   "payload": native.FUSED_PAYLOAD}[mode]
+        sel_off = sel_len = out_rows = out_seq = out_qual = None
+        if mode == "rows":
+            sel_off = np.asarray([o for o, _ in sel], dtype=np.int32)
+            sel_len = np.asarray([l for _, l in sel], dtype=np.int32)
+            self.rows = out_rows = np.empty((cap, row_stride),
+                                            dtype=np.uint8)
+        elif mode == "payload":
+            # zeroed like the two-pass wrappers: the C side writes only
+            # each row's payload bytes, padding stays zero
+            self.prefix = out_rows = np.zeros((cap, 36), dtype=np.uint8)
+            self.seq = out_seq = np.zeros((cap, seq_stride), dtype=np.uint8)
+            self.qual = out_qual = np.zeros((cap, qual_stride),
+                                            dtype=np.uint8)
+        self.n_blocks = int(isize.size)
+        if self.n_blocks == 0:
+            self._job = None
+            self.n_rows, self.tail = 0, int(start)
+            return
+        self._job = native.FusedJob(
+            src, table["cdata_off"], table["cdata_len"], isize, expect,
+            self.data, self.ubase, start, self.stop, mode_id, sel_off,
+            sel_len, row_stride, out_rows, out_seq, out_qual, max_len,
+            seq_stride, qual_stride, self.offsets, chunk_blocks, n_threads)
+        self.n_rows: Optional[int] = None
+        self.tail: Optional[int] = None
+
+    def chunks(self) -> "Iterator[Tuple[int, int]]":
+        """Yield ``(row_lo, row_hi)`` as the native walk completes them;
+        raises on corruption.  Always drives the job to completion unless
+        the generator is closed early (which cancels + joins)."""
+        if self._job is None:
+            return
+        try:
+            while True:
+                c = self._job.next_chunk()
+                if c is None:
+                    if self._job.rc < 0:
+                        _raise_fused_error(self._job.rc,
+                                           self._job.err_index)
+                    return
+                yield c
+        finally:
+            # abandoned mid-stream (early generator close): join workers
+            # so no native thread outlives its span's buffers
+            if self.n_rows is None:
+                self.finish(check=False)
+
+    def finish(self, check: bool = True) -> Tuple[int, int]:
+        """Join the job; returns (n_rows, tail).  ``check=False`` skips
+        raising (the cancellation path)."""
+        if self._job is not None:
+            rc = self._job.finish()
+            self.n_rows, self.tail = self._job.n_rows, self._job.tail
+            idx = self._job.err_index
+            self._job = None
+            if check and rc < 0:
+                _raise_fused_error(rc, idx)
+        return self.n_rows, self.tail
+
+    @property
+    def err_index(self) -> int:
+        return -1 if self._job is None else self._job.err_index
+
+    def run(self) -> Tuple[int, int]:
+        """Non-streamed convenience: drain every chunk, then finish."""
+        for _ in self.chunks():
+            pass
+        return self.finish()

@@ -21,6 +21,7 @@ import dataclasses
 import functools
 import logging
 import os
+import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -116,6 +117,29 @@ class HostSpanBatch:
     voffsets: List[np.ndarray]  # per-device per-record virtual offsets
 
 
+def _fetch_span_raw(src, span: FileVirtualSpan) -> Tuple[bytes, int, int]:
+    """Fetch one span's compressed bytes: the whole blocks in
+    [start_c, end_c) plus the block AT end_c when the span ends inside it
+    (end_u > 0) — reading it up front folds it into one batched-inflate
+    call instead of a per-block Python zlib + whole-buffer concatenate
+    afterwards.  Returns (raw, end_block_size, next_c) where ``next_c`` is
+    the compressed offset of the first block past the fetched bytes."""
+    from hadoop_bam_tpu.formats import bgzf
+
+    start_c, start_u = span.start
+    end_c, end_u = span.end
+    with METRICS.span("bam.fetch_wall", nbytes=max(end_c - start_c, 0)):
+        raw = src.pread(start_c, max(end_c - start_c, 0))
+        end_block_size = 0
+        if end_u > 0 and end_c < src.size:
+            head = src.pread(end_c, bgzf.MAX_BLOCK_SIZE)
+            info = bgzf.parse_block_header(head, 0)
+            end_block_size = info.block_size
+            raw = raw + head[:end_block_size]
+    next_c = (end_c + end_block_size) if raw else start_c
+    return raw, end_block_size, next_c
+
+
 def _decode_span_core(source, span: FileVirtualSpan,
                       check_crc: bool = False,
                       inflate_backend: str = "auto",
@@ -130,6 +154,11 @@ def _decode_span_core(source, span: FileVirtualSpan,
     records *starting* inside the span are owned (reference reader
     contract); the final record may extend into the following blocks, which
     are fetched as needed.
+
+    This is the TWO-PASS path (inflate the whole span to DRAM, then walk
+    it again) — the byte-identity oracle the fused single-pass path
+    (``_decode_span_fused``) is pinned against, and the fallback when the
+    native library is unavailable or ``config.use_fused_decode`` is off.
     """
     from hadoop_bam_tpu.formats import bgzf
 
@@ -138,18 +167,7 @@ def _decode_span_core(source, span: FileVirtualSpan,
     end_c, end_u = span.end
     METRICS.count("pipeline.spans")
 
-    # 1. Batched inflate of the whole blocks in [start_c, end_c) — plus the
-    #    block AT end_c when the span ends inside it (end_u > 0): reading it
-    #    up front folds it into the one native batched-inflate call instead
-    #    of a per-block Python zlib + whole-buffer concatenate afterwards.
-    with METRICS.span("bam.fetch_wall", nbytes=max(end_c - start_c, 0)):
-        raw = src.pread(start_c, max(end_c - start_c, 0))
-        end_block_size = 0
-        if end_u > 0 and end_c < src.size:
-            head = src.pread(end_c, bgzf.MAX_BLOCK_SIZE)
-            info = bgzf.parse_block_header(head, 0)
-            end_block_size = info.block_size
-            raw = raw + head[:end_block_size]
+    raw, end_block_size, next_c = _fetch_span_raw(src, span)
     if raw:
         table = inflate_ops.block_table(raw)
         with METRICS.timer("pipeline.inflate"), \
@@ -159,14 +177,15 @@ def _decode_span_core(source, span: FileVirtualSpan,
         METRICS.count("pipeline.blocks", int(table["isize"].size))
         METRICS.count("pipeline.inflated_bytes", int(data.size))
         if check_crc:
-            inflate_ops.verify_crcs(raw, table, data, ubase)
+            # a separate third sweep over the inflated bytes — the fused
+            # path folds this into its single visit for ~free
+            with METRICS.timer("pipeline.crc"):
+                inflate_ops.verify_crcs(raw, table, data, ubase)
         abs_coffs = table["coffset"] + start_c
-        next_c = end_c
     else:
         data = np.empty(0, dtype=np.uint8)
         ubase = np.empty(0, dtype=np.int64)
         abs_coffs = np.empty(0, dtype=np.int64)
-        next_c = start_c
 
     def extend_past(tail: int) -> None:
         """Fetch + inflate the following blocks until the record starting
@@ -222,7 +241,6 @@ def _decode_span_core(source, span: FileVirtualSpan,
     #    records owned by this span.
     if end_block_size:
         end_inflated = int(ubase[-1]) + end_u
-        next_c = end_c + end_block_size
     else:
         end_inflated = data.size
 
@@ -263,16 +281,290 @@ def _decode_span_core(source, span: FileVirtualSpan,
     return data, offs, voffs, rows
 
 
+# ---------------------------------------------------------------------------
+# Fused single-pass span decode (native hbam_fused_*: ops/inflate.py
+# FusedSpanDecode).  One streamed native pass replaces the two-pass path's
+# three DRAM sweeps (inflate -> walk re-read -> optional CRC sweep): each
+# native worker inflates a run of decode_chunk_blocks BGZF blocks and the
+# record walk + projection pack + CRC fold consume those bytes cache-hot.
+# The two-pass _decode_span_core stays as the byte-identity oracle and the
+# automatic fallback (no native library, non-native backends,
+# config.use_fused_decode=False, and the rare cut-final-record span).
+# ---------------------------------------------------------------------------
+
+def _use_fused(config: Optional[HBamConfig],
+               inflate_backend: str = "auto") -> bool:
+    """Fused-path eligibility: the config knob (default on), a native
+    backend choice, and the fused entry points actually loadable."""
+    cfg = config if config is not None else DEFAULT_CONFIG
+    return (bool(getattr(cfg, "use_fused_decode", True))
+            and inflate_backend in ("auto", "native")
+            and inflate_ops.fused_available())
+
+
+def _close_stream(item) -> None:
+    """_iter_windowed cleanup hook: join a fused chunk stream's native
+    workers; buffered results (plain arrays/tuples) need nothing."""
+    close = getattr(item, "close", None)
+    if close is not None:
+        close()
+
+
+def _fused_stream_gate(config: Optional[HBamConfig], intervals) -> bool:
+    """Chunk-streaming eligibility, shared by every driver that feeds
+    fused chunks to the FeedPipeline (ONE place, so a new
+    streaming-incompatible condition cannot be added to one driver and
+    missed in another): fused on, no interval filtering (the row mask
+    needs the whole span's offsets), and no skip_bad_spans (quarantine
+    is span-granular; a streamed span's early chunks would already be
+    dispatched when a late chunk turns out corrupt)."""
+    return (_use_fused(config) and intervals is None
+            and not getattr(config, "skip_bad_spans", False))
+
+
+def _flatten_span_stream(items) -> Iterator[Tuple[np.ndarray, ...]]:
+    """Uniform FeedPipeline input from mixed decode results: buffered
+    arrays/tuples pass through as one-span items; fused chunk streams
+    flatten into their per-chunk tuples."""
+    for item in items:
+        if isinstance(item, np.ndarray):
+            yield (item,)
+        elif isinstance(item, tuple):
+            yield item
+        else:
+            yield from item
+
+
+def _stream_window(window: int) -> int:
+    """Cap the in-flight window for STREAMED fused decode: each windowed
+    span is a live multi-threaded native job (the pool task only fetches
+    and starts it), so the pool-sized window that bounds buffered decodes
+    would oversubscribe the host several-fold here."""
+    return min(window, max(2, 2 * (os.cpu_count() or 1)))
+
+
+def _fused_off(config: Optional[HBamConfig]) -> HBamConfig:
+    """A config copy with the fused path disabled — the streamed paths'
+    tail-extension fallback must run the two-pass oracle, not re-run the
+    fused decode it just finished."""
+    cfg = config if config is not None else DEFAULT_CONFIG
+    return dataclasses.replace(cfg, use_fused_decode=False)
+
+
+def _start_fused_span(src, span: FileVirtualSpan, mode: str, *,
+                      sel=None, row_bytes: int = 0,
+                      geometry: "Optional[PayloadGeometry]" = None,
+                      check_crc: bool = False,
+                      config: Optional[HBamConfig] = None):
+    """Fetch one span and start its fused native decode job.
+
+    The fetch runs HERE, on the caller's thread — transient I/O faults
+    surface inside the decode_with_retry boundary even when the chunk
+    stream is consumed later.  Returns (dec, end_inflated, next_c, table)
+    or None for an empty span (the two-pass path disposes of those)."""
+    raw, end_block_size, next_c = _fetch_span_raw(src, span)
+    if not raw:
+        return None
+    table = inflate_ops.block_table(raw)
+    isize = table["isize"]
+    total = int(isize.sum())
+    end_inflated = (total - int(isize[-1]) + span.end[1]) if end_block_size \
+        else total
+    cfg = config if config is not None else DEFAULT_CONFIG
+    kwargs = {}
+    if mode == "rows":
+        kwargs = dict(sel=sel, row_stride=row_bytes)
+    elif mode == "payload":
+        kwargs = dict(max_len=geometry.max_len,
+                      seq_stride=geometry.seq_stride,
+                      qual_stride=geometry.qual_stride)
+    dec = inflate_ops.FusedSpanDecode(
+        raw, table, start=span.start[1], stop=end_inflated, mode=mode,
+        check_crc=check_crc,
+        chunk_blocks=max(1, int(getattr(cfg, "decode_chunk_blocks", 32))),
+        **kwargs)
+    return dec, end_inflated, next_c, table
+
+
+def _fused_span_counts(dec, table, n: int) -> None:
+    """Span bookkeeping on fused-decode success (the two-pass core counts
+    these itself; a fused span that falls back must not double-count)."""
+    METRICS.count("pipeline.spans")
+    METRICS.count("pipeline.blocks", int(table["isize"].size))
+    METRICS.count("pipeline.inflated_bytes", int(dec.data.size))
+    METRICS.count("pipeline.records", n)
+
+
+def _decode_span_fused(source, span: FileVirtualSpan, mode: str, *,
+                       check_crc: bool = False, sel=None, row_bytes: int = 0,
+                       geometry: "Optional[PayloadGeometry]" = None,
+                       want_voffs: bool = True,
+                       config: Optional[HBamConfig] = None):
+    """Buffered fused decode of one span — the drop-in replacement for
+    ``_decode_span_core`` + packed walker.
+
+    Returns (data, offs, voffs, outs) with ``outs`` mode-dependent
+    (rows / (prefix, seq, qual) / None), or **None** when this span needs
+    the two-pass path: an empty span, or a final owned record extending
+    past the span's inflated blocks (the tail-extension case — a record
+    crossing the end block's boundary, well under 1% of spans; the oracle
+    path re-decodes those whole for simplicity)."""
+    src = as_byte_source(source)
+    started = _start_fused_span(src, span, mode, sel=sel,
+                                row_bytes=row_bytes, geometry=geometry,
+                                check_crc=check_crc, config=config)
+    if started is None:
+        return None
+    dec, end_inflated, next_c, table = started
+    try:
+        with METRICS.timer("pipeline.fused_decode"), \
+                METRICS.span("bam.fused_decode_wall",
+                             nbytes=int(dec.data.size)):
+            n, tail = dec.run()
+    except Exception:
+        # counter parity with the two-pass path (which counts spans at
+        # entry): a span that FAILED decode still counts as attempted —
+        # the success/fallback paths count elsewhere, exactly once
+        METRICS.count("pipeline.spans")
+        raise
+    if tail < end_inflated and next_c < src.size:
+        return None             # cut final record: two-pass oracle path
+    _fused_span_counts(dec, table, n)
+    offs = dec.offsets[:n]
+    if n and want_voffs:
+        abs_coffs = table["coffset"] + span.start[0]
+        blk = np.searchsorted(dec.ubase, offs, side="right") - 1
+        voffs = (abs_coffs[blk].astype(np.uint64) << np.uint64(16)) | \
+            (offs - dec.ubase[blk]).astype(np.uint64)
+    else:
+        voffs = np.empty(0, dtype=np.uint64)
+    if mode == "rows":
+        outs = dec.rows[:n]
+    elif mode == "payload":
+        outs = (dec.prefix[:n], dec.seq[:n], dec.qual[:n])
+    else:
+        outs = None
+    return dec.data, offs, voffs, outs
+
+
+class _FusedChunkStream:
+    """One span's streamed fused decode: iterate for row-array tuples,
+    ``close()`` to join the native workers deterministically (works even
+    when iteration never started — the GC ``__del__`` backstop is for
+    interpreter teardown, not the normal abandon path)."""
+
+    __slots__ = ("_dec", "_gen")
+
+    def __init__(self, dec, gen):
+        self._dec = dec
+        self._gen = gen
+
+    def __iter__(self):
+        return self._gen
+
+    def close(self) -> None:
+        self._gen.close()
+        self._dec.finish(check=False)
+
+
+def _iter_fused_span_chunks(src, span: FileVirtualSpan, mode: str, *,
+                            sel=None, row_bytes: int = 0,
+                            geometry: "Optional[PayloadGeometry]" = None,
+                            check_crc: bool = False,
+                            config: Optional[HBamConfig] = None,
+                            fallback_fn: Optional[Callable] = None):
+    """Streamed fused decode: start the span's native job NOW (fetch on
+    the caller's thread, inside the retry boundary) and return an iterable
+    of packed row-array TUPLES — mode "rows" yields ``(rows,)``, mode
+    "payload" ``(prefix, seq, qual)`` — in record order, each yielded the
+    moment the native walk publishes it.  Feeding these straight into the
+    FeedPipeline means staging-ring tiles for dispatch start packing
+    before the span's tail blocks are even inflated.
+
+    The rare cut-final-record span completes through ``fallback_fn`` (the
+    two-pass oracle, returning the whole span's packed arrays as a tuple):
+    rows ``[n:]`` of its result are appended, so the concatenated stream
+    stays byte-identical to the buffered paths.  Corruption raises from
+    the iterator (the consumer side) — callers gate streaming off when
+    ``skip_bad_spans`` needs span-granular quarantine."""
+    src = as_byte_source(src)
+    started = _start_fused_span(src, span, mode, sel=sel,
+                                row_bytes=row_bytes, geometry=geometry,
+                                check_crc=check_crc, config=config)
+
+    def slices(lo: int, hi: int) -> Tuple[np.ndarray, ...]:
+        if mode == "rows":
+            return (dec.rows[lo:hi],)
+        return (dec.prefix[lo:hi], dec.seq[lo:hi], dec.qual[lo:hi])
+
+    if started is None:
+        METRICS.count("pipeline.spans")     # empty span, still planned
+        return iter(())
+    dec, end_inflated, next_c, table = started
+    src_size = src.size
+
+    def gen():
+        t_prev = time.perf_counter()
+        try:
+            # the consumption below IS the span's host decode (the
+            # native waits are inflate+walk work): accrue it into the
+            # same host_decode timer/walls the buffered paths use, with
+            # fused_decode as the sub-stage, so the stage taxonomy keeps
+            # meaning "all host decode work" under streaming
+            with METRICS.timer("pipeline.host_decode"), \
+                    METRICS.wall_timer("pipeline.host_decode_wall"), \
+                    METRICS.timer("pipeline.fused_decode"), \
+                    METRICS.span("bam.fused_decode_wall",
+                                 nbytes=int(dec.data.size)):
+                for lo, hi in dec.chunks():
+                    now = time.perf_counter()
+                    # per-chunk handoff latency: the stall a staging
+                    # tile pays waiting for its next batch of rows
+                    METRICS.observe("pipeline.decode_chunk_s",
+                                    now - t_prev)
+                    t_prev = now
+                    yield slices(lo, hi)
+                n, tail = dec.finish()
+        except GeneratorExit:
+            raise
+        except Exception as e:  # noqa: BLE001 — counter parity only
+            # streamed corruption raises on the consumer side, outside
+            # decode_with_retry — keep the spans/corrupt_spans counters
+            # in step with the buffered/two-pass paths (the fallback
+            # path below goes through decode_with_retry, which counts
+            # its own failures; success counts via _fused_span_counts)
+            METRICS.count("pipeline.spans")
+            if classify_error(e) == hberrors.CORRUPT:
+                METRICS.count("pipeline.corrupt_spans")
+            raise
+        if tail < end_inflated and next_c < src_size:
+            full = fallback_fn()
+            rest = tuple(a[n:] for a in full)
+            if rest[0].shape[0]:
+                yield rest
+        else:
+            _fused_span_counts(dec, table, n)
+
+    return _FusedChunkStream(dec, gen())
+
+
 def decode_span_host(source, span: FileVirtualSpan, geometry: DecodeGeometry,
                      check_crc: bool = False,
                      inflate_backend: str = "auto",
+                     config: Optional[HBamConfig] = None,
                      ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
     """Span mode: full inflated bytes + offsets padded to geometry caps.
 
     Returns (data[bytes_cap], offsets[records_cap], n_records, voffsets[n]).
     """
-    data, offs, voffs, _ = _decode_span_core(source, span, check_crc,
-                                             inflate_backend)
+    got = _decode_span_fused(source, span, "offsets", check_crc=check_crc,
+                             config=config) \
+        if _use_fused(config, inflate_backend) else None
+    if got is not None:
+        data, offs, voffs, _ = got
+    else:
+        data, offs, voffs, _ = _decode_span_core(source, span, check_crc,
+                                                 inflate_backend)
     n = int(offs.size)
     g = geometry
     if data.size > g.bytes_cap or n > g.records_cap:
@@ -306,6 +598,7 @@ def decode_span_prefix_host(source, span: FileVirtualSpan,
                             projection: Tuple[str, ...] = ALL_FIELDS,
                             want_voffs: bool = True,
                             intervals=None, header=None,
+                            config: Optional[HBamConfig] = None,
                             ) -> Tuple[np.ndarray, np.ndarray]:
     """Prefix mode: pack each owned record's projected columns densely.
 
@@ -322,6 +615,18 @@ def decode_span_prefix_host(source, span: FileVirtualSpan,
 
     row_bytes = projection_row_bytes(projection)
     ranges = projection_ranges(projection)
+    if _use_fused(config, inflate_backend):
+        got = _decode_span_fused(source, span, "rows", check_crc=check_crc,
+                                 sel=ranges, row_bytes=row_bytes,
+                                 want_voffs=want_voffs, config=config)
+        if got is not None:
+            data, offs, voffs, rows = got
+            if intervals and offs.size:
+                keep = _interval_mask(data, offs, header, intervals)
+                rows = rows[keep]
+                if voffs.size:
+                    voffs = voffs[keep]
+            return rows, voffs
     use_native = native.available()
 
     def walker(data, start, end_limit):
@@ -363,6 +668,7 @@ def decode_span_payload_host(source, span: FileVirtualSpan,
                              inflate_backend: str = "auto",
                              want_voffs: bool = False,
                              intervals=None, header=None,
+                             config: Optional[HBamConfig] = None,
                              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
                                         np.ndarray]:
     """Payload mode: pack prefix + 4-bit seq + qual into dense row tiles.
@@ -375,6 +681,18 @@ def decode_span_payload_host(source, span: FileVirtualSpan,
     from hadoop_bam_tpu.utils import native
 
     g = geometry
+    if _use_fused(config, inflate_backend):
+        got = _decode_span_fused(source, span, "payload",
+                                 check_crc=check_crc, geometry=g,
+                                 want_voffs=want_voffs, config=config)
+        if got is not None:
+            data, offs, voffs, (prefix, seq, qual) = got
+            if intervals and offs.size:
+                keep = _interval_mask(data, offs, header, intervals)
+                prefix, seq, qual = prefix[keep], seq[keep], qual[keep]
+                if voffs.size:
+                    voffs = voffs[keep]
+            return prefix, seq, qual, voffs
     use_native = native.available()
     out: Dict[str, np.ndarray] = {}
 
@@ -436,6 +754,7 @@ def decode_span_payload_host(source, span: FileVirtualSpan,
 def stack_span_group(source, spans: Sequence[FileVirtualSpan], n_dev: int,
                      geometry: DecodeGeometry, check_crc: bool = False,
                      executor: Optional[cf.ThreadPoolExecutor] = None,
+                     config: Optional[HBamConfig] = None,
                      ) -> HostSpanBatch:
     """Decode up to n_dev spans (threaded) and stack into device-batch shape;
     missing spans become empty shards (zero records)."""
@@ -443,7 +762,8 @@ def stack_span_group(source, spans: Sequence[FileVirtualSpan], n_dev: int,
     results = [None] * n_dev
 
     def work(i):
-        return decode_span_host(source, spans[i], geometry, check_crc)
+        return decode_span_host(source, spans[i], geometry, check_crc,
+                                config=config)
 
     if executor is None:
         outs = [work(i) for i in range(len(spans))]
@@ -659,7 +979,8 @@ def decode_with_retry(fn: Callable, span: FileVirtualSpan,
 
 
 def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
-                   fn: Callable, window: int) -> Iterator:
+                   fn: Callable, window: int,
+                   cleanup: Optional[Callable] = None) -> Iterator:
     """Submit ``fn(item)`` to the pool with bounded in-flight futures and
     yield results in order.  Bounds host memory: at most ``window`` decoded
     spans exist at once (a plain list of futures would retain every span's
@@ -668,7 +989,10 @@ def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
     On early close (a consumer abandoning the stream), queued-but-unstarted
     futures are cancelled — the SHARED decode pool (utils/pools.py) never
     shuts down, so without the cancel an abandoned window of decodes would
-    keep running to completion for nothing."""
+    keep running to completion for nothing.  ``cleanup`` is called on
+    results that already materialized but will never be yielded (the fused
+    chunk streams hold live native jobs — closing them joins the workers
+    instead of leaving that to GC)."""
     from collections import deque
 
     it = iter(items)
@@ -688,8 +1012,20 @@ def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
                 break
             yield fut.result()
     finally:
+        def _reap(f: cf.Future) -> None:
+            # done-callback: covers futures already finished AND ones
+            # still running at teardown (fires on the worker thread when
+            # they complete) without blocking this thread on .result()
+            if f.cancelled():
+                return
+            try:
+                cleanup(f.result())
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
         for fut in dq:
-            fut.cancel()
+            if not fut.cancel() and cleanup is not None:
+                fut.add_done_callback(_reap)
 
 
 def _iter_prefix_tiles(row_arrays, cap: int, row_bytes: int = PREFIX
@@ -832,13 +1168,29 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     pool = decode_pool(config)
     window = max(1, prefetch) * decode_pool_size(config)
 
+    # same chunk-streaming shape as flagstat_file: fused spans hand their
+    # prefix/seq/qual chunks to the packer as the native walk lands them
+    stream_fused = _fused_stream_gate(config, intervals)
+    if stream_fused:
+        window = _stream_window(window)
+
     def decode(span):
         def inner(s):
+            if stream_fused:
+                return _iter_fused_span_chunks(
+                    src, s, "payload", geometry=geometry,
+                    check_crc=check_crc, config=config,
+                    fallback_fn=lambda: decode_with_retry(
+                        lambda s2: decode_span_payload_host(
+                            src, s2, geometry, check_crc, header=header,
+                            config=_fused_off(config))[:3],
+                        s, config))
             prefix, seq, qual, _v = decode_span_payload_host(
                 src, s, geometry, check_crc,
-                intervals=intervals, header=header)
+                intervals=intervals, header=header, config=config)
             return prefix, seq, qual
-        with METRICS.wall_timer("pipeline.host_decode_wall"), \
+        with METRICS.timer("pipeline.host_decode"), \
+                METRICS.wall_timer("pipeline.host_decode_wall"), \
                 METRICS.span("bam.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
                                     quarantine=quarantine)
@@ -847,7 +1199,9 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
             np.empty((0, geometry.seq_stride), np.uint8),
             np.empty((0, geometry.qual_stride), np.uint8))
 
-    stream = _iter_windowed(pool, spans, decode, window)
+    stream = _flatten_span_stream(
+        _iter_windowed(pool, spans, decode, window,
+                       cleanup=_close_stream))
     # balance=True only for psum'd stats consumers (seq_stats_file);
     # tensor_batches keeps the serial row placement, so public batches
     # stay byte-stable across releases
@@ -1348,11 +1702,39 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     check_crc = bool(getattr(config, "check_crc", False))
     intervals = parse_config_intervals(config, header)
 
+    # Chunk-streamed fused decode: each pool worker starts its span's
+    # native job (fetch inside the retry boundary) and hands back a lazy
+    # chunk iterator; the FeedPipeline's packer consumes row chunks the
+    # moment the native walk publishes them, so staging tiles pack while
+    # the span's tail is still inflating.  Gated off when skip_bad_spans
+    # needs span-granular quarantine (a streamed span's early chunks
+    # would already be dispatched when a late chunk turns out corrupt)
+    # or when interval filtering needs the whole span's offsets.
+    stream_fused = _fused_stream_gate(config, intervals)
+    if stream_fused:
+        window = _stream_window(window)
+    ranges = projection_ranges(projection)
+
     def decode(span):
         def inner(s):
+            if stream_fused:
+                # the tail-cut fallback runs LATER, on the consumer
+                # thread: it re-reads the span, so it gets its own pass
+                # through the retry policy (transients there must heal
+                # exactly like the eager fetch's do)
+                return _iter_fused_span_chunks(
+                    src, s, "rows", sel=ranges, row_bytes=row_bytes,
+                    check_crc=check_crc, config=config,
+                    fallback_fn=lambda: decode_with_retry(
+                        lambda s2: (decode_span_prefix_host(
+                            src, s2, check_crc, "auto", projection,
+                            want_voffs=False, header=header,
+                            config=_fused_off(config))[0],),
+                        s, config))
             rows, _voffs = decode_span_prefix_host(
                 src, s, check_crc, "auto", projection,
-                want_voffs=False, intervals=intervals, header=header)
+                want_voffs=False, intervals=intervals, header=header,
+                config=config)
             return rows
         with METRICS.timer("pipeline.host_decode"), \
                 METRICS.wall_timer("pipeline.host_decode_wall"), \
@@ -1362,7 +1744,10 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
         return out if out is not None \
             else np.empty((0, row_bytes), dtype=np.uint8)
 
-    row_stream = _iter_windowed(pool, spans, decode, window)
+    def row_stream():
+        return _flatten_span_stream(
+            _iter_windowed(pool, spans, decode, window,
+                           cleanup=_close_stream))
     # Ring-staged groups + NO blocking between dispatches: the packer
     # thread writes rows straight into a leased [n_dev, cap, row] slot
     # (no per-group allocation, no np.stack, no pad memset) while THIS
@@ -1389,7 +1774,7 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                 else _ADD(totals_vec, vec)
         return t, c      # in-flight handles: the ring waits before reuse
 
-    fp.feed(((r,) for r in row_stream), dispatch)
+    fp.feed(row_stream(), dispatch)
     if totals_vec is None:
         host = np.zeros(len(FLAGSTAT_FIELDS), dtype=np.int64)
     else:
@@ -1413,7 +1798,8 @@ def _cigar_row_bytes(max_cigar: int) -> int:
 
 
 def decode_span_cigar_rows(source, span: FileVirtualSpan, max_cigar: int,
-                           check_crc: bool = False) -> np.ndarray:
+                           check_crc: bool = False,
+                           config: Optional[HBamConfig] = None) -> np.ndarray:
     """Host stage of the coverage path: inflate a span and pack one dense
     row per record — the (refid, pos, n_cigar, flag) projection + the
     cigar words, zero-padded to ``max_cigar`` ops.  ~268 B/record over
@@ -1425,8 +1811,14 @@ def decode_span_cigar_rows(source, span: FileVirtualSpan, max_cigar: int,
     span-retry boundary (a user-parameter error must not be retried or
     skip_bad_spans-eaten as corruption).
     """
-    d, o, _voffs, _ = _decode_span_core(source, span, check_crc, "auto",
-                                        want_voffs=False)
+    got = _decode_span_fused(source, span, "offsets", check_crc=check_crc,
+                             want_voffs=False, config=config) \
+        if _use_fused(config) else None
+    if got is not None:
+        d, o, _voffs, _ = got      # fused: inflate+walk+CRC in one sweep
+    else:
+        d, o, _voffs, _ = _decode_span_core(source, span, check_crc, "auto",
+                                            want_voffs=False)
     c = o.size
     w = _cigar_row_bytes(max_cigar)
     rows = np.zeros((c, w), dtype=np.uint8)
@@ -1571,7 +1963,7 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
     def decode(span):
         def inner(s):
             return decode_span_cigar_rows(src, s, max_cigar,
-                                          check_crc)
+                                          check_crc, config=config)
         with METRICS.wall_timer("pipeline.host_decode_wall"), \
                 METRICS.span("bam.host_decode_wall"):
             out = decode_with_retry(inner, span, config,

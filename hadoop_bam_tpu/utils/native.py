@@ -112,6 +112,20 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hbam_deflate_tokenize_batch.argtypes = [
             i8p, i64p, i32p, ctypes.c_int32, u32p, ctypes.c_int64,
             i32p, i32p, ctypes.c_int32]
+        if hasattr(lib, "hbam_fused_start"):
+            lib.hbam_fused_start.restype = ctypes.c_void_p
+            lib.hbam_fused_start.argtypes = [
+                i8p, i64p, i32p, i32p, u32p, ctypes.c_int32,
+                i8p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int32, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
+                i8p, i8p, i8p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, i64p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32]
+            lib.hbam_fused_next.restype = ctypes.c_int
+            lib.hbam_fused_next.argtypes = [ctypes.c_void_p, i64p, i64p]
+            lib.hbam_fused_finish.restype = ctypes.c_int
+            lib.hbam_fused_finish.argtypes = [
+                ctypes.c_void_p, i64p, i64p, i64p]
         _lib = lib
         return _lib
 
@@ -318,6 +332,116 @@ def itf8_decode_batch(buf: np.ndarray, count: int
     if consumed < 0:
         raise ValueError("ITF8 stream truncated")
     return out, int(consumed)
+
+
+def fused_available() -> bool:
+    """True when the loaded library exposes the fused span-decode entry
+    points (a stale pre-fused .so rebuilds on the next source touch; until
+    then callers fall back to the two-pass path)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "hbam_fused_start")
+
+
+# fused pack modes (must mirror HbamFusedJob::mode in hbam_native.cpp)
+FUSED_OFFSETS, FUSED_ROWS, FUSED_PAYLOAD = 0, 1, 2
+
+
+class FusedJob:
+    """Handle over one running ``hbam_fused_*`` span decode.
+
+    Thin lifecycle wrapper: pins every borrowed array for the job's
+    lifetime, exposes the blocking chunk poll, and guarantees the native
+    workers are joined exactly once (``finish``/``close``/GC).  Error
+    mapping to the repo taxonomy lives in ``ops/inflate.py`` — this layer
+    only reports raw (rc, err_index) pairs.  Single consumer; not
+    thread-safe."""
+
+    def __init__(self, src: np.ndarray, cdata_off: np.ndarray,
+                 cdata_len: np.ndarray, isize: np.ndarray,
+                 expect_crc: Optional[np.ndarray], dst: np.ndarray,
+                 ubase: np.ndarray, start: int, stop: int, mode: int,
+                 sel_off: Optional[np.ndarray], sel_len: Optional[np.ndarray],
+                 row_stride: int, out_rows: Optional[np.ndarray],
+                 out_seq: Optional[np.ndarray],
+                 out_qual: Optional[np.ndarray], max_len: int,
+                 seq_stride: int, qual_stride: int, out_off: np.ndarray,
+                 chunk_blocks: int, n_threads: int = 0):
+        lib = load()
+        assert lib is not None and hasattr(lib, "hbam_fused_start")
+        self._lib = lib
+        n_blocks = len(cdata_off)
+        if n_threads <= 0:
+            n_threads = min(
+                (n_blocks + chunk_blocks - 1) // max(1, chunk_blocks),
+                os.cpu_count() or 1)
+        # pin every borrowed buffer until finish()
+        self._keep = (src, cdata_off, cdata_len, isize, expect_crc, dst,
+                      ubase, sel_off, sel_len, out_rows, out_seq, out_qual,
+                      out_off)
+        self._h = lib.hbam_fused_start(
+            _ptr(src, ctypes.c_uint8), _ptr(cdata_off, ctypes.c_int64),
+            _ptr(cdata_len, ctypes.c_int32), _ptr(isize, ctypes.c_int32),
+            None if expect_crc is None else _ptr(expect_crc,
+                                                ctypes.c_uint32),
+            n_blocks, _ptr(dst, ctypes.c_uint8), _ptr(ubase, ctypes.c_int64),
+            int(dst.size), int(start), int(stop), int(mode),
+            None if sel_off is None else _ptr(sel_off, ctypes.c_int32),
+            None if sel_len is None else _ptr(sel_len, ctypes.c_int32),
+            0 if sel_off is None else len(sel_off), int(row_stride),
+            None if out_rows is None else _ptr(out_rows, ctypes.c_uint8),
+            None if out_seq is None else _ptr(out_seq, ctypes.c_uint8),
+            None if out_qual is None else _ptr(out_qual, ctypes.c_uint8),
+            int(max_len), int(seq_stride), int(qual_stride),
+            _ptr(out_off, ctypes.c_int64), int(out_off.size),
+            int(chunk_blocks), int(n_threads))
+        if not self._h:
+            raise ValueError("fused decode rejected its arguments")
+        self.rc = 0
+        self.tail = int(start)
+        self.n_rows = 0
+        self.err_index = -1
+
+    def next_chunk(self) -> "Optional[tuple[int, int]]":
+        """Block until the next walked row range lands; (row_lo, row_hi),
+        or None when the decode is complete.  On error, joins the workers
+        and returns None with ``self.rc < 0`` set."""
+        if self._h is None:
+            return None
+        lo = np.zeros(1, dtype=np.int64)
+        hi = np.zeros(1, dtype=np.int64)
+        rc = self._lib.hbam_fused_next(
+            self._h, _ptr(lo, ctypes.c_int64), _ptr(hi, ctypes.c_int64))
+        if rc == 1:
+            return int(lo[0]), int(hi[0])
+        if rc < 0:
+            self.finish()
+        return None
+
+    def finish(self) -> int:
+        """Join + free; idempotent.  Returns the final rc (0 or -kind) and
+        populates ``tail``/``n_rows``/``err_index``."""
+        if self._h is None:
+            return self.rc
+        tail = np.zeros(1, dtype=np.int64)
+        n_rows = np.zeros(1, dtype=np.int64)
+        err_index = np.zeros(1, dtype=np.int64)
+        rc = self._lib.hbam_fused_finish(
+            self._h, _ptr(tail, ctypes.c_int64),
+            _ptr(n_rows, ctypes.c_int64), _ptr(err_index, ctypes.c_int64))
+        self._h = None
+        self.rc = int(rc)
+        self.tail = int(tail[0])
+        self.n_rows = int(n_rows[0])
+        self.err_index = int(err_index[0])
+        return self.rc
+
+    close = finish
+
+    def __del__(self):  # abandoned mid-stream: never leak native threads
+        try:
+            self.finish()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
 
 def available() -> bool:

@@ -9,8 +9,11 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC -pthread hbam_native.cpp -lz
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -708,6 +711,343 @@ int hbam_deflate_tokenize(const uint8_t* comp, int64_t comp_len,
   *n_tokens = nt;
   *out_len = opos;
   return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Fused single-pass span decode: inflate + record walk + projection pack +
+// CRC fold in ONE streamed pass over the span, chunk-granular.
+//
+// The two-pass hot path (hbam_inflate_batch -> DRAM, then a separate
+// hbam_walk_bam_* full re-read, plus an optional third hbam_crc32_batch
+// sweep) touches every inflated byte two-to-three times from DRAM.  Here a
+// worker inflates a run of ``chunk_blocks`` BGZF blocks and the record walk
+// consumes those bytes while they are still cache-resident; the CRC32
+// check folds into the same visit.  Record boundaries chain serially
+// (offset[i+1] = offset[i] + 4 + block_size[i]), so the walk advances
+// behind the CONTIGUOUS inflated frontier: whichever worker extends the
+// frontier drains the walk (one walker at a time; inflation of later
+// chunks keeps running concurrently).  Completed walk increments are
+// published as [row_lo, row_hi) ranges that hbam_fused_next hands to the
+// caller as they land — the chunk-streamed handoff that lets the Python
+// side start packing staging tiles before the span's tail is inflated
+// (rapidgzip's chunk-pipelined consumption shape, applied host-side).
+//
+// Pack modes share one walk:
+//   0: offsets only (callers that pack variable-length series themselves)
+//   1: selected fixed-prefix ranges -> dense rows (hbam_walk_bam_packed)
+//   2: prefix + 4-bit seq + qual tiles   (hbam_walk_bam_payload)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct HbamFusedChunk { int64_t row_lo, row_hi; };
+
+struct HbamFusedJob {
+  // borrowed inputs — the Python wrapper keeps every array alive
+  const uint8_t* src;
+  const int64_t* cdata_off;
+  const int32_t* cdata_len;
+  const int32_t* isize;
+  const uint32_t* expect_crc;    // null: no CRC fold
+  int32_t n_blocks;
+  uint8_t* dst;                  // inflated span buffer [total]
+  const int64_t* ubase;          // per-block inflated start offsets
+  int64_t total;
+  int64_t start_u, stop;         // walk start / ownership limit
+  // pack configuration
+  int32_t mode;
+  const int32_t* sel_off;
+  const int32_t* sel_len;
+  int32_t n_sel, row_stride;
+  uint8_t* out_rows;             // mode 1 rows / mode 2 prefix tile
+  uint8_t* out_seq;
+  uint8_t* out_qual;
+  int32_t max_len, seq_stride, qual_stride;
+  int64_t* out_off;
+  int64_t cap;
+  // chunk bookkeeping (mu guards everything below except the atomics)
+  int32_t chunk_blocks, n_chunks;
+  std::vector<uint8_t> chunk_done;
+  int32_t frontier = 0;          // count of contiguously inflated chunks
+  bool walk_active = false;
+  int64_t walk_pos = 0;
+  int64_t walk_limit_done = 0;   // bytes the walk has already swept
+  int64_t rows = 0;
+  bool finished = false;
+  int32_t err_kind = 0;          // 1 inflate, 2 isize, 3 crc, 4 chain, 5 cap
+  int64_t err_index = -1;        // failing block (1-3) or offset (4-5)
+  std::atomic<bool> cancel{false};
+  std::atomic<int32_t> next{0};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<HbamFusedChunk> ready;
+  std::vector<std::thread> pool;
+};
+
+// Walk newly contiguous bytes and pack rows.  Called with ``lk`` held;
+// the walk body runs unlocked (walk_active excludes other walkers while
+// inflation of later chunks proceeds in parallel).
+void hbam_fused_drain(HbamFusedJob* j, std::unique_lock<std::mutex>& lk) {
+  if (j->walk_active || j->err_kind) return;
+  for (;;) {
+    const bool final_pass = j->frontier >= j->n_chunks;
+    const int64_t limit = final_pass
+        ? j->total
+        : j->ubase[static_cast<int64_t>(j->frontier) * j->chunk_blocks];
+    if (j->finished) return;
+    if (!final_pass && limit <= j->walk_limit_done) return;
+    j->walk_active = true;
+    int64_t p = j->walk_pos;
+    int64_t r = j->rows;
+    lk.unlock();
+    int ekind = 0;
+    while (p + 4 <= limit && p < j->stop) {
+      int32_t bs;
+      std::memcpy(&bs, j->dst + p, 4);
+      if (bs < 32) { ekind = 4; break; }
+      if (p + 4 + bs > limit) break;   // record cut at the frontier: resume
+      if (r >= j->cap) { ekind = 5; break; }
+      const uint8_t* rec = j->dst + p;
+      if (j->mode == 1) {
+        uint8_t* row = j->out_rows + r * j->row_stride;
+        for (int32_t s = 0; s < j->n_sel; ++s) {
+          std::memcpy(row, rec + j->sel_off[s],
+                      static_cast<size_t>(j->sel_len[s]));
+          row += j->sel_len[s];
+        }
+      } else if (j->mode == 2) {
+        std::memcpy(j->out_rows + r * 36, rec, 36);
+        uint8_t l_read_name = rec[12];
+        uint16_t n_cigar;
+        std::memcpy(&n_cigar, rec + 16, 2);
+        int32_t l_seq;
+        std::memcpy(&l_seq, rec + 20, 4);
+        int64_t seq_off = 36 + static_cast<int64_t>(l_read_name) +
+                          4 * static_cast<int64_t>(n_cigar);
+        int64_t nb = (static_cast<int64_t>(l_seq) + 1) / 2;
+        if (l_seq < 0 || seq_off + nb + l_seq > 4 + static_cast<int64_t>(bs)) {
+          ekind = 4;
+          break;
+        }
+        int32_t use = l_seq < j->max_len ? l_seq : j->max_len;
+        std::memcpy(j->out_seq + r * j->seq_stride, rec + seq_off,
+                    (use + 1) / 2);
+        std::memcpy(j->out_qual + r * j->qual_stride, rec + seq_off + nb,
+                    use);
+      }
+      j->out_off[r] = p;
+      ++r;
+      p += 4 + static_cast<int64_t>(bs);
+    }
+    lk.lock();
+    const int64_t lo = j->rows;
+    j->rows = r;
+    j->walk_pos = p;
+    j->walk_limit_done = limit;
+    j->walk_active = false;
+    if (ekind) {
+      if (!j->err_kind) { j->err_kind = ekind; j->err_index = p; }
+      j->cancel.store(true);
+      j->cv.notify_all();
+      return;
+    }
+    if (r > lo) {
+      j->ready.push_back({lo, r});
+      j->cv.notify_all();
+    }
+    if (final_pass) {
+      j->finished = true;
+      j->cv.notify_all();
+      return;
+    }
+    // loop: the frontier may have advanced while this pass walked
+  }
+}
+
+void hbam_fused_worker(HbamFusedJob* j) {
+#if defined(HBAM_USE_LIBDEFLATE)
+  libdeflate_decompressor* d = libdeflate_alloc_decompressor();
+  if (!d) {
+    std::lock_guard<std::mutex> lk(j->mu);
+    if (!j->err_kind) { j->err_kind = 1; j->err_index = 0; }
+    j->cancel.store(true);
+    j->cv.notify_all();
+    return;
+  }
+#else
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  bool live = false;
+#endif
+  for (;;) {
+    const int32_t c = j->next.fetch_add(1);
+    if (c >= j->n_chunks || j->cancel.load(std::memory_order_relaxed)) break;
+    const int32_t b0 = c * j->chunk_blocks;
+    const int32_t b1 = b0 + j->chunk_blocks < j->n_blocks
+                           ? b0 + j->chunk_blocks : j->n_blocks;
+    int ekind = 0;
+    int64_t eidx = -1;
+    for (int32_t b = b0; b < b1 && !ekind; ++b) {
+#if defined(HBAM_USE_LIBDEFLATE)
+      size_t out_n = 0;
+      libdeflate_result rc = libdeflate_deflate_decompress(
+          d, j->src + j->cdata_off[b], static_cast<size_t>(j->cdata_len[b]),
+          j->dst + j->ubase[b], static_cast<size_t>(j->isize[b]), &out_n);
+      if (rc != LIBDEFLATE_SUCCESS) { ekind = 1; eidx = b; }
+      else if (static_cast<int32_t>(out_n) != j->isize[b]) {
+        ekind = 2; eidx = b;
+      }
+#else
+      if (!live) {
+        if (inflateInit2(&zs, -15) != Z_OK) { ekind = 1; eidx = b; break; }
+        live = true;
+      } else {
+        inflateReset(&zs);
+      }
+      zs.next_in = const_cast<Bytef*>(j->src + j->cdata_off[b]);
+      zs.avail_in = static_cast<uInt>(j->cdata_len[b]);
+      zs.next_out = j->dst + j->ubase[b];
+      zs.avail_out = static_cast<uInt>(j->isize[b]);
+      int rc = inflate(&zs, Z_FINISH);
+      if (rc != Z_STREAM_END) { ekind = 1; eidx = b; }
+      else if (static_cast<int32_t>(zs.total_out) != j->isize[b]) {
+        ekind = 2; eidx = b;
+      }
+#endif
+      if (!ekind && j->expect_crc) {
+        // fold the footer check in while the block is cache-hot — this
+        // is what makes check_crc nearly free on the fused path
+#if defined(HBAM_USE_LIBDEFLATE)
+        uint32_t got = libdeflate_crc32(0, j->dst + j->ubase[b],
+                                        static_cast<size_t>(j->isize[b]));
+#else
+        uint32_t got = static_cast<uint32_t>(
+            crc32(0L, j->dst + j->ubase[b],
+                  static_cast<uInt>(j->isize[b])));
+#endif
+        if (got != j->expect_crc[b]) { ekind = 3; eidx = b; }
+      }
+    }
+    std::unique_lock<std::mutex> lk(j->mu);
+    if (ekind) {
+      if (!j->err_kind) { j->err_kind = ekind; j->err_index = eidx; }
+      j->cancel.store(true);
+      j->cv.notify_all();
+      break;
+    }
+    j->chunk_done[c] = 1;
+    while (j->frontier < j->n_chunks && j->chunk_done[j->frontier])
+      ++j->frontier;
+    hbam_fused_drain(j, lk);
+  }
+#if defined(HBAM_USE_LIBDEFLATE)
+  libdeflate_free_decompressor(d);
+#else
+  if (live) inflateEnd(&zs);
+#endif
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a fused span decode; returns an opaque handle (null on bad args).
+// All arrays are borrowed until hbam_fused_finish returns.  expect_crc may
+// be null (no CRC fold); out_seq/out_qual are only read in mode 2 and
+// sel_off/sel_len only in mode 1.
+void* hbam_fused_start(const uint8_t* src, const int64_t* cdata_off,
+                       const int32_t* cdata_len, const int32_t* isize,
+                       const uint32_t* expect_crc, int32_t n_blocks,
+                       uint8_t* dst, const int64_t* ubase, int64_t total,
+                       int64_t start_u, int64_t stop, int32_t mode,
+                       const int32_t* sel_off, const int32_t* sel_len,
+                       int32_t n_sel, int32_t row_stride,
+                       uint8_t* out_rows, uint8_t* out_seq,
+                       uint8_t* out_qual, int32_t max_len,
+                       int32_t seq_stride, int32_t qual_stride,
+                       int64_t* out_off, int64_t cap,
+                       int32_t chunk_blocks, int32_t n_threads) {
+  if (n_blocks <= 0 || mode < 0 || mode > 2) return nullptr;
+  if (chunk_blocks < 1) chunk_blocks = 1;
+  if (n_threads < 1) n_threads = 1;
+  HbamFusedJob* j = new HbamFusedJob();
+  j->src = src;
+  j->cdata_off = cdata_off;
+  j->cdata_len = cdata_len;
+  j->isize = isize;
+  j->expect_crc = expect_crc;
+  j->n_blocks = n_blocks;
+  j->dst = dst;
+  j->ubase = ubase;
+  j->total = total;
+  j->start_u = start_u;
+  j->stop = stop;
+  j->mode = mode;
+  j->sel_off = sel_off;
+  j->sel_len = sel_len;
+  j->n_sel = n_sel;
+  j->row_stride = row_stride;
+  j->out_rows = out_rows;
+  j->out_seq = out_seq;
+  j->out_qual = out_qual;
+  j->max_len = max_len;
+  j->seq_stride = seq_stride;
+  j->qual_stride = qual_stride;
+  j->out_off = out_off;
+  j->cap = cap;
+  j->chunk_blocks = chunk_blocks;
+  j->n_chunks = (n_blocks + chunk_blocks - 1) / chunk_blocks;
+  j->chunk_done.assign(j->n_chunks, 0);
+  j->walk_pos = start_u;
+  if (n_threads > j->n_chunks) n_threads = j->n_chunks;
+  j->pool.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t)
+    j->pool.emplace_back(hbam_fused_worker, j);
+  return j;
+}
+
+// Block until the next walked row range is ready.  Returns 1 and fills
+// [*row_lo, *row_hi); 0 when the decode completed (all chunks inflated,
+// walk drained); -kind on error (kind per HbamFusedJob::err_kind).
+int hbam_fused_next(void* h, int64_t* row_lo, int64_t* row_hi) {
+  HbamFusedJob* j = static_cast<HbamFusedJob*>(h);
+  std::unique_lock<std::mutex> lk(j->mu);
+  j->cv.wait(lk, [&] {
+    return j->err_kind || !j->ready.empty() || j->finished;
+  });
+  if (j->err_kind) return -j->err_kind;
+  if (!j->ready.empty()) {
+    HbamFusedChunk c = j->ready.front();
+    j->ready.pop_front();
+    *row_lo = c.row_lo;
+    *row_hi = c.row_hi;
+    return 1;
+  }
+  return 0;
+}
+
+// Join workers and free the job.  Returns 0 or -kind; *tail receives the
+// first incomplete record's offset (== stop-trimmed walk end), *n_rows
+// the packed row count, *err_index the failing block/offset on error.
+// Safe to call while workers are still running (cancels outstanding
+// chunks) — but then dst/out arrays are only partially written.
+int hbam_fused_finish(void* h, int64_t* tail, int64_t* n_rows,
+                      int64_t* err_index) {
+  HbamFusedJob* j = static_cast<HbamFusedJob*>(h);
+  {
+    std::lock_guard<std::mutex> lk(j->mu);
+    j->cancel.store(true);
+    j->cv.notify_all();
+  }
+  for (auto& th : j->pool) th.join();
+  int rc = j->err_kind ? -j->err_kind : 0;
+  if (tail) *tail = j->walk_pos;
+  if (n_rows) *n_rows = j->rows;
+  if (err_index) *err_index = j->err_index;
+  delete j;
+  return rc;
 }
 
 // Threaded batch tokenize over independent blocks (same pool shape as

@@ -1,0 +1,467 @@
+"""Fused single-pass span decode tests (``pytest -m fused``).
+
+The fused native path (``ops/inflate.py FusedSpanDecode`` over
+``hbam_fused_*`` in native/hbam_native.cpp) collapses the two-pass hot
+path's inflate -> walk -> CRC sweeps into one streamed pass.  The
+two-pass path stays in-tree as the byte-identity ORACLE — every test
+here pins the fused outputs (and the fused failure modes) to it:
+
+- randomized byte-identity across split offsets, all three pack modes;
+- truncation / byte-flip / CRC-mismatch fuzz raising the same error
+  classes on both paths;
+- chaos injection through the PR-1 ``FaultInjectingByteSource`` (the
+  fetch stays inside the retry boundary even when chunks stream);
+- chunk-streaming order and early-cancellation (native workers must
+  join, never outlive the span's buffers).
+"""
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.config import HBamConfig
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.bamio import BamWriter
+from hadoop_bam_tpu.ops import inflate as inflate_ops
+from hadoop_bam_tpu.ops.unpack_bam import (
+    FLAGSTAT_PROJECTION, projection_ranges, projection_row_bytes,
+)
+from hadoop_bam_tpu.split.planners import plan_bam_spans
+from hadoop_bam_tpu.split.spans import FileVirtualSpan
+from hadoop_bam_tpu.utils import native
+from hadoop_bam_tpu.utils.errors import CORRUPT, classify_error
+
+from fixtures import make_header, make_records
+
+pytestmark = [
+    pytest.mark.fused,
+    pytest.mark.skipif(not inflate_ops.fused_available(),
+                       reason="native fused decode unavailable"),
+]
+
+SEL = projection_ranges(FLAGSTAT_PROJECTION)
+ROW_W = projection_row_bytes(FLAGSTAT_PROJECTION)
+CFG_ON = HBamConfig(backend="cpu")
+CFG_OFF = dataclasses.replace(CFG_ON, use_fused_decode=False)
+
+
+@pytest.fixture(scope="module")
+def bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fused") / "f.bam")
+    header = make_header()
+    records = make_records(header, 4000, seed=21)
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_sam_record(r)
+    return path, header, records
+
+
+def _span_setup(path):
+    raw = open(path, "rb").read()
+    table = inflate_ops.block_table(raw)
+    data, ubase = inflate_ops.inflate_span(raw, table)
+    _, after = SAMHeader.from_bam_bytes(data.tobytes())
+    return raw, table, data, after
+
+
+# ---------------------------------------------------------------------------
+# byte-identity vs the two-pass oracle
+# ---------------------------------------------------------------------------
+
+def test_offsets_mode_matches_two_pass(bam):
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    offs, tail = inflate_ops.walk_records(data, start=after)
+    dec = inflate_ops.FusedSpanDecode(raw, table, start=after,
+                                      chunk_blocks=2)
+    n, ftail = dec.run()
+    assert np.array_equal(dec.data, data)
+    assert np.array_equal(dec.offsets[:n], offs)
+    assert ftail == tail
+
+
+def test_rows_and_payload_modes_match_native_walkers(bam):
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    cap = max(16, (data.size - after) // 36 + 1)
+    rows, offs, _ = native.walk_bam_packed(data, after, cap, SEL, ROW_W)
+    dec = inflate_ops.FusedSpanDecode(raw, table, start=after, mode="rows",
+                                      sel=SEL, row_stride=ROW_W,
+                                      chunk_blocks=3)
+    n, _ = dec.run()
+    assert n == rows.shape[0]
+    assert np.array_equal(dec.rows[:n], rows)
+    assert np.array_equal(dec.offsets[:n], offs)
+
+    pf, sq, ql, _, _ = native.walk_bam_payload(data, after, cap, 160, 96,
+                                               160)
+    dec2 = inflate_ops.FusedSpanDecode(raw, table, start=after,
+                                       mode="payload", max_len=160,
+                                       seq_stride=96, qual_stride=160,
+                                       chunk_blocks=3)
+    n2, _ = dec2.run()
+    assert np.array_equal(dec2.prefix[:n2], pf)
+    assert np.array_equal(dec2.seq[:n2], sq)
+    assert np.array_equal(dec2.qual[:n2], ql)
+
+
+def test_randomized_split_offsets_byte_identity(bam):
+    """Fused vs two-pass across randomized span plans — the full driver
+    entry points, both pack modes, voffsets included."""
+    from hadoop_bam_tpu.parallel.pipeline import (
+        PayloadGeometry, decode_span_payload_host, decode_span_prefix_host,
+    )
+
+    path, header, _ = bam
+    rng = random.Random(7)
+    geom = PayloadGeometry(max_len=120)
+    for num_spans in (rng.randint(2, 9), rng.randint(10, 25),
+                      rng.randint(26, 60)):
+        spans = plan_bam_spans(path, num_spans=num_spans, header=header)
+        for s in spans:
+            r1, v1 = decode_span_prefix_host(
+                path, s, projection=FLAGSTAT_PROJECTION, config=CFG_ON)
+            r2, v2 = decode_span_prefix_host(
+                path, s, projection=FLAGSTAT_PROJECTION, config=CFG_OFF)
+            assert np.array_equal(r1, r2) and np.array_equal(v1, v2)
+            p1 = decode_span_payload_host(path, s, geom, want_voffs=True,
+                                          config=CFG_ON)
+            p2 = decode_span_payload_host(path, s, geom, want_voffs=True,
+                                          config=CFG_OFF)
+            for a, b in zip(p1, p2):
+                assert np.array_equal(a, b)
+
+
+def test_cut_final_record_falls_back_to_oracle(tmp_path):
+    """A span whose last owned record extends past its final inflated
+    block (the tail-extension case) must produce oracle-identical rows —
+    the fused path detects the cut and reroutes that span."""
+    from hadoop_bam_tpu.parallel.pipeline import decode_span_prefix_host
+
+    header = make_header()
+    base = str(tmp_path / "hdr.bam")
+    with BamWriter(base, header) as w:
+        pass
+    hdr_bytes = open(base, "rb").read()[:-len(bgzf.EOF_BLOCK)]
+
+    recs = make_records(header, 40, seed=9)
+    tmp = str(tmp_path / "tmp.bam")
+    with BamWriter(tmp, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    from hadoop_bam_tpu.formats.bamio import read_bam
+    _, batch = read_bam(tmp)
+    payload = b"".join(batch.record_bytes(i) for i in range(40))
+    rec_offs = np.cumsum([0] + [len(batch.record_bytes(i))
+                                for i in range(40)])[:-1]
+
+    chunk = 100                       # every ~130 B record crosses blocks
+    blocks = b"".join(bgzf.deflate_block(payload[i:i + chunk])
+                      for i in range(0, len(payload), chunk))
+    path = str(tmp_path / "tiny.bam")
+    with open(path, "wb") as f:
+        f.write(hdr_bytes + blocks + bgzf.EOF_BLOCK)
+
+    raw = open(path, "rb").read()
+    coffs = [b.coffset for b in bgzf.scan_blocks(raw)
+             if b.coffset >= len(hdr_bytes)]
+    # span ends one byte past record 20's start: record 20 is OWNED and
+    # extends past the end block's boundary -> fused tail < end_inflated
+    u = int(rec_offs[20])
+    end_block = coffs[u // chunk]
+    span = FileVirtualSpan(path, (len(hdr_bytes) << 16),
+                           (end_block << 16) | (u % chunk + 1))
+    r1, v1 = decode_span_prefix_host(path, span, config=CFG_ON)
+    r2, v2 = decode_span_prefix_host(path, span, config=CFG_OFF)
+    assert r1.shape[0] == 21          # records 0..20 owned
+    assert np.array_equal(r1, r2) and np.array_equal(v1, v2)
+
+    # whole-file plans over the tiny-block layout stay identical too
+    header2 = SAMHeader.from_bam_bytes(
+        inflate_ops.inflate_span(raw)[0].tobytes())[0]
+    for s in plan_bam_spans(path, num_spans=11, header=header2):
+        a, _ = decode_span_prefix_host(path, s, config=CFG_ON)
+        b, _ = decode_span_prefix_host(path, s, config=CFG_OFF)
+        assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# corruption fuzz: same error classes on both paths
+# ---------------------------------------------------------------------------
+
+def _two_pass_decode(raw, after, check_crc=False):
+    table = inflate_ops.block_table(raw)
+    data, ubase = inflate_ops.inflate_span(raw, table)
+    if check_crc:
+        inflate_ops.verify_crcs(raw, table, data, ubase)
+    return inflate_ops.walk_records(data, start=after)
+
+
+def _fused_decode(raw, after, check_crc=False):
+    dec = inflate_ops.FusedSpanDecode(raw, start=after,
+                                      check_crc=check_crc, chunk_blocks=2)
+    n, tail = dec.run()
+    return dec.offsets[:n], tail
+
+
+def test_byte_flip_fuzz_same_errors(bam):
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    rng = random.Random(31)
+    mismatches = []
+    n_corrupt = 0
+    for trial in range(25):
+        bad = bytearray(raw)
+        pos = rng.randrange(len(raw) - len(bgzf.EOF_BLOCK))
+        bad[pos] ^= (1 << rng.randrange(8))
+        bad = bytes(bad)
+        outcomes = []
+        for fn in (_two_pass_decode, _fused_decode):
+            try:
+                offs, tail = fn(bad, after, check_crc=True)
+                outcomes.append(("ok", offs.size, tail))
+            except Exception as e:  # noqa: BLE001 — the class IS the test
+                outcomes.append(("err", isinstance(e, bgzf.BGZFError),
+                                 classify_error(e)))
+        if outcomes[0] != outcomes[1]:
+            mismatches.append((pos, outcomes))
+        if outcomes[0][0] == "err":
+            n_corrupt += 1
+            assert outcomes[0][2] == CORRUPT
+    assert not mismatches, mismatches
+    assert n_corrupt >= 5    # the fuzz actually hit payloads, not just air
+
+
+def test_crc_mismatch_only_with_check_crc(bam):
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    # flip a footer CRC byte (not the payload): only check_crc sees it
+    foot = int(table["cdata_off"][3] + table["cdata_len"][3])
+    bad = bytearray(raw)
+    bad[foot] ^= 0xFF
+    bad = bytes(bad)
+    o1, t1 = _two_pass_decode(bad, after, check_crc=False)
+    o2, t2 = _fused_decode(bad, after, check_crc=False)
+    assert np.array_equal(o1, o2) and t1 == t2
+    with pytest.raises(bgzf.BGZFError, match="CRC32 mismatch"):
+        _two_pass_decode(bad, after, check_crc=True)
+    with pytest.raises(bgzf.BGZFError, match="CRC32 mismatch"):
+        _fused_decode(bad, after, check_crc=True)
+
+
+def test_truncated_tail_matches(bam):
+    """Truncation that cuts the final block's payload: both paths raise
+    the same BGZF corruption; truncation at a block boundary walks the
+    same (shorter) record set."""
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    cut_block = int(table["coffset"][5])
+    clean_cut = raw[:cut_block]
+    o1, t1 = _two_pass_decode(clean_cut, after)
+    o2, t2 = _fused_decode(clean_cut, after)
+    assert np.array_equal(o1, o2) and t1 == t2
+
+    ragged = raw[:cut_block + 40]      # mid-header truncation
+    for fn in (_two_pass_decode, _fused_decode):
+        with pytest.raises(bgzf.BGZFError):
+            fn(ragged, after)
+
+
+def test_malformed_record_chain_same_class(bam):
+    """A corrupted block_size field (valid DEFLATE, bad BAM) raises the
+    CORRUPT class on both paths."""
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    # re-deflate block containing `after` with a poisoned block_size
+    bad_data = bytearray(data.tobytes())
+    bad_data[after:after + 4] = (5).to_bytes(4, "little")  # bs < 32
+    blk = int(np.searchsorted(
+        np.cumsum(table["isize"]), after, side="right"))
+    lo = int(np.cumsum(table["isize"])[blk - 1]) if blk else 0
+    hi = lo + int(table["isize"][blk])
+    reblocked = bgzf.deflate_block(bytes(bad_data[lo:hi]))
+    bad_raw = (raw[:int(table["coffset"][blk])] + reblocked
+               + raw[int(table["coffset"][blk])
+                     + int(bgzf.parse_block_header(
+                         raw, int(table["coffset"][blk])).block_size):])
+    errs = []
+    for fn in (_two_pass_decode, _fused_decode):
+        with pytest.raises(ValueError) as ei:
+            fn(bad_raw, after)
+        errs.append(ei.value)
+    assert all(classify_error(e) == CORRUPT for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# chaos injection (PR-1 FaultInjectingByteSource)
+# ---------------------------------------------------------------------------
+
+def test_transient_chaos_heals_inside_retry_boundary(bam):
+    """Injected transient preads fail the FETCH, which the fused path
+    runs eagerly inside decode_with_retry — the streamed chunks never
+    see the fault and the result is byte-identical to a clean run."""
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+    from hadoop_bam_tpu.utils.metrics import METRICS
+    from hadoop_bam_tpu.utils.resilient import FaultSpec, chaos_on
+
+    path, header, records = bam
+    cfg = dataclasses.replace(CFG_ON, span_retries=3,
+                              retry_backoff_base_s=0.0,
+                              retry_backoff_max_s=0.0)
+    clean = flagstat_file(path, header=header, config=cfg)
+    METRICS.reset()
+    with chaos_on(path, [FaultSpec(kind="transient", at_read=0, count=2)]):
+        chaotic = flagstat_file(path, header=header, config=cfg)
+    assert chaotic == clean
+    assert clean["total"] == len(records)
+    assert METRICS.counters["chaos.injected_faults"] >= 2
+
+
+def test_bitflip_chaos_quarantines_span(bam):
+    """Corrupting chaos + skip_bad_spans: the fused path drops back to
+    buffered per-span decode so quarantine stays span-granular."""
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+    from hadoop_bam_tpu.utils.resilient import FaultSpec, chaos_on
+
+    path, header, records = bam
+    size = len(open(path, "rb").read())
+    # check_crc: a flipped payload bit may still inflate to "valid" bytes
+    # — the folded CRC check makes detection deterministic
+    cfg = dataclasses.replace(CFG_ON, skip_bad_spans=True, span_retries=0,
+                              check_crc=True)
+    with chaos_on(path, [FaultSpec(kind="bitflip",
+                                   offset_range=(size // 2, size // 2 + 4),
+                                   count=10)]):
+        out = flagstat_file(path, header=header, config=cfg)
+    assert "quarantine" in out
+    assert 0 < out["total"] < len(records)
+
+
+# ---------------------------------------------------------------------------
+# chunk streaming: order, knobs, cancellation
+# ---------------------------------------------------------------------------
+
+def test_chunk_stream_order_and_coverage(bam):
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    dec = inflate_ops.FusedSpanDecode(raw, table, start=after, mode="rows",
+                                      sel=SEL, row_stride=ROW_W,
+                                      chunk_blocks=1)
+    ranges = list(dec.chunks())
+    n, _ = dec.finish()
+    assert len(ranges) >= 2           # chunk_blocks=1 must actually stream
+    prev = 0
+    for lo, hi in ranges:             # contiguous, ascending, gap-free
+        assert lo == prev and hi > lo
+        prev = hi
+    assert prev == n
+    cap = max(16, (data.size - after) // 36 + 1)
+    rows, _, _ = native.walk_bam_packed(data, after, cap, SEL, ROW_W)
+    assert np.array_equal(dec.rows[:n], rows)
+
+
+def test_multithreaded_workers_race_free(bam):
+    """Forced 4-worker jobs over 1-block chunks: inflate workers race the
+    walk frontier constantly (this host's auto thread count is 1, so the
+    contention paths only run when forced).  Results must stay
+    deterministic and oracle-identical.  TSan covers the same shape in
+    test_native_sanitize.py."""
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    offs, tail = inflate_ops.walk_records(data, start=after)
+    for _ in range(6):
+        dec = inflate_ops.FusedSpanDecode(raw, table, start=after,
+                                          mode="rows", sel=SEL,
+                                          row_stride=ROW_W, check_crc=True,
+                                          chunk_blocks=1, n_threads=4)
+        n, t = dec.run()
+        assert n == offs.size and t == tail
+        assert np.array_equal(dec.offsets[:n], offs)
+
+
+def test_chunk_blocks_knob_changes_granularity(bam):
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    n_blocks = int(table["isize"].size)
+    fine = len(list(inflate_ops.FusedSpanDecode(
+        raw, table, start=after, chunk_blocks=1).chunks()))
+    coarse = len(list(inflate_ops.FusedSpanDecode(
+        raw, table, start=after, chunk_blocks=n_blocks).chunks()))
+    assert coarse == 1 and fine > coarse
+
+
+def test_early_close_joins_native_workers(bam):
+    path, _, _ = bam
+    raw, table, data, after = _span_setup(path)
+    for _ in range(4):                # repeated cancel must never wedge
+        dec = inflate_ops.FusedSpanDecode(raw, table, start=after,
+                                          chunk_blocks=1)
+        g = dec.chunks()
+        next(g)
+        g.close()                     # abandon mid-stream
+        assert dec.n_rows is not None   # joined: counts are final
+    # the library stays fully usable after cancels
+    o1, t1 = _fused_decode(raw, after)
+    o2, t2 = _two_pass_decode(raw, after)
+    assert np.array_equal(o1, o2) and t1 == t2
+
+
+def test_driver_stream_abandoned_midway(bam):
+    """A consumer abandoning tensor batches mid-stream (the query/LIMIT
+    shape) unwinds the windowed fused decodes without hanging."""
+    from hadoop_bam_tpu.api.dataset import open_bam
+
+    path, header, records = bam
+    ds = open_bam(path, config=CFG_ON)
+    it = ds.tensor_batches()
+    first = next(it)
+    it.close()
+    assert int(np.asarray(first["n_records"]).sum()) > 0
+
+
+def test_config_knob_plumbing():
+    cfg = HBamConfig.from_dict({"hbam.use-fused-decode": "false",
+                                "hbam.decode-chunk-blocks": "7"})
+    assert cfg.use_fused_decode is False and cfg.decode_chunk_blocks == 7
+    from hadoop_bam_tpu.parallel.pipeline import _use_fused
+    assert not _use_fused(cfg)
+    assert _use_fused(None) == inflate_ops.fused_available()
+    assert not _use_fused(HBamConfig(), inflate_backend="zlib")
+
+
+def test_streamed_corruption_ticks_corrupt_spans(bam, tmp_path):
+    """Corruption surfacing from the streamed consumer side must keep
+    the pipeline.corrupt_spans counter in step with the buffered and
+    two-pass paths (it raises outside decode_with_retry)."""
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
+    path, header, _ = bam
+    raw = bytearray(open(path, "rb").read())
+    table = inflate_ops.block_table(bytes(raw))
+    raw[int(table["cdata_off"][4]) + 9] ^= 0xFF
+    bad = str(tmp_path / "bad.bam")
+    open(bad, "wb").write(bytes(raw))
+    METRICS.reset()
+    with pytest.raises(bgzf.BGZFError):
+        flagstat_file(bad, header=header, config=CFG_ON)
+    assert METRICS.counters["pipeline.corrupt_spans"] >= 1
+
+
+def test_fused_metrics_taxonomy(bam):
+    """The fused sweep reports pipeline.fused_decode (+ the chunk
+    histogram and the bam.fused_decode_wall span) instead of the
+    two-pass inflate/walk stage pair."""
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
+    path, header, _ = bam
+    METRICS.reset()
+    flagstat_file(path, header=header, config=CFG_ON)
+    snap = METRICS.snapshot()
+    assert "pipeline.fused_decode" in snap["timers"]
+    assert "pipeline.inflate" not in snap["timers"]
+    assert "bam.fused_decode_wall" in snap["wall_timers"]
+    assert snap["histograms"]["pipeline.decode_chunk_s"]["count"] > 0

@@ -508,6 +508,73 @@ def test_ob_outside_hot_paths_not_scoped():
 
 
 # ---------------------------------------------------------------------------
+# decode-path copy discipline (DP7xx)
+# ---------------------------------------------------------------------------
+
+_DP_BAD = '''
+import numpy as np
+
+def walk_fallback(data, start):
+    buf = data.tobytes()                     # DP701: whole-span copy
+    arr = np.frombuffer(buf, np.uint8).copy()  # DP702: copy of a view
+    return buf, arr
+
+class Decoder:
+    def pack(self):
+        return self.data.tobytes()           # DP701: attribute receiver
+'''
+
+_DP_CLEAN = '''
+import numpy as np
+
+def walk_fallback(data, start, s, e):
+    head = data[s:e].tobytes()               # bounded slice: blessed
+    crc_src = data[int(s):int(e)].tobytes()  # ditto
+    view = np.frombuffer(head, np.uint8)     # zero-copy view: blessed
+    whole = data.tobytes                     # bare reference, no call
+    return head, crc_src, view, whole
+
+FULL = None
+SNAPSHOT = np.frombuffer(b"x", np.uint8)
+'''
+
+
+def test_dp_seeded_violations_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/ops/inflate.py": _DP_BAD}, only=["decodepath"])
+    assert rules_of(findings) == {"DP701", "DP702"}
+    assert sum(f.rule == "DP701" for f in findings) == 2
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_dp_clean_idioms_pass():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/pipeline.py": _DP_CLEAN},
+        only=["decodepath"])
+    assert findings == []
+
+
+def test_dp_outside_decode_path_not_scoped():
+    # same bad source in a module off the inflated-span hot path: silent
+    findings = lint_sources(
+        {"hadoop_bam_tpu/formats/bam.py": _DP_BAD,
+         "hadoop_bam_tpu/parallel/mesh_sort.py": _DP_BAD},
+        only=["decodepath"])
+    assert findings == []
+
+
+def test_dp_module_level_code_not_scoped():
+    # the rule fires only inside function bodies: module-level fixture
+    # materializations (test corpora, constants) stay out of scope
+    findings = lint_sources(
+        {"hadoop_bam_tpu/ops/inflate.py": '''
+import numpy as np
+GOLDEN = np.zeros(4, np.uint8).tobytes()
+'''}, only=["decodepath"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip / suppression
 # ---------------------------------------------------------------------------
 

@@ -74,6 +74,30 @@ for order in (0, 1):
     got = cram_codecs.rans4x8_decode(enc)
     assert got == payload, order
 
+# fused single-pass decode: 4 workers over 1-block chunks maximizes
+# frontier/drain contention (inflate workers racing the walk), streamed
+# consumption, the CRC fold, and the early-cancel join path
+for mode, kw in (("offsets", {}),
+                 ("rows", dict(sel=[(0, 4), (4, 4), (12, 2)],
+                               row_stride=10)),
+                 ("payload", dict(max_len=160, seq_stride=80,
+                                  qual_stride=160))):
+    dec = inflate_ops.FusedSpanDecode(raw, table, start=after, mode=mode,
+                                      check_crc=True, chunk_blocks=1,
+                                      n_threads=4, **kw)
+    for _lo, _hi in dec.chunks():
+        pass
+    n, tail = dec.finish()
+    assert n == 400 and (dec.offsets[:n] == offs).all(), (mode, n)
+assert (dec.prefix[:n] == prefix).all()
+assert (dec.seq[:n] == seq).all() and (dec.qual[:n] == qual).all()
+cancelled = inflate_ops.FusedSpanDecode(raw, table, start=after,
+                                        chunk_blocks=1, n_threads=4)
+g = cancelled.chunks()
+next(g)
+g.close()          # join while workers may still be inflating
+assert cancelled.n_rows is not None
+
 # DEFLATE tokenize (host half of the device inflate), threaded
 src = np.frombuffer(raw, dtype=np.uint8)
 tokens, n_tokens, out_lens = native.deflate_tokenize_batch(
