@@ -832,6 +832,132 @@ def bench_region_query(path: str):
                     "warm pass re-serves decoded chunks from the LRU"}
 
 
+def bench_region_serve(path: str):
+    """The serving-tier saturation row, four arms on the zipf fixture:
+
+    1. COLD: fresh ServeLoop (prefetch off), each DISTINCT window once
+       — true first-touch latency (the zipf set repeats windows, so a
+       naive cold pass self-warms and understates the decode cost).
+    2. WARM: the full 250-query zipf set against the now-resident tiles
+       — every query is a tile hit; p50/p99 + sustained q/s + the
+       host-decode wall share (the bypass proof: ~0).
+    3. CLIENTS: the warm set driven by 1 then 8 concurrent client
+       threads against the one dispatcher — sustained q/s must not
+       regress as clients scale.
+    4. PREFETCH: a fresh loop with prefetch ON serving the zipf order —
+       prefetch usefulness (useful/issued) and realistic first-pass
+       tile hit rate.
+
+    Acceptance bars: warm tile-hit p50 >= 5x better than cold p50 (vs
+    the 3.1-3.7x byte-LRU-only warm speedup of PR 5), warm host_decode
+    share ~0, q/s(8 clients) >= q/s(1 client)."""
+    import dataclasses as _dc
+    import threading as _th
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.serve import ServeLoop
+    from hadoop_bam_tpu.utils.metrics import MetricsContext
+
+    bam, regions = _region_query_fixture(path)
+    unique = list(dict.fromkeys(regions))
+    quiet = _dc.replace(DEFAULT_CONFIG, serve_prefetch=False)
+
+    with ServeLoop(config=quiet) as warmup:
+        warmup.query(bam, [regions[0]])      # jit/mesh warmup only
+
+    with ServeLoop(config=quiet) as loop:
+        # -- arm 1: true cold (first touch, no prefetch, no repeats) --
+        with MetricsContext() as cold_m:
+            t0 = time.perf_counter()
+            for region in unique:
+                loop.query(bam, [region])
+            cold_dt = time.perf_counter() - t0
+        cold_lat = cold_m.hist_summary("serve.latency_s")
+
+        # -- arm 2: warm zipf set, all tile hits ----------------------
+        s0 = loop.tiles.stats()
+        with MetricsContext() as warm_m:
+            t0 = time.perf_counter()
+            for region in regions:
+                loop.query(bam, [region])
+            warm_dt = time.perf_counter() - t0
+        warm_lat = warm_m.hist_summary("serve.latency_s")
+        s1 = loop.tiles.stats()
+        d_hits = s1["hits"] - s0["hits"]
+        d_total = d_hits + s1["misses"] - s0["misses"]
+        tile_hit_rate = d_hits / d_total if d_total else 0.0
+        warm_walls = warm_m.snapshot()["wall_timers"]
+        warm_decode_share = (
+            warm_walls.get("pipeline.host_decode_wall", 0.0)
+            + warm_walls.get("query.decode_wall", 0.0)) / max(
+            warm_dt, 1e-9)
+
+        # -- arm 3: client scaling on the warm loop -------------------
+        def qps_with_clients(c: int) -> float:
+            slices = [regions[i::c] for i in range(c)]
+            errs = []
+
+            def client(idx, rs):
+                try:
+                    for region in rs:
+                        loop.query(bam, [region], tenant=f"client{idx}")
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            t0 = time.perf_counter()
+            ts = [_th.Thread(target=client, args=(i, rs))
+                  for i, rs in enumerate(slices) if rs]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            if errs:
+                raise errs[0]
+            return len(regions) / dt
+
+        clients_qps = [[c, round(qps_with_clients(c), 1)]
+                       for c in (1, 8)]
+
+    # -- arm 4: prefetch usefulness on a fresh loop, zipf order -------
+    with ServeLoop(config=DEFAULT_CONFIG) as pf_loop:
+        p0 = pf_loop.tiles.stats()
+        for region in regions:
+            pf_loop.query(bam, [region])
+        pf_loop.prefetcher.drain()
+        prefetch = pf_loop.prefetcher.stats()
+        p1 = pf_loop.tiles.stats()
+        zipf_hits = p1["hits"] - p0["hits"]
+        zipf_total = zipf_hits + p1["misses"] - p0["misses"]
+
+    cold_qps = len(unique) / cold_dt
+    warm_qps = len(regions) / warm_dt
+    cold_p50 = cold_lat.get("p50", 0.0)
+    warm_p50 = max(warm_lat.get("p50", 0.0), 1e-9)
+    return {"metric": "region_serve_queries_per_sec",
+            "value": round(warm_qps, 1), "unit": "queries/s",
+            # baseline = first-touch cold p50; the bar is >= 5x
+            "vs_baseline": round(cold_p50 / warm_p50, 3),
+            "cold_queries_per_sec": round(cold_qps, 1),
+            "tile_hit_rate": round(tile_hit_rate, 4),
+            "zipf_first_pass_hit_rate": round(
+                zipf_hits / zipf_total if zipf_total else 0.0, 4),
+            "prefetch_hit_rate": round(prefetch["hit_rate"], 4),
+            "prefetch_issued": int(prefetch["issued"]),
+            "latency_p50_ms": round(warm_p50 * 1e3, 3),
+            "latency_p99_ms": round(warm_lat.get("p99", 0.0) * 1e3, 3),
+            "cold_p50_ms": round(cold_p50 * 1e3, 3),
+            "warm_host_decode_share": round(warm_decode_share, 4),
+            "clients_qps": clients_qps,
+            "regions": len(regions),
+            "distinct_windows": len(unique),
+            "note": ("zipf 250-region set via ServeLoop; cold = each "
+                     "distinct window first-touch (prefetch off); warm "
+                     "= all-tile-hit zipf set (no decode at all); "
+                     "vs_baseline = cold_p50/warm_p50, bar >= 5x; "
+                     "clients_qps pins 1->8 client saturation")}
+
+
 def bench_obs_overhead(path: str):
     """What the always-on instrumentation itself costs (tracing
     DISABLED, the default state): flagstat through an isolated normal
@@ -1704,6 +1830,8 @@ def main() -> None:
                    "bcf_variants_per_sec", est_s=25)
     _run_component(lambda: bench_region_query(path),
                    "region_query_queries_per_sec", est_s=45)
+    _run_component(lambda: bench_region_serve(path),
+                   "region_serve_queries_per_sec", est_s=50)
     _run_component(lambda: bench_obs_overhead(path),
                    "obs_overhead_pct", est_s=25)
     _run_component(lambda: bench_fastq(build_fastq_fixture()),

@@ -159,7 +159,7 @@ def analyzers() -> Dict[str, Analyzer]:
     # import for registration side effects
     from hadoop_bam_tpu.analysis import (  # noqa: F401
         decodepath, feedpath, layout, lockstep, obsrules, querycache,
-        taxonomy, trace_safety,
+        servebounds, taxonomy, trace_safety,
     )
     return dict(_REGISTRY)
 
@@ -257,7 +257,8 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                     "binary-layout contracts (LC4xx), feed-path "
                     "allocation discipline (PF5xx), query-cache key "
                     "identity (QE5xx), observability discipline (OB6xx), "
-                    "decode-path copy discipline (DP7xx)")
+                    "decode-path copy discipline (DP7xx), serving-tier "
+                    "cache bounds (SV8xx)")
     p.add_argument("--root", default=None,
                    help="package directory to analyze (default: the "
                         "installed hadoop_bam_tpu package)")
@@ -265,7 +266,7 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                    metavar="ANALYZER",
                    help="run one analyzer (trace_safety, lockstep, "
                         "taxonomy, layout, feedpath, querycache, obs, "
-                        "decodepath); repeatable")
+                        "decodepath, servebounds); repeatable")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline file (default: analysis/baseline.json)")
     p.add_argument("--no-baseline", action="store_true",

@@ -1,0 +1,217 @@
+"""SV8xx — serving-tier cache bounds: every cache must evict.
+
+A batch pipeline can afford an unbounded memo (the process exits); a
+RESIDENT server cannot — an unbounded dict cache or append-only
+registry in ``query/`` or ``serve/`` is a slow memory leak that only
+shows up days into a deployment.  This analyzer enforces the bound
+*structurally*:
+
+- SV801: a PERSISTENT dict-like container (module-level name or
+  ``self.X`` attribute) whose name reads cache/registry-ish and that is
+  INSERTED into somewhere in the module but never evicted — no
+  ``pop``/``popitem``/``clear``/``del x[...]``, no re-assignment reset,
+  not a ``deque(maxlen=...)`` — is an unbounded cache.
+- SV802: the same for list/set-like containers that only ever
+  ``append``/``add``/``extend`` (the append-only registry).
+
+Locals inside functions are out of scope (they die with the call);
+``deque(maxlen=...)`` counts as bounded at construction.  The fix is an
+explicit bound: LRU ``popitem``, a cap + ``pop(next(iter(...)))``, a
+``maxlen`` deque, or identity-keyed purge — see ``query/cache.py`` and
+``serve/tiles.py`` for the house idioms.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/query", "hadoop_bam_tpu/serve")
+
+# names that read as long-lived lookup structures; everything else is
+# presumed working state (bounded by its algorithm, not by eviction)
+_CACHEISH = re.compile(
+    r"cache|tile|registry|recent|history|seen|memo|lru|meta\b|"
+    r"tenant|session|client|prefetch|pending|inflight|in_flight",
+    re.IGNORECASE)
+
+_DICT_CTORS = {"dict", "OrderedDict", "defaultdict", "WeakValueDictionary"}
+_LIST_CTORS = {"list", "set", "deque"}
+_INSERT_METHODS = {"setdefault", "update", "append", "appendleft",
+                   "add", "extend", "insert"}
+_EVICT_METHODS = {"pop", "popitem", "clear", "popleft", "remove",
+                  "discard", "move_to_end"}
+# move_to_end alone is not eviction, but it only exists on OrderedDicts
+# that are being LRU-managed — and every LRU manager also pops; keeping
+# it in the set just avoids double-reporting a managed structure.
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """'dict' / 'list' when ``value`` constructs an (unbounded)
+    container; None for anything else (incl. deque(maxlen=...))."""
+    if isinstance(value, ast.Dict):
+        return "dict"
+    if isinstance(value, (ast.List, ast.Set)):
+        return "list"
+    if isinstance(value, (ast.ListComp, ast.SetComp, ast.DictComp)):
+        return None               # comprehensions: computed, not grown
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if name == "deque":
+            for kw in value.keywords:
+                if kw.arg == "maxlen":
+                    return None   # bounded at construction
+            return "list"
+        if name in _DICT_CTORS:
+            return "dict"
+        if name in _LIST_CTORS:
+            return "list"
+    return None
+
+
+def _target_name(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """('global', name) for module-level Names, ('attr', name) for
+    ``self.X`` — the persistent-container identities this rule tracks."""
+    if isinstance(node, ast.Name):
+        return ("global", node.id)
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return ("attr", node.attr)
+    return None
+
+
+def _candidates(tree: ast.Module) -> Dict[Tuple[str, str],
+                                          Tuple[str, int]]:
+    """Persistent cache-ish containers: {identity: (kind, lineno)}.
+    Module-level assigns plus ``self.X = <container>`` anywhere in a
+    class body; re-assignment of a tracked name elsewhere is recorded
+    by the ops scan as a reset (eviction), not here."""
+    out: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            if value is None:
+                continue
+            kind = _ctor_kind(value)
+            if kind is None:
+                continue
+            for t in targets:
+                ident = _target_name(t)
+                if ident and _CACHEISH.search(ident[1]):
+                    out[ident] = (kind, node.lineno)
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                if value is None:
+                    continue
+                kind = _ctor_kind(value)
+                if kind is None:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    ident = _target_name(t)
+                    if ident and ident[0] == "attr" \
+                            and _CACHEISH.search(ident[1]):
+                        out.setdefault(ident, (kind, node.lineno))
+    return out
+
+
+def _ops(tree: ast.Module, names: Set[Tuple[str, str]]
+         ) -> Tuple[Set[Tuple[str, str]], Set[Tuple[str, str]],
+                    Dict[Tuple[str, str], int]]:
+    """(inserted, evicted, assign_counts) over the tracked identities."""
+    inserted: Set[Tuple[str, str]] = set()
+    evicted: Set[Tuple[str, str]] = set()
+    assigns: Dict[Tuple[str, str], int] = {}
+
+    def tracked(node: ast.AST) -> Optional[Tuple[str, str]]:
+        ident = _target_name(node)
+        return ident if ident in names else None
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    ident = tracked(t.value)
+                    if ident:
+                        inserted.add(ident)
+                else:
+                    ident = tracked(t)
+                    if ident:
+                        assigns[ident] = assigns.get(ident, 0) + 1
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Subscript):
+                ident = tracked(node.target.value)
+                if ident:
+                    inserted.add(ident)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    ident = tracked(t.value)
+                    if ident:
+                        evicted.add(ident)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            ident = tracked(node.func.value)
+            if ident:
+                if node.func.attr in _EVICT_METHODS:
+                    evicted.add(ident)
+                elif node.func.attr in _INSERT_METHODS:
+                    inserted.add(ident)
+    return inserted, evicted, assigns
+
+
+@register("servebounds")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+        cands = _candidates(m.tree)
+        if not cands:
+            continue
+        inserted, evicted, assigns = _ops(m.tree, set(cands))
+        for ident, (kind, lineno) in sorted(cands.items(),
+                                            key=lambda kv: kv[1][1]):
+            if ident not in inserted or ident in evicted:
+                continue
+            # a second assignment is a reset (the whole container is
+            # dropped and rebuilt) — bounded by that reset
+            if assigns.get(ident, 0) > 1:
+                continue
+            scope, name = ident
+            label = (f"module-level {name}" if scope == "global"
+                     else f"self.{name}")
+            if kind == "dict":
+                findings.append(Finding(
+                    rule="SV801", severity="error", path=m.path,
+                    line=lineno,
+                    message=f"unbounded dict cache {label}: inserted "
+                            f"into but never evicted — a resident server "
+                            f"leaks it; bound it with an LRU popitem/pop "
+                            f"cap, a maxlen deque, or an identity-keyed "
+                            f"purge (see query/cache.py, "
+                            f"serve/tiles.py)"))
+            else:
+                findings.append(Finding(
+                    rule="SV802", severity="error", path=m.path,
+                    line=lineno,
+                    message=f"append-only registry {label}: grows "
+                            f"without removal — a resident server leaks "
+                            f"it; drain it, cap it, or use "
+                            f"deque(maxlen=...)"))
+    return findings

@@ -94,6 +94,17 @@ _HADOOP_KEY_MAP = {
     "hbam.query-max-in-flight": "query_max_in_flight",
     "hbam.query-queue-depth": "query_queue_depth",
     "hbam.query-deadline-s": "query_deadline_s",
+    # serving knobs (serve/; no reference analog — Hadoop-BAM never ran
+    # as a resident service)
+    "hbam.serve-tile-cache-bytes": "serve_tile_cache_bytes",
+    "hbam.serve-tile-records": "serve_tile_records",
+    "hbam.serve-prefetch": "serve_prefetch",
+    "hbam.serve-prefetch-depth": "serve_prefetch_depth",
+    "hbam.serve-recent-regions": "serve_recent_regions",
+    "hbam.serve-tenant-max-in-flight": "serve_tenant_max_in_flight",
+    "hbam.serve-tenant-queue-depth": "serve_tenant_queue_depth",
+    "hbam.serve-max-tenants": "serve_max_tenants",
+    "hbam.serve-ring-slots": "serve_ring_slots",
 }
 
 
@@ -195,7 +206,32 @@ class HBamConfig:
     #                                     TransientIOError
     query_deadline_s: Optional[float] = None  # per-request wall budget;
     #                                     blown deadlines raise
-    #                                     TransientIOError (retryable)
+    #                                     TransientIOError (retryable);
+    #                                     anchored at ENQUEUE, so
+    #                                     admission wait counts
+
+    # --- serving (serve/: hbam serve / ServeLoop) ---
+    serve_tile_cache_bytes: int = 512 << 20  # device-resident decoded-
+    #                                     tile LRU budget (tier above the
+    #                                     host chunk LRU; a hit skips
+    #                                     fetch+inflate+host_decode)
+    serve_tile_records: int = 4096      # rows per device per cached tile
+    serve_prefetch: bool = True         # predictive adjacent-chunk
+    #                                     prefetch at background pool
+    #                                     priority
+    serve_prefetch_depth: int = 2       # adjacent region windows
+    #                                     prefetched per served query
+    serve_recent_regions: int = 16      # per-file recency window driving
+    #                                     prefetch dedup
+    serve_tenant_max_in_flight: int = 4  # per-tenant admission quota
+    serve_tenant_queue_depth: int = 16  # per-tenant bounded wait queue;
+    #                                     overflow sheds with
+    #                                     TransientIOError
+    serve_max_tenants: int = 64         # idle tenant schedulers kept
+    #                                     before LRU eviction
+    serve_ring_slots: int = 3           # staging-ring slots for the tile
+    #                                     builder (>= 3: one filling plus
+    #                                     pinned-in-transfer slack)
 
     # --- TPU backend ---
     backend: str = "tpu"                  # "tpu" | "cpu" (host NumPy decode)
@@ -230,7 +266,7 @@ def _coerce(kwargs: dict) -> dict:
               "qseq_filter_failed_qc", "write_header", "write_terminator",
               "use_splitting_index", "use_native", "use_fused_decode",
               "keep_paired_reads_together", "skip_bad_spans",
-              "debug_keep_spill"):
+              "debug_keep_spill", "serve_prefetch"):
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
     for k in ("max_bad_span_fraction", "retry_backoff_base_s",
@@ -243,7 +279,11 @@ def _coerce(kwargs: dict) -> dict:
               "decode_chunk_blocks",
               "query_cache_bytes", "query_chunk_bytes",
               "query_tile_records", "query_max_in_flight",
-              "query_queue_depth"):
+              "query_queue_depth",
+              "serve_tile_cache_bytes", "serve_tile_records",
+              "serve_prefetch_depth", "serve_recent_regions",
+              "serve_tenant_max_in_flight", "serve_tenant_queue_depth",
+              "serve_max_tenants", "serve_ring_slots"):
         if k in out and isinstance(out[k], str):
             out[k] = int(out[k])
     return out

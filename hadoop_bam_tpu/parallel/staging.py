@@ -121,8 +121,21 @@ class RingSlot:
     from these buffers (any pytree); the packer blocks on them after
     re-leasing the slot and BEFORE writing — so an asynchronous
     host->device transfer can never still be reading a buffer the
-    packer overwrites, without the dispatch thread ever waiting."""
-    __slots__ = ("arrays", "counts", "index", "in_flight", "_ring")
+    packer overwrites, without the dispatch thread ever waiting.
+
+    ``pin()`` transfers the slot's buffers OUT of the ring permanently:
+    a pinned slot's ``release`` parks it (never requeues it) and the
+    ring MINTS a fresh replacement slot, so capacity is unchanged while
+    the pinned buffers can never be re-leased and overwritten.  This is
+    load-bearing on the CPU backend, where ``jax.device_put`` may
+    ZERO-COPY alias a numpy buffer — a device array the serve tile
+    cache retains would otherwise silently mutate when the ring reuses
+    the slot (caught by the test_serve churn proof).  ``unpin()``
+    relinquishes a parked slot (its buffers then live exactly as long
+    as the device arrays referencing them) or, if called before
+    release, cancels the pin so the slot recirculates normally."""
+    __slots__ = ("arrays", "counts", "index", "in_flight", "pinned",
+                 "parked", "_ring")
 
     def __init__(self, arrays: List[np.ndarray], counts: np.ndarray,
                  index: int, ring: "StagingRing"):
@@ -130,7 +143,15 @@ class RingSlot:
         self.counts = counts
         self.index = index
         self.in_flight = None
+        self.pinned = False
+        self.parked = False
         self._ring = ring
+
+    def pin(self) -> None:
+        self.pinned = True
+
+    def unpin(self) -> None:
+        self._ring.unpin(self)
 
     def release(self) -> None:
         self._ring.release(self)
@@ -165,16 +186,22 @@ class StagingRing:
         self.specs = [TileSpec.normalize(s) for s in specs]
         self.n_slots = max(2, int(slots))
         self._free: "queue.Queue[RingSlot]" = queue.Queue()
+        self._next_index = 0
         self.slots: List[RingSlot] = []
-        for i in range(self.n_slots):
-            arrays = [
-                np.full((self.n_dev, self.cap) + s.shape, s.pad,
-                        dtype=s.dtype)
-                for s in self.specs
-            ]
-            slot = RingSlot(arrays, np.zeros(self.n_dev, np.int32), i, self)
+        for _ in range(self.n_slots):
+            slot = self._fresh_slot()
             self.slots.append(slot)
             self._free.put(slot)
+
+    def _fresh_slot(self) -> RingSlot:
+        arrays = [
+            np.full((self.n_dev, self.cap) + s.shape, s.pad, dtype=s.dtype)
+            for s in self.specs
+        ]
+        slot = RingSlot(arrays, np.zeros(self.n_dev, np.int32),
+                        self._next_index, self)
+        self._next_index += 1
+        return slot
 
     def lease(self, cancel: threading.Event) -> RingSlot:
         while True:
@@ -185,7 +212,30 @@ class StagingRing:
                     raise _Cancelled()
 
     def release(self, slot: RingSlot) -> None:
+        if slot.pinned:
+            # ownership transfer: the pinned buffers leave the ring FOR
+            # GOOD (device arrays made from them may alias the memory on
+            # the CPU backend — recycling would corrupt a cached tile);
+            # a fresh replacement keeps ring capacity unchanged
+            slot.parked = True
+            replacement = self._fresh_slot()
+            try:
+                self.slots[self.slots.index(slot)] = replacement
+            except ValueError:
+                self.slots.append(replacement)
+            self._free.put(replacement)
+            return
         self._free.put(slot)
+
+    def unpin(self, slot: RingSlot) -> None:
+        """Relinquish a pinned slot.  Parked (already released): a
+        replacement was minted at release time, so this only drops the
+        ring's bookkeeping — the buffers live exactly as long as the
+        device arrays referencing them, and are NEVER re-leased.  Not
+        yet released: cancels the pin, the slot recirculates normally on
+        release."""
+        slot.pinned = False
+        slot.parked = False
 
 
 def _put(q: "queue.Queue", item, cancel: threading.Event) -> None:

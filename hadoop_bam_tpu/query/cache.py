@@ -12,10 +12,11 @@ so the bench can report hit rates without private hooks.
 """
 from __future__ import annotations
 
+import concurrent.futures as cf
 import os
 import threading
 from collections import OrderedDict
-from typing import Dict, Hashable, Optional, Tuple
+from typing import Callable, Dict, Hashable, Optional, Tuple
 
 from hadoop_bam_tpu.utils.metrics import METRICS
 
@@ -60,6 +61,10 @@ class ChunkCache:
         self._hits = 0
         self._misses = 0
         self._evictions = 0
+        self._coalesced = 0
+        # single-flight table: key -> Future of the one in-progress
+        # compute; entries are ALWAYS removed in the leader's finally
+        self._inflight: Dict[Hashable, cf.Future] = {}
 
     def get(self, key: Hashable):
         """Cached value or None; ticks query.cache_hits / cache_misses."""
@@ -93,6 +98,61 @@ class ChunkCache:
             # a single entry can never exceed the budget (guard above),
             # so the loop always terminates with _bytes <= byte_budget
 
+    def contains(self, key: Hashable) -> bool:
+        """Counter-free membership probe (cached OR currently being
+        computed) — the prefetcher's dedup check, which must not distort
+        hit/miss stats with its speculative lookups."""
+        with self._lock:
+            return key in self._entries or key in self._inflight
+
+    def get_or_compute(self, key: Hashable,
+                       compute: Callable[[], Tuple[object, Optional[int]]]):
+        """Single-flight lookup: a hit returns immediately; on a miss
+        exactly ONE caller (the leader) runs ``compute`` while concurrent
+        callers for the same key block on its result instead of
+        duplicating the decode (the thundering-herd shape of a zipf-hot
+        region arriving from many serve clients at once).
+
+        ``compute`` returns ``(value, nbytes)``; ``nbytes=None`` means
+        serve-but-don't-cache (the quarantined-chunk healing path).  A
+        leader exception propagates to every waiter — the waiters asked
+        for the same bytes and would have failed identically."""
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self._hits += 1
+                METRICS.count("query.cache_hits")
+                return hit[0]
+            fut = self._inflight.get(key)
+            if fut is None:
+                fut = self._inflight[key] = cf.Future()
+                leader = True
+                self._misses += 1
+                METRICS.count("query.cache_misses")
+            else:
+                leader = False
+                self._coalesced += 1
+                METRICS.count("query.cache_coalesced")
+        if not leader:
+            return fut.result()
+        try:
+            value, nbytes = compute()
+            if nbytes is not None:
+                self.put(key, value, nbytes)
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        else:
+            fut.set_result(value)
+            return value
+        finally:
+            # the flight entry ALWAYS clears and the future ALWAYS
+            # resolves, whatever failed above — a leaked entry would
+            # park every future caller for this key on a dead future
+            with self._lock:
+                self._inflight.pop(key, None)
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
@@ -122,5 +182,6 @@ class ChunkCache:
                 "hits": self._hits,
                 "misses": self._misses,
                 "evictions": self._evictions,
+                "coalesced": self._coalesced,
                 "hit_rate": (self._hits / total) if total else 0.0,
             }

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import struct
+import threading
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -42,6 +43,7 @@ from hadoop_bam_tpu.split.intervals import Interval, resolve_interval
 from hadoop_bam_tpu.split.spans import FileVirtualSpan
 from hadoop_bam_tpu.utils.errors import PlanError
 from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.utils.stepcache import BoundedStepCache
 
 _I32_MAX = np.int32(np.iinfo(np.int32).max)
 # compressed gap below which neighbouring index ranges coalesce into one
@@ -70,7 +72,9 @@ class QueryResult:
 # device predicate
 # ---------------------------------------------------------------------------
 
-_STEP_CACHE: Dict[Tuple, object] = {}
+# bounded (SV801): one entry per (mesh, axis) actually used — a process
+# cycling through many meshes must not grow this forever
+_STEP_CACHE = BoundedStepCache(cap=8)
 
 # tile column order fed through the FeedPipeline (all [] int32 series)
 TILE_COLUMNS = ("rid", "pos1", "end1", "iv_rid", "iv_beg", "iv_end", "req")
@@ -90,24 +94,24 @@ def make_overlap_step(mesh, axis: str = "data"):
     from hadoop_bam_tpu.parallel.mesh import shard_map
 
     key = ("query_overlap", tuple(mesh.devices.flat), mesh.axis_names, axis)
-    if key in _STEP_CACHE:
-        return _STEP_CACHE[key]
 
-    def per_device(rid, pos1, end1, iv_rid, iv_beg, iv_end, req, count):
-        rid, pos1, end1 = rid[0], pos1[0], end1[0]
-        iv_rid, iv_beg, iv_end = iv_rid[0], iv_beg[0], iv_end[0]
-        count = count[0]
-        valid = jnp.arange(rid.shape[0], dtype=jnp.int32) < count
-        keep = valid & (rid == iv_rid) & (pos1 <= iv_end) \
-            & (end1 >= iv_beg)
-        del req
-        return keep[None]
+    def build():
+        def per_device(rid, pos1, end1, iv_rid, iv_beg, iv_end, req,
+                       count):
+            rid, pos1, end1 = rid[0], pos1[0], end1[0]
+            iv_rid, iv_beg, iv_end = iv_rid[0], iv_beg[0], iv_end[0]
+            count = count[0]
+            valid = jnp.arange(rid.shape[0], dtype=jnp.int32) < count
+            keep = valid & (rid == iv_rid) & (pos1 <= iv_end) \
+                & (end1 >= iv_beg)
+            del req
+            return keep[None]
 
-    fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis),) * 8,
-                   out_specs=P(axis))
-    step = jax.jit(fn)
-    _STEP_CACHE[key] = step
-    return step
+        fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis),) * 8,
+                       out_specs=P(axis))
+        return jax.jit(fn)
+
+    return _STEP_CACHE.get_or_build(key, build)
 
 
 # ---------------------------------------------------------------------------
@@ -170,21 +174,32 @@ class QueryEngine:
                 int(getattr(config, "query_queue_depth", 32)),
                 getattr(config, "query_deadline_s", None))
         self._mesh = mesh
-        self._meta: Dict[Tuple, _FileMeta] = {}
+        # bounded metadata LRU + its lock: `hbam serve` drives one engine
+        # from many client/dispatcher threads, so lookup/insert/evict of
+        # the header+index table must be atomic
+        import collections
+        self._meta: "collections.OrderedDict[Tuple, _FileMeta]" = \
+            collections.OrderedDict()
+        self._meta_lock = threading.Lock()
 
     # -- metadata ------------------------------------------------------------
 
     def _mesh_or_make(self):
-        if self._mesh is None:
-            from hadoop_bam_tpu.parallel.mesh import make_mesh
-            self._mesh = make_mesh()
-        return self._mesh
+        with self._meta_lock:
+            if self._mesh is None:
+                from hadoop_bam_tpu.parallel.mesh import make_mesh
+                self._mesh = make_mesh()
+            return self._mesh
 
     def _file_meta(self, path: str) -> _FileMeta:
         ident = file_identity(path)
-        meta = self._meta.get(ident)
-        if meta is not None:
-            return meta
+        with self._meta_lock:
+            meta = self._meta.get(ident)
+            if meta is not None:
+                # true LRU: a hot file's header+index must never be the
+                # one evicted at the 65th distinct file
+                self._meta.move_to_end(ident)
+                return meta
         kind = _sniff_kind(path)
         if kind == "bam":
             from hadoop_bam_tpu.formats.bamio import read_bam_header
@@ -215,9 +230,15 @@ class QueryEngine:
             index = self._cram_container_table(path, ident)
             meta = _FileMeta(path, ident, kind, header, header.ref_names,
                              index)
-        if len(self._meta) >= 64:
-            self._meta.pop(next(iter(self._meta)))
-        self._meta[ident] = meta
+        with self._meta_lock:
+            # two threads may have built the same meta concurrently; the
+            # first insert wins so every caller shares one instance
+            existing = self._meta.get(ident)
+            if existing is not None:
+                return existing
+            if len(self._meta) >= 64:
+                self._meta.pop(next(iter(self._meta)))
+            self._meta[ident] = meta
         return meta
 
     def _variant_header(self, path: str, kind: str):
@@ -330,13 +351,19 @@ class QueryEngine:
 
     # -- chunk decode (cache + retry) ---------------------------------------
 
+    def chunk_key(self, meta: _FileMeta, s: int, e: int) -> Tuple:
+        return (meta.ident, meta.kind, s, e)
+
     def _chunk(self, meta: _FileMeta, s: int, e: int) -> Dict[str, object]:
         """Decoded chunk columns: {'rid','pos1','end1' int32 arrays,
-        'records' materializer state} — cached by (identity, range)."""
-        key = (meta.ident, meta.kind, s, e)
-        hit = self.cache.get(key)
-        if hit is not None:
-            return hit
+        'records' materializer state} — cached by (identity, range)
+        through the SINGLE-FLIGHT cache path, so many serve clients
+        landing on the same cold chunk share one decode."""
+        return self.cache.get_or_compute(
+            self.chunk_key(meta, s, e),
+            lambda: self._compute_chunk(meta, s, e))
+
+    def _compute_chunk(self, meta: _FileMeta, s: int, e: int):
         import time
 
         from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
@@ -356,13 +383,12 @@ class QueryEngine:
             # empty (the scan drivers' skip semantics), and do NOT cache
             # — a transient fault may heal on the next query
             METRICS.count("query.chunks_skipped")
-            return {"rid": np.empty(0, np.int32),
-                    "pos1": np.empty(0, np.int32),
-                    "end1": np.empty(0, np.int32),
-                    "records": [], "n": 0, "nbytes": 0}
+            return ({"rid": np.empty(0, np.int32),
+                     "pos1": np.empty(0, np.int32),
+                     "end1": np.empty(0, np.int32),
+                     "records": [], "n": 0, "nbytes": 0}, None)
         METRICS.count("query.chunks_decoded")
-        self.cache.put(key, value, nbytes=int(value["nbytes"]))
-        return value
+        return (value, int(value["nbytes"]))
 
     def _decode_chunk(self, meta: _FileMeta,
                       span: FileVirtualSpan) -> Dict[str, object]:
@@ -501,10 +527,12 @@ class QueryEngine:
         refs: List[Tuple[int, _FileMeta, Dict[str, object]]] = []
         cand_counts = [0] * len(requests)
         ivs: List[Interval] = [None] * len(requests)
-        # per-request deadline overrides ride alongside the batch one
+        # per-request deadline overrides ride alongside the batch one,
+        # ANCHORED at the batch's enqueue instant (rebudget): admission
+        # wait counts against them, matching query.latency_s
         req_deadlines = [
             None if r.deadline_s is None
-            else self.scheduler.deadline(r.deadline_s)
+            else deadline.rebudget(r.deadline_s)
             for r in requests]
 
         def check(i: int, what: str) -> None:
@@ -615,6 +643,7 @@ class QueryEngine:
                     for r in requests]
         import time
         t0 = time.perf_counter()
+        deadline = None
         try:
             with self.scheduler.admit(deadline_s) as deadline:
                 tuples, _refs, _counts, _ivs = self._prepare(requests,
@@ -625,6 +654,11 @@ class QueryEngine:
             # single-request batch this IS the per-query latency the
             # bench's p50/p99 columns report
             METRICS.observe("query.latency_s", time.perf_counter() - t0)
+            # one tick per batch whose deadline was missed — whether it
+            # aborted mid-serve (check() already booked it) or merely
+            # finished late (booked here)
+            if deadline is not None and deadline.expired:
+                deadline.book_miss()
 
     def query_records(self, requests: Sequence[QueryRequest],
                       deadline_s: Optional[float] = None
@@ -636,17 +670,23 @@ class QueryEngine:
                     for r in requests]
         import time
         t_start = time.perf_counter()
-        with self.scheduler.admit(deadline_s) as deadline:
-            tuples, refs, cand_counts, _ivs = self._prepare(requests,
-                                                            deadline)
-            mesh = self._mesh_or_make()
-            n_dev = int(np.prod(mesh.devices.shape))
-            flat_keep: List[np.ndarray] = []
-            for out in self._stream_groups(tuples, deadline):
-                counts = np.asarray(out["n_records"])
-                keep = np.asarray(out["keep"])
-                for dev in range(n_dev):
-                    flat_keep.append(keep[dev, :int(counts[dev])])
+        batch_deadline = None
+        try:
+            with self.scheduler.admit(deadline_s) as deadline:
+                batch_deadline = deadline
+                tuples, refs, cand_counts, _ivs = self._prepare(requests,
+                                                                deadline)
+                mesh = self._mesh_or_make()
+                n_dev = int(np.prod(mesh.devices.shape))
+                flat_keep: List[np.ndarray] = []
+                for out in self._stream_groups(tuples, deadline):
+                    counts = np.asarray(out["n_records"])
+                    keep = np.asarray(out["keep"])
+                    for dev in range(n_dev):
+                        flat_keep.append(keep[dev, :int(counts[dev])])
+        finally:
+            if batch_deadline is not None and batch_deadline.expired:
+                batch_deadline.book_miss()
         mask = (np.concatenate(flat_keep) if flat_keep
                 else np.zeros(0, bool))
         results = [QueryResult(req, [], cand_counts[i])

@@ -28,15 +28,32 @@ from hadoop_bam_tpu.utils.metrics import METRICS
 class Deadline:
     """A per-request wall budget.  ``check()`` raises ``TransientIOError``
     once the budget is spent — transient on purpose: the data is fine,
-    the request may simply be retried when the system is less loaded."""
+    the request may simply be retried when the system is less loaded.
+
+    The budget is anchored at ``start`` — ENQUEUE time, by default the
+    moment the Deadline is built inside ``QueryScheduler.admit`` —
+    so admission wait counts against it, matching what the
+    ``query.latency_s`` histogram measures end to end.  ``rebudget``
+    derives a per-request override Deadline that KEEPS the anchor: a
+    request that waited 0.3s for admission has 0.3s less of its own
+    budget left, never a fresh one."""
 
     def __init__(self, seconds: Optional[float],
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 start: Optional[float] = None):
         if seconds is not None and seconds < 0:
             raise PlanError(f"query deadline must be >= 0, got {seconds}")
         self.seconds = seconds
         self._clock = clock
-        self._t_end = None if seconds is None else clock() + seconds
+        self.t_start = clock() if start is None else start
+        self._t_end = None if seconds is None else self.t_start + seconds
+        self.missed = False      # set once by book_miss()
+
+    def rebudget(self, seconds: Optional[float]) -> "Deadline":
+        """A new Deadline with ``seconds`` of budget anchored at THIS
+        deadline's enqueue instant (per-request overrides inside an
+        admitted batch)."""
+        return Deadline(seconds, clock=self._clock, start=self.t_start)
 
     def remaining(self) -> Optional[float]:
         if self._t_end is None:
@@ -48,9 +65,21 @@ class Deadline:
         r = self.remaining()
         return r is not None and r <= 0
 
+    def book_miss(self) -> bool:
+        """Tick ``query.deadline_misses`` ONCE for this deadline —
+        idempotent, so a hard abort (``check`` raising) and the serving
+        path's finally-block soft-miss accounting never double-count
+        one request."""
+        if self.missed:
+            return False
+        self.missed = True
+        METRICS.count("query.deadline_misses")
+        return True
+
     def check(self, what: str = "query") -> None:
         if self.expired:
             METRICS.count("query.deadline_exceeded")
+            self.book_miss()
             raise TransientIOError(
                 f"{what} exceeded its {self.seconds:g}s deadline — "
                 f"retry later or raise the deadline "

@@ -1,0 +1,34 @@
+"""``hbam serve`` — persistent multi-tenant region serving.
+
+The serving tier the ROADMAP's open item 2 describes, built on the
+PR-5 query engine without changing it:
+
+- ``tiles.py``     DeviceTileCache: a SECOND cache tier of decoded,
+  device-resident interval tiles above the host byte LRU, keyed by
+  ``(file_identity, chunk range, projection)`` — a hit skips
+  fetch + inflate + host_decode entirely and goes straight to the
+  jitted interval-filter step.  TileBuilder assembles tiles through a
+  PINNED staging ring (cached device tiles can never be aliased by
+  ring reuse).
+- ``prefetch.py``  Prefetcher: recency+adjacency prediction of the next
+  windows, decoded into the host cache at BACKGROUND decode-pool
+  priority (rapidgzip's cache-prefetching idea at the serving layer).
+- ``tenancy.py``   TenantQuotas: per-tenant admission quotas (one PR-5
+  ``QueryScheduler`` each) and ``interactive``/``batch`` priority
+  classes.
+- ``loop.py``      ServeLoop: the resident server — client futures, a
+  single-threaded device dispatcher, per-client MetricsContext
+  isolation, ``serve.*`` spans/histograms through the PR-6 obs layer.
+- ``transport.py`` JSONL over stdin/stdout or TCP (``hbam serve``).
+"""
+from hadoop_bam_tpu.serve.loop import ServeLoop, ServeResult  # noqa: F401
+from hadoop_bam_tpu.serve.prefetch import Prefetcher  # noqa: F401
+from hadoop_bam_tpu.serve.tenancy import (  # noqa: F401
+    PRIORITIES, TenantQuotas,
+)
+from hadoop_bam_tpu.serve.tiles import (  # noqa: F401
+    DeviceTileCache, TileBuilder, TileSet, make_tile_filter_step, tile_key,
+)
+from hadoop_bam_tpu.serve.transport import (  # noqa: F401
+    handle_stream, make_tcp_server, serve_stdio,
+)

@@ -1,0 +1,331 @@
+"""ServeLoop: the long-running multi-tenant region-query server.
+
+The PR-5 ``QueryEngine`` answers one batch and exits; production shape
+is a RESIDENT server.  ``ServeLoop`` owns:
+
+- one long-lived ``QueryEngine`` (host chunk LRU + metadata stay warm
+  across requests, many client threads feed it safely);
+- the device-resident ``DeviceTileCache`` tier above it — a warm query
+  whose tiles are resident never touches fetch/inflate/host_decode and
+  goes straight to the jitted interval-filter step;
+- the ``Prefetcher`` (adjacent-window decode at background pool
+  priority) and ``TenantQuotas`` (per-tenant admission + priority
+  classes).
+
+Threading model: clients call ``submit()`` from any thread and get a
+``concurrent.futures.Future``; tenant admission blocks (bounded) on the
+CLIENT's thread, then the job enters one priority heap.  A single
+DISPATCHER thread drains the heap and does every jax call — device
+dispatch stays single-threaded, exactly the FeedPipeline discipline —
+while decode parallelism lives in the shared pool.  Each job runs under
+the SUBMITTER's contextvars snapshot, so a client inside a
+``MetricsContext`` gets its own isolated numbers even though the
+serving and pool threads are shared (pinned by tests).
+
+Span/metric taxonomy (PR-6 obs layer; all Prometheus-exportable):
+``serve.request_wall`` / ``serve.tile_build_wall`` /
+``serve.filter_wall`` spans, ``serve.latency_s`` end-to-end histogram
+(enqueue -> result, admission wait included), ``serve.queue_wait_s``,
+``serve.tile_hits/misses/evictions``, ``serve.prefetch_issued/useful``,
+and ``query.deadline_misses`` for jobs that finish past their budget.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import contextvars
+import dataclasses
+import heapq
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.query.engine import QueryEngine, _I32_MAX
+from hadoop_bam_tpu.serve.prefetch import Prefetcher
+from hadoop_bam_tpu.serve.tenancy import TenantQuotas, priority_rank
+from hadoop_bam_tpu.serve.tiles import (
+    DeviceTileCache, TileBuilder, make_tile_filter_step, tile_key,
+)
+from hadoop_bam_tpu.utils.errors import PlanError, TransientIOError
+from hadoop_bam_tpu.utils.metrics import METRICS
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One served region: the match count is always computed (tile
+    path); ``records`` materialize only when asked for."""
+    region: str
+    count: int
+    n_candidates: int
+    tile_hits: int               # chunks served from resident tiles
+    tile_misses: int             # chunks that needed a tile build
+    records: Optional[List[object]] = None
+
+
+@dataclasses.dataclass(order=True)
+class _Job:
+    rank: int                    # priority class (lower first)
+    seq: int                     # FIFO within a class
+    tenant: str = dataclasses.field(compare=False)
+    path: str = dataclasses.field(compare=False)
+    regions: Sequence[str] = dataclasses.field(compare=False)
+    want_records: bool = dataclasses.field(compare=False)
+    deadline: object = dataclasses.field(compare=False)
+    admission: object = dataclasses.field(compare=False)   # entered CM
+    future: cf.Future = dataclasses.field(compare=False)
+    ctx: contextvars.Context = dataclasses.field(compare=False)
+    t_enqueue: float = dataclasses.field(compare=False)
+
+
+class ServeLoop:
+    """The resident server (module docstring).  Use as a context
+    manager, or ``start()``/``stop()`` explicitly; ``submit()``
+    auto-starts."""
+
+    def __init__(self, config: HBamConfig = DEFAULT_CONFIG,
+                 engine: Optional[QueryEngine] = None, mesh=None):
+        self.config = config
+        self.engine = engine if engine is not None else QueryEngine(
+            config=config, mesh=mesh)
+        self.tiles = DeviceTileCache(
+            int(getattr(config, "serve_tile_cache_bytes", 512 << 20)))
+        self.tenants = TenantQuotas(config)
+        self.prefetcher = Prefetcher(self.engine, config)
+        self.tile_cap = int(getattr(config, "serve_tile_records", 4096))
+        self._builder: Optional[TileBuilder] = None
+        self._cond = threading.Condition()
+        self._heap: List[_Job] = []
+        self._seq = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServeLoop":
+        with self._cond:
+            if self._thread is None or not self._thread.is_alive():
+                self._stopping = False
+                self._thread = threading.Thread(
+                    target=self._dispatch_loop, name="hbam-serve",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        self.prefetcher.stop()
+        # anything still queued will never run: fail it loudly as
+        # retryable (a restarting server is a transient condition)
+        with self._cond:
+            leftovers, self._heap = self._heap, []
+        for job in leftovers:
+            self._finish_admission(job)
+            job.future.set_exception(
+                TransientIOError("serve loop stopped before this "
+                                 "request was dispatched — retry"))
+        if self._builder is not None:
+            self._builder.close()
+
+    def __enter__(self) -> "ServeLoop":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, path: str, regions: Sequence[str], *,
+               tenant: str = "default", priority: str = "interactive",
+               deadline_s: Optional[float] = None,
+               want_records: bool = False) -> cf.Future:
+        """Enqueue one request (a path + its regions) for serving.
+
+        Blocks (bounded) on THIS thread for tenant admission — the
+        backpressure lands on the flooding client — then returns a
+        Future of ``[ServeResult, ...]``.  Over-quota tenants shed with
+        ``TransientIOError``; bad parameters raise ``PlanError``."""
+        if not regions:
+            raise PlanError("submit() needs at least one region")
+        rank = priority_rank(priority)
+        with self._cond:
+            if self._stopping:
+                # a stopped loop sheds instead of silently resurrecting:
+                # restart is an explicit start() by whoever owns the loop
+                raise TransientIOError("serve loop is stopped — retry "
+                                       "after it restarts")
+        if self._thread is None:
+            self.start()
+        # entered HERE (client thread: admission wait + shed happen to
+        # the submitter); exited by the dispatcher when the job finishes
+        admission = self.tenants.admit(tenant, deadline_s)
+        deadline = admission.__enter__()
+        job = _Job(rank=rank, seq=next(self._seq), tenant=tenant,
+                   path=path, regions=list(regions),
+                   want_records=bool(want_records), deadline=deadline,
+                   admission=admission, future=cf.Future(),
+                   ctx=contextvars.copy_context(),
+                   t_enqueue=time.perf_counter())
+        with self._cond:
+            if self._stopping:
+                self._finish_admission(job)
+                raise TransientIOError("serve loop is stopping — retry")
+            heapq.heappush(self._heap, job)
+            self._cond.notify()
+        return job.future
+
+    def query(self, path: str, regions: Sequence[str],
+              **kwargs) -> List[ServeResult]:
+        """Blocking convenience: ``submit(...).result()``."""
+        return self.submit(path, regions, **kwargs).result()
+
+    def stats(self) -> Dict[str, object]:
+        return {"tiles": self.tiles.stats(),
+                "chunks": self.engine.cache.stats(),
+                "prefetch": self.prefetcher.stats(),
+                "tenants": self.tenants.stats()}
+
+    # -- dispatcher ----------------------------------------------------------
+
+    @staticmethod
+    def _finish_admission(job: _Job) -> None:
+        try:
+            job.admission.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001 — release must never mask results
+            pass
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._heap and not self._stopping:
+                    self._cond.wait(0.1)
+                if self._stopping:
+                    return
+                job = heapq.heappop(self._heap)
+            try:
+                # run under the SUBMITTER's contextvars snapshot: the
+                # client's MetricsContext (and anything the decode pool
+                # inherits from here) stays isolated per client
+                job.ctx.run(self._run_job, job)
+            except BaseException as e:  # noqa: BLE001 — keep serving
+                if not job.future.done():
+                    job.future.set_exception(e)
+
+    def _run_job(self, job: _Job) -> None:
+        t_run = time.perf_counter()
+        METRICS.observe("serve.queue_wait_s", t_run - job.t_enqueue)
+        try:
+            with METRICS.span("serve.request_wall", tenant=job.tenant,
+                              regions=len(job.regions)):
+                results = [self._serve_region(job, region)
+                           for region in job.regions]
+            job.future.set_result(results)
+        except BaseException as e:  # noqa: BLE001 — crosses to the client
+            job.future.set_exception(e)
+        finally:
+            METRICS.observe("serve.latency_s",
+                            time.perf_counter() - job.t_enqueue)
+            if job.deadline is not None and job.deadline.expired:
+                job.deadline.book_miss()
+            self._finish_admission(job)
+
+    def _builder_or_make(self) -> TileBuilder:
+        if self._builder is None:
+            mesh = self.engine._mesh_or_make()
+            self._builder = TileBuilder(
+                mesh, self.tile_cap,
+                int(getattr(self.config, "serve_ring_slots", 3)))
+        return self._builder
+
+    def _serve_region(self, job: _Job, region: str) -> ServeResult:
+        engine = self.engine
+        job.deadline.check("serve resolve")
+        meta = engine._file_meta(job.path)
+        iv, ranges = engine._resolve(meta, region)
+        chunks = engine._coalesce(ranges, meta.kind)
+        builder = self._builder_or_make()
+        step = make_tile_filter_step(builder.mesh)
+        rid = meta.ref_names.index(iv.rname)
+        iv_dev = builder.put_interval([
+            rid, min(iv.start, int(_I32_MAX)), min(iv.end, int(_I32_MAX))])
+
+        count = 0
+        n_candidates = 0
+        tile_hits = 0
+        tile_misses = 0
+        rows_per_chunk: List[Tuple[Tuple, np.ndarray, int]] = []
+        for s, e in chunks:
+            job.deadline.check("serve chunk")
+            key = tile_key(meta.ident, meta.kind, s, e,
+                           builder.n_dev, builder.cap)
+            tiles = self.tiles.get(key)
+            if tiles is None:
+                tile_misses += 1
+                value = engine._chunk(meta, s, e)
+                # ticks serve.prefetch_useful when the host chunk was
+                # decoded ahead of need by the prefetcher
+                self.prefetcher.was_prefetched(engine.chunk_key(meta, s, e))
+                tiles = builder.build(meta.ident, value)
+                if int(value["n"]) > 0 or int(value["nbytes"]) > 0:
+                    self.tiles.put(key, tiles)
+                else:
+                    # a QUARANTINED chunk (skip_bad_spans healing path:
+                    # n=0 AND nbytes=0 — a genuinely empty chunk always
+                    # accounts >= 64 bytes) serves as empty but is NOT
+                    # cached at either tier, so a healed transient fault
+                    # re-decodes instead of returning empty forever
+                    METRICS.count("serve.tiles_uncached_quarantine")
+            else:
+                tile_hits += 1
+            n_candidates += tiles.n
+            masks: List[np.ndarray] = []
+            with METRICS.span("serve.filter_wall"):
+                for g in tiles.groups:
+                    keep, hits = step(*g.cols, g.counts, iv_dev)
+                    # count-only serving reads just the [n_dev] match
+                    # counts — a few bytes off the mesh; the full mask
+                    # materializes only for records mode
+                    count += int(np.asarray(hits).sum())
+                    if job.want_records:
+                        masks.append(np.asarray(keep))
+            if job.want_records and masks:
+                rows_per_chunk.append((
+                    (s, e), self._flat_rows(masks, builder), tiles.n))
+        records = None
+        if job.want_records:
+            records = self._materialize(meta, rows_per_chunk)
+        METRICS.count("serve.requests")
+        self.prefetcher.note(meta, iv)
+        return ServeResult(region=region, count=count,
+                           n_candidates=n_candidates,
+                           tile_hits=tile_hits, tile_misses=tile_misses,
+                           records=records)
+
+    @staticmethod
+    def _flat_rows(masks: List[np.ndarray], builder: TileBuilder
+                   ) -> np.ndarray:
+        """Chunk-local row indices of kept rows, undoing the serial
+        group/device packing of ``TileBuilder.build``."""
+        rows: List[int] = []
+        per_group = builder.n_dev * builder.cap
+        for g_idx, k in enumerate(masks):
+            for dev in range(builder.n_dev):
+                hit = np.flatnonzero(k[dev])
+                rows.extend(g_idx * per_group + dev * builder.cap + hit)
+        return np.asarray(sorted(rows), dtype=np.int64)
+
+    def _materialize(self, meta, rows_per_chunk) -> List[object]:
+        """Host record objects for kept rows: the host chunk tier has
+        (or re-decodes, byte-identically) the materializer state."""
+        out: List[object] = []
+        for (s, e), rows, _n in rows_per_chunk:
+            value = self.engine._chunk(meta, s, e)
+            for row in rows:
+                out.append(QueryEngine._materialize(meta, value, int(row)))
+        return out

@@ -1,0 +1,120 @@
+"""Per-tenant admission quotas + priority classes for ``hbam serve``.
+
+One tenant flooding the server must degrade THAT tenant, not its
+neighbours.  This module layers multi-tenancy onto the PR-5
+``QueryScheduler`` — reused unchanged, one instance per tenant:
+
+- each tenant gets its own bounded admission gate
+  (``serve_tenant_max_in_flight`` running + ``serve_tenant_queue_depth``
+  waiting); a tenant past both sheds ITS OWN load with
+  ``TransientIOError`` while every other tenant admits normally;
+- admission happens on the SUBMITTING client's thread (backpressure
+  lands on the flooder), and the admitted slot is held until the
+  dispatcher finishes the request;
+- priority classes order the dispatcher's queue: ``interactive``
+  requests jump ahead of ``batch`` backfill, so a batch tenant
+  saturating its quota cannot push an interactive tenant's p99 past its
+  deadline (the isolation contract, pinned in tests/test_serve.py);
+- idle tenant gates are LRU-evicted past ``serve_max_tenants`` — a
+  long-running server accepting arbitrary tenant strings must not grow
+  a scheduler per string forever (the SV801 bound).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.query.scheduler import QueryScheduler
+from hadoop_bam_tpu.utils.errors import PlanError
+
+# lower sorts first in the dispatch heap
+PRIORITIES: Dict[str, int] = {"interactive": 0, "batch": 1}
+
+
+def priority_rank(priority: str) -> int:
+    try:
+        return PRIORITIES[priority]
+    except KeyError:
+        raise PlanError(
+            f"unknown priority class {priority!r}; choose from "
+            f"{sorted(PRIORITIES)}") from None
+
+
+class TenantQuotas:
+    """The per-tenant gate registry (module docstring)."""
+
+    def __init__(self, config: HBamConfig = DEFAULT_CONFIG,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_in_flight = int(
+            getattr(config, "serve_tenant_max_in_flight", 4))
+        self.queue_depth = int(
+            getattr(config, "serve_tenant_queue_depth", 16))
+        self.max_tenants = int(getattr(config, "serve_max_tenants", 64))
+        self.default_deadline_s: Optional[float] = getattr(
+            config, "query_deadline_s", None)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tenants: "OrderedDict[str, QueryScheduler]" = OrderedDict()
+
+    def scheduler(self, tenant: str) -> QueryScheduler:
+        """This tenant's admission gate (created on first use; idle gates
+        LRU-evict past ``max_tenants``)."""
+        if not isinstance(tenant, str) or not tenant:
+            raise PlanError(f"tenant must be a non-empty string, "
+                            f"got {tenant!r}")
+        with self._lock:
+            sched = self._tenants.get(tenant)
+            if sched is not None:
+                self._tenants.move_to_end(tenant)
+                return sched
+            if len(self._tenants) >= self.max_tenants:
+                # evict the least-recently-used IDLE gate; busy gates
+                # (admitted work outstanding) are skipped — evicting one
+                # would orphan its in-flight accounting
+                for name in list(self._tenants):
+                    if self._tenants[name].in_flight == 0:
+                        self._tenants.pop(name)
+                        break
+            sched = QueryScheduler(self.max_in_flight, self.queue_depth,
+                                   self.default_deadline_s,
+                                   clock=self._clock)
+            self._tenants[tenant] = sched
+            return sched
+
+    @contextlib.contextmanager
+    def admit(self, tenant: str, deadline_s: Optional[float] = None):
+        """The tenant's ``QueryScheduler.admit`` — blocking bounded
+        admission on the CALLER's thread, yielding the enqueue-anchored
+        ``Deadline``.  Guards the handout window: if the idle-LRU
+        eviction dropped this tenant's gate between lookup and
+        admission, the admitted slot would live on an orphaned
+        scheduler (splitting the tenant's quota across instances), so
+        after admitting we re-validate membership — reinstalling the
+        gate if it was evicted, or retrying on the replacement a racing
+        creator installed."""
+        while True:
+            sched = self.scheduler(tenant)
+            with sched.admit(deadline_s) as deadline:
+                with self._lock:
+                    live = self._tenants.get(tenant)
+                    if live is None:
+                        # evicted while idle in the handout window; we
+                        # now hold an admitted slot, so it is not idle:
+                        # reinstall it as the tenant's one true gate
+                        self._tenants[tenant] = sched
+                        live = sched
+                if live is sched:
+                    yield deadline
+                    return
+            # a racing creator installed a different gate: the slot we
+            # took on the orphan is released by the with-exit above;
+            # re-admit on the live gate
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {name: {"in_flight": sched.in_flight}
+                    for name, sched in self._tenants.items()}

@@ -1,0 +1,190 @@
+"""Wire transports for ``hbam serve``: JSONL over stdin/stdout or TCP.
+
+One request per line::
+
+    {"id": 1, "path": "a.bam", "regions": ["chr20:1-5000"],
+     "tenant": "web", "priority": "interactive", "deadline_s": 0.5,
+     "records": false}
+
+(``region`` singular is accepted too.)  One response line per request,
+keyed by ``id`` — responses stream back AS THEY COMPLETE, which with
+priority classes is not submission order::
+
+    {"id": 1, "tenant": "web", "latency_ms": 3.1,
+     "results": [{"region": "chr20:1-5000", "count": 17,
+                  "candidates": 94, "tile_hits": 1, "tile_misses": 0}]}
+
+Failures answer on the same line protocol with the PR-1 taxonomy class
+spelled out, so clients can implement retry policy without parsing
+message strings::
+
+    {"id": 2, "error": "...", "kind": "transient"}   # back off + retry
+    {"id": 3, "error": "...", "kind": "plan"}        # fix the request
+
+The TCP flavor is a thread-per-connection ``socketserver`` veneer over
+the same per-line handler; every connection funnels into the ONE
+``ServeLoop`` dispatcher, so device work stays single-threaded no
+matter how many sockets are open.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import json
+import threading
+import time
+from typing import Dict, List
+
+from hadoop_bam_tpu.utils.errors import (
+    CorruptDataError, HBamError, PlanError, TransientIOError,
+)
+
+
+def error_kind(exc: BaseException) -> str:
+    """The taxonomy class a failed request reports on the wire."""
+    if isinstance(exc, TransientIOError):
+        return "transient"
+    if isinstance(exc, (PlanError, FileNotFoundError)):
+        # a bad path is configuration (file_identity's contract): never
+        # retried, never quarantined
+        return "plan"
+    if isinstance(exc, CorruptDataError):
+        return "corrupt"
+    return "error"
+
+
+def _result_doc(req_id, tenant: str, results, t_enqueue: float) -> Dict:
+    return {
+        "id": req_id,
+        "tenant": tenant,
+        "latency_ms": round((time.perf_counter() - t_enqueue) * 1e3, 3),
+        "results": [
+            {"region": r.region, "count": r.count,
+             "candidates": r.n_candidates, "tile_hits": r.tile_hits,
+             "tile_misses": r.tile_misses,
+             **({"records": [rec.to_line() for rec in r.records]}
+                if r.records is not None else {})}
+            for r in results],
+    }
+
+
+def handle_stream(loop, rfile, wfile) -> int:
+    """Drive one JSONL request stream against ``loop`` until EOF;
+    returns the number of requests handled.  Writes are serialized by a
+    lock because responses complete out of order on the dispatcher
+    thread while this thread keeps reading."""
+    wlock = threading.Lock()
+    # response-WRITTEN events, not bare futures: a future resolves
+    # before its done-callback runs, and returning on future completion
+    # would let a TCP handler close the socket under the in-flight
+    # response write
+    written: List[threading.Event] = []
+
+    def write(doc: Dict) -> None:
+        line = json.dumps(doc)
+        with wlock:
+            wfile.write(line + "\n")
+            try:
+                wfile.flush()
+            except (OSError, ValueError):
+                pass              # client went away mid-response
+
+    n = 0
+    for raw in rfile:
+        line = raw.strip()
+        if not line:
+            continue
+        n += 1
+        req_id: object = n
+        t_enqueue = time.perf_counter()
+        try:
+            doc = json.loads(line)
+            if not isinstance(doc, dict):
+                raise ValueError("request must be a JSON object")
+            req_id = doc.get("id", n)
+            regions = doc.get("regions")
+            if regions is None:
+                regions = [doc["region"]] if "region" in doc else None
+            if not regions or "path" not in doc:
+                raise ValueError(
+                    'request needs "path" and "regions" (or "region")')
+            fut = loop.submit(
+                doc["path"], regions,
+                tenant=str(doc.get("tenant", "default")),
+                priority=str(doc.get("priority", "interactive")),
+                deadline_s=doc.get("deadline_s"),
+                want_records=bool(doc.get("records", False)))
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed line / PlanError-class rejection: answer, keep
+            # serving the stream (one bad client line must not kill the
+            # connection)
+            write({"id": req_id, "error": str(e),
+                   "kind": error_kind(e) if isinstance(e, HBamError)
+                   else "plan"})
+            continue
+        except OSError as e:      # admission shed (TransientIOError)
+            write({"id": req_id, "error": str(e), "kind": error_kind(e)})
+            continue
+
+        ev = threading.Event()
+
+        def _done(f: cf.Future, req_id=req_id,
+                  tenant=str(doc.get("tenant", "default")),
+                  t_enqueue=t_enqueue, ev=ev) -> None:
+            try:
+                exc = f.exception()
+                if exc is not None:
+                    write({"id": req_id, "error": str(exc),
+                           "kind": error_kind(exc)})
+                else:
+                    write(_result_doc(req_id, tenant, f.result(),
+                                      t_enqueue))
+            finally:
+                ev.set()
+
+        fut.add_done_callback(_done)
+        written.append(ev)
+        # prune responses already on the wire: a connection held open
+        # for millions of requests must not grow this list without
+        # bound (the SV802 discipline, applied to a local)
+        if len(written) > 64:
+            written[:] = [e for e in written if not e.is_set()]
+    for ev in written:
+        ev.wait(timeout=60.0)
+    return n
+
+
+def serve_stdio(loop, rfile=None, wfile=None) -> int:
+    """The ``hbam serve`` default transport: JSONL on stdin/stdout."""
+    import sys
+    return handle_stream(loop, rfile if rfile is not None else sys.stdin,
+                         wfile if wfile is not None else sys.stdout)
+
+
+def make_tcp_server(loop, host: str = "127.0.0.1", port: int = 0):
+    """A ``ThreadingTCPServer`` speaking the JSONL protocol per
+    connection; caller owns ``serve_forever()`` / ``shutdown()``.  The
+    bound address is ``server.server_address`` (pass ``port=0`` for an
+    ephemeral port — how the tests run it)."""
+    import socketserver
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self) -> None:
+            rfile = (line.decode("utf-8", "replace")
+                     for line in self.rfile)
+            import io
+
+            class _W(io.TextIOBase):
+                def write(inner, s: str) -> int:  # noqa: N805
+                    self.wfile.write(s.encode())
+                    return len(s)
+
+                def flush(inner) -> None:  # noqa: N805
+                    pass
+
+            handle_stream(loop, rfile, _W())
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    return Server((host, int(port)), Handler)

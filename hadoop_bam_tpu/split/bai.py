@@ -305,11 +305,16 @@ def build_bai(bam_path: str, header=None) -> BaiIndex:
         span_len = np.maximum(batch.reference_span(), 1).astype(np.int64)
         end = pos + span_len                        # half-open
         # chunk end of record i = start voffset of record i+1 (same span);
-        # the final record's end falls back to its own start + 1 block —
-        # conservative and still correct for overlap queries
+        # the final record's end is the SPAN's end voffset — conservative
+        # (covers every record starting in the span) and block-aligned.
+        # The old fallback packed (coffset+1, 0), one BYTE past the block
+        # start: BGZFReader-based chunk reads tolerated that by accident,
+        # but block-table consumers (plan_interval_spans -> coverage's
+        # _fetch_span_raw) need end coffsets on real block boundaries and
+        # died mid-block with "truncated BGZF header"
         nxt = np.empty(n, dtype=np.uint64)
         nxt[:-1] = voffs[1:]
-        nxt[-1] = (int(voffs[-1]) + (1 << 16)) & ~0xFFFF
+        nxt[-1] = (int(span.end[0]) << 16) | int(span.end[1])
         for i in range(n):
             rid = int(refid[i])
             if rid < 0:

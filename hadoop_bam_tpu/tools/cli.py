@@ -525,6 +525,62 @@ def cmd_query(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# serve
+# ---------------------------------------------------------------------------
+
+def cmd_serve(args) -> int:
+    """Long-running multi-tenant region serving (serve/loop.py): JSONL
+    requests over stdin/stdout (default) or TCP (--port), served from a
+    device-resident decoded-tile cache above the host chunk LRU, with
+    per-tenant admission quotas, priority classes, and predictive
+    prefetch."""
+    import dataclasses
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.serve import ServeLoop, make_tcp_server, serve_stdio
+
+    cfg = DEFAULT_CONFIG
+    overrides = {}
+    if args.deadline is not None:
+        overrides["query_deadline_s"] = args.deadline
+    if args.tile_cache_bytes is not None:
+        overrides["serve_tile_cache_bytes"] = args.tile_cache_bytes
+    if args.no_prefetch:
+        overrides["serve_prefetch"] = False
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    _start_obs(args)
+    n = 0
+    with ServeLoop(config=cfg) as loop:
+        for path in args.warm or ():
+            # warm metadata + index up front so the first client query
+            # doesn't pay the header walk
+            loop.engine._file_meta(path)
+        if args.port is not None:
+            server = make_tcp_server(loop, host=args.host, port=args.port)
+            host, port = server.server_address[:2]
+            print(f"serving on {host}:{port} (JSONL; ^C stops)",
+                  file=sys.stderr)
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                server.shutdown()
+                server.server_close()
+        else:
+            n = serve_stdio(loop)
+        if args.metrics:
+            print("-- serve stats --", file=sys.stderr)
+            for section, stats in sorted(loop.stats().items()):
+                print(f"{section}\t{stats}", file=sys.stderr)
+    _finish_obs(args)
+    if args.port is None:
+        print(f"served {n} request(s)", file=sys.stderr)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # metrics (snapshot render / export)
 # ---------------------------------------------------------------------------
 
@@ -714,6 +770,34 @@ def build_parser() -> argparse.ArgumentParser:
     _add_obs_flags(q)
     q.set_defaults(fn=cmd_query, uses_device=True)
 
+    sv = sub.add_parser("serve",
+                        help="long-running multi-tenant region server: "
+                             "JSONL requests on stdin (or --port TCP), "
+                             "device-resident tile cache, per-tenant "
+                             "quotas + priority classes, predictive "
+                             "prefetch")
+    sv.add_argument("--port", type=int, default=None,
+                    help="listen on TCP PORT (0 = ephemeral) instead of "
+                         "stdin/stdout JSONL")
+    sv.add_argument("--host", default="127.0.0.1",
+                    help="bind address for --port (default 127.0.0.1)")
+    sv.add_argument("--deadline", type=float, default=None,
+                    help="default per-request deadline in seconds, "
+                         "anchored at enqueue (admission wait counts)")
+    sv.add_argument("--tile-cache-bytes", type=int, default=None,
+                    help="device-resident decoded-tile LRU budget "
+                         "(default config.serve_tile_cache_bytes)")
+    sv.add_argument("--no-prefetch", action="store_true",
+                    help="disable predictive adjacent-window prefetch")
+    sv.add_argument("--warm", metavar="PATH", action="append",
+                    help="pre-resolve header+index of PATH at startup; "
+                         "repeatable")
+    sv.add_argument("--metrics", action="store_true",
+                    help="dump tile/chunk/prefetch/tenant stats to "
+                         "stderr at shutdown")
+    _add_obs_flags(sv)
+    sv.set_defaults(fn=cmd_serve, uses_device=True)
+
     mt = sub.add_parser("metrics",
                         help="render/merge metrics snapshots written by "
                              "--metrics-json (text, Prometheus "
@@ -729,14 +813,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="static analysis: trace safety (TS1xx), "
                              "collective lockstep (CL2xx), error taxonomy "
                              "(ET3xx), layout contracts (LC4xx), "
-                             "observability discipline (OB6xx); exits "
-                             "non-zero on unsuppressed findings")
+                             "observability discipline (OB6xx), serving "
+                             "cache bounds (SV8xx); exits non-zero on "
+                             "unsuppressed findings")
     ln.add_argument("--root", default=None,
                     help="package directory to analyze")
     ln.add_argument("--only", action="append", metavar="ANALYZER",
                     help="run one analyzer (trace_safety, lockstep, "
-                         "taxonomy, layout, feedpath, querycache, obs); "
-                         "repeatable")
+                         "taxonomy, layout, feedpath, querycache, obs, "
+                         "decodepath, servebounds); repeatable")
     ln.add_argument("--baseline", default=None,
                     help="baseline file (default analysis/baseline.json)")
     ln.add_argument("--no-baseline", action="store_true")

@@ -14,6 +14,7 @@ configs get the existing pool (one process, one pool, by design).
 """
 from __future__ import annotations
 
+import collections
 import concurrent.futures as cf
 import contextvars
 import os
@@ -72,7 +73,8 @@ def _timed_task(fn, t_submit: float, args, kwargs):
         m.observe("pool.task_run_s", time.perf_counter() - t0)
 
 
-def submit(pool: cf.ThreadPoolExecutor, fn, *args, **kwargs) -> cf.Future:
+def submit(pool: cf.ThreadPoolExecutor, fn, *args,
+           priority: str = "fg", **kwargs) -> cf.Future:
     """Context-carrying, histogram-instrumented submit — what every
     decode-path call site uses instead of bare ``pool.submit``:
 
@@ -81,11 +83,110 @@ def submit(pool: cf.ThreadPoolExecutor, fn, *args, **kwargs) -> cf.Future:
       (a bare submit silently falls back to the process-global Metrics
       and two concurrent engine batches smear into each other);
     - per-task queue-wait and run durations land in the
-      ``pool.task_wait_s`` / ``pool.task_run_s`` histograms.
+      ``pool.task_wait_s`` / ``pool.task_run_s`` histograms;
+    - ``priority="bg"`` routes the task through the background gate:
+      at most ``background_limit(pool)`` (a quarter of the workers,
+      min 1) background tasks occupy the pool concurrently, so serve
+      prefetch can soak idle decode capacity without ever starving
+      foreground admission — excess background work queues in FIFO
+      order and drains as permits free.
     """
+    if priority not in ("fg", "bg"):
+        from hadoop_bam_tpu.utils.errors import PlanError
+        raise PlanError(f"pool priority must be 'fg' or 'bg', "
+                        f"got {priority!r}")
     ctx = contextvars.copy_context()
     t_submit = time.perf_counter()
-    return pool.submit(ctx.run, _timed_task, fn, t_submit, args, kwargs)
+    if priority == "fg":
+        return pool.submit(ctx.run, _timed_task, fn, t_submit, args, kwargs)
+    fut: cf.Future = cf.Future()
+    from hadoop_bam_tpu.utils.metrics import METRICS
+    METRICS.count("pool.bg_submitted")
+    with _BG_LOCK:
+        _BG_QUEUE.append((pool, fut, ctx, fn, t_submit, args, kwargs))
+    _pump_background()
+    return fut
+
+
+# ---------------------------------------------------------------------------
+# background priority gate (serve prefetch rides this)
+# ---------------------------------------------------------------------------
+
+_BG_LOCK = threading.Lock()
+_BG_QUEUE: "collections.deque" = collections.deque()
+_BG_RUNNING = [0]
+
+
+def background_limit(pool: cf.ThreadPoolExecutor) -> int:
+    """Concurrent background tasks allowed in ``pool``: a quarter of the
+    workers (min 1), so >= 3/4 of the pool is always free the instant
+    foreground decode work arrives."""
+    size = int(getattr(pool, "_max_workers", 1) or 1)
+    return max(1, size // 4)
+
+
+def _run_background(fut: cf.Future, ctx, fn, t_submit, args, kwargs) -> None:
+    if not fut.set_running_or_notify_cancel():
+        return
+    try:
+        fut.set_result(ctx.run(_timed_task, fn, t_submit, args, kwargs))
+    except BaseException as e:  # noqa: BLE001 — crosses the thread
+        fut.set_exception(e)
+
+
+def _pump_background() -> None:
+    while True:
+        with _BG_LOCK:
+            if not _BG_QUEUE:
+                return
+            pool = _BG_QUEUE[0][0]
+            if _BG_RUNNING[0] >= background_limit(pool):
+                return
+            item = _BG_QUEUE.popleft()
+            _BG_RUNNING[0] += 1
+        _pool, fut, ctx, fn, t_submit, args, kwargs = item
+
+        def task(fut=fut, ctx=ctx, fn=fn, t_submit=t_submit, args=args,
+                 kwargs=kwargs):
+            try:
+                _run_background(fut, ctx, fn, t_submit, args, kwargs)
+            finally:
+                with _BG_LOCK:
+                    _BG_RUNNING[0] -= 1
+                _pump_background()
+
+        try:
+            _pool.submit(task)
+        except BaseException as e:  # noqa: BLE001 — pool shut down etc.
+            # the permit was taken above and `task` will never run its
+            # finally: give the permit back, fail the future (so waiters
+            # like Prefetcher.drain never hang), and keep pumping — a
+            # speculative submit must never wedge the gate or raise into
+            # a foreground serve path
+            with _BG_LOCK:
+                _BG_RUNNING[0] -= 1
+            if not fut.cancel():
+                try:
+                    fut.set_exception(e)
+                except Exception:  # noqa: BLE001 — already resolved
+                    pass
+
+
+def cancel_background() -> int:
+    """Cancel every QUEUED (not yet running) background task; returns the
+    number cancelled.  ``ServeLoop.stop`` / ``Prefetcher`` teardown use
+    this so a shutting-down server never keeps decoding regions nobody
+    will ask for."""
+    cancelled = 0
+    with _BG_LOCK:
+        while _BG_QUEUE:
+            _p, fut, *_rest = _BG_QUEUE.popleft()
+            if fut.cancel():
+                cancelled += 1
+    from hadoop_bam_tpu.utils.metrics import METRICS
+    if cancelled:
+        METRICS.count("pool.bg_cancelled", cancelled)
+    return cancelled
 
 
 def set_decode_pool(pool: Optional[cf.ThreadPoolExecutor],

@@ -1,0 +1,44 @@
+"""A tiny locked, bounded build-once cache for jitted step functions.
+
+Both the query engine's overlap predicate and the serve tier's tile
+filter key one compiled step per (mesh, axis) — a process cycling
+through many meshes must not grow those module caches forever (the
+SV801 discipline), and the logic (lock, double-check, FIFO evict) is
+identical.  One implementation, shared.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Hashable
+
+
+class BoundedStepCache:
+    """``get_or_build(key, build)``: returns the cached value or builds,
+    inserts (evicting oldest-inserted past ``cap``), and returns it.
+    ``build`` runs OUTSIDE the lock — jit construction is slow and must
+    not serialize unrelated lookups; two racing builders of the same key
+    both build, first insert wins for future callers."""
+
+    def __init__(self, cap: int = 8):
+        self.cap = max(1, int(cap))
+        self._lock = threading.Lock()
+        self._entries: Dict[Hashable, object] = {}
+
+    def get_or_build(self, key: Hashable, build: Callable[[], object]):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                return hit
+        value = build()
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                return existing
+            while len(self._entries) >= self.cap:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[key] = value
+        return value
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

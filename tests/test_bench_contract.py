@@ -29,6 +29,7 @@ def _fill_state(bench, n_notes=6):
         ("vcf_variants_per_sec", 507001.2, "variants/s", 1.5),
         ("bcf_variants_per_sec", 612345.7, "variants/s", 1.21),
         ("region_query_queries_per_sec", 41.7, "queries/s", 2.4),
+        ("region_serve_queries_per_sec", 200.3, "queries/s", 9.5),
         ("obs_overhead_pct", 1.3, "%", None),
         ("fastq_reads_per_sec", 188001.0, "reads/s", 2.37),
         ("bam_write_records_per_sec", 301222.5, "records/s", 2.1),
@@ -55,6 +56,16 @@ def _fill_state(bench, n_notes=6):
             row.update(cold_queries_per_sec=17.1, cache_hit_rate=0.93,
                        regions=250, records_matched=2_551_000,
                        latency_p50_ms=19.2, latency_p99_ms=88.4)
+        if m == "region_serve_queries_per_sec":
+            # the r11 serving row: tile-cache bypass + prefetch
+            # usefulness + client saturation ride the FULL row only
+            row.update(cold_queries_per_sec=23.6, tile_hit_rate=1.0,
+                       zipf_first_pass_hit_rate=0.9356,
+                       prefetch_hit_rate=0.28, prefetch_issued=168,
+                       latency_p50_ms=4.6, latency_p99_ms=9.3,
+                       cold_p50_ms=44.2, warm_host_decode_share=0.0,
+                       clients_qps=[[1, 196.0], [8, 188.9]],
+                       regions=250, distinct_windows=51)
         if m == "obs_overhead_pct":
             row.update(instrumented_s=0.1301, null_s=0.1284)
         comps.append(row)
@@ -135,6 +146,16 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     # r9: warm-pass latency percentiles from the query.latency_s
     # histogram ride the full region-query row
     assert rq["latency_p99_ms"] >= rq["latency_p50_ms"] > 0
+    # r11: the serving row pins the tile-cache bypass (hit rate, ~zero
+    # warm host-decode share), prefetch usefulness, and the 1->8 client
+    # saturation pairs — full row only, compact line keeps the number
+    rs = by_metric["region_serve_queries_per_sec"]
+    assert 0.0 <= rs["tile_hit_rate"] <= 1.0
+    assert 0.0 <= rs["prefetch_hit_rate"] <= 1.0
+    assert rs["warm_host_decode_share"] < 0.1
+    assert rs["cold_p50_ms"] > rs["latency_p50_ms"] > 0
+    assert [c for c, _q in rs["clients_qps"]] == [1, 8]
+    assert all(q > 0 for _c, q in rs["clients_qps"])
     ov = by_metric["obs_overhead_pct"]
     assert ov["instrumented_s"] > 0 and ov["null_s"] > 0
     line = json.dumps(bench._compact_snapshot(full))

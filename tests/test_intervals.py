@@ -237,6 +237,53 @@ def test_bai_round_trip_and_query(tmp_path):
     assert back.query(0, (1 << 28), (1 << 28) + 100) == []
 
 
+def test_bai_chunk_ends_are_block_aligned(tmp_path):
+    """Chunk END voffsets must carry real block-boundary coffsets: the
+    old final-record fallback packed (coffset+1, 0) — one BYTE past the
+    block start — which BGZFReader-based chunk reads tolerated by
+    accident but block-table consumers (plan_interval_spans ->
+    coverage's raw span fetch) died on mid-block with 'truncated BGZF
+    header'."""
+    from hadoop_bam_tpu.formats import bgzf
+    from hadoop_bam_tpu.split.bai import build_bai, plan_interval_spans
+    from hadoop_bam_tpu.split.intervals import resolve_interval
+    from hadoop_bam_tpu.utils.seekable import as_byte_source
+
+    path, header, _records = _sorted_bam(tmp_path)
+    idx = build_bai(path)
+    src = as_byte_source(path)
+
+    def at_block_boundary(coffset):
+        if coffset >= src.size:
+            return True
+        bgzf.parse_block_header(src.pread(coffset, 1 << 16), 0)
+        return True
+
+    n_chunks = 0
+    for ref in idx.refs:
+        for chunks in ref.bins.values():
+            for beg, end in chunks:
+                n_chunks += 1
+                assert at_block_boundary(beg >> 16)
+                assert at_block_boundary(end >> 16)
+    assert n_chunks > 0
+
+    # the exact failing composition: interval spans from the BAI feed
+    # the raw-fetch + block-table path (what coverage_file does)
+    from hadoop_bam_tpu.ops import inflate as inflate_ops
+    from hadoop_bam_tpu.parallel.pipeline import _fetch_span_raw
+
+    iv = resolve_interval(f"{header.ref_names[0]}:1-100000000",
+                          header.ref_names)
+    spans = plan_interval_spans(path, [iv], header, bai=idx)
+    assert spans
+    for span in spans:
+        raw, _end_block, _next_c = _fetch_span_raw(src, span)
+        table = inflate_ops.block_table(raw)   # raises on mid-block ends
+        assert int(table["isize"].sum()) > 0
+    src.close()
+
+
 def test_bai_split_trimming_matches_full_scan(tmp_path):
     import dataclasses
 

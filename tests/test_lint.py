@@ -575,6 +575,131 @@ GOLDEN = np.zeros(4, np.uint8).tobytes()
 
 
 # ---------------------------------------------------------------------------
+# serving-tier cache bounds (SV8xx)
+# ---------------------------------------------------------------------------
+
+_SV_BAD = '''
+from collections import OrderedDict
+
+_STEP_CACHE = {}                     # SV801: module dict, insert only
+
+def get_step(key, build):
+    if key not in _STEP_CACHE:
+        _STEP_CACHE[key] = build()
+    return _STEP_CACHE[key]
+
+class TileServer:
+    def __init__(self):
+        self.tile_cache = OrderedDict()   # SV801: never evicted
+        self.client_log = []              # SV802: append-only registry
+
+    def serve(self, key, tiles, who):
+        self.tile_cache[key] = tiles
+        self.client_log.append(who)
+        return self.tile_cache[key]
+'''
+
+_SV_CLEAN = '''
+import collections
+from collections import OrderedDict
+
+_STEP_CACHE = {}
+_CAP = 8
+
+def get_step(key, build):
+    if key not in _STEP_CACHE:
+        while len(_STEP_CACHE) >= _CAP:
+            _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
+        _STEP_CACHE[key] = build()
+    return _STEP_CACHE[key]
+
+class TileServer:
+    def __init__(self, budget):
+        self.tile_cache = OrderedDict()            # LRU: popitem below
+        self.recent_clients = collections.deque(maxlen=16)  # bounded
+        self._bytes, self.budget = 0, budget
+
+    def serve(self, key, tiles, nbytes, who):
+        self.tile_cache[key] = tiles
+        self.recent_clients.append(who)
+        self._bytes += nbytes
+        while self._bytes > self.budget and len(self.tile_cache) > 1:
+            _k, v = self.tile_cache.popitem(last=False)
+            self._bytes -= v.nbytes
+        return self.tile_cache[key]
+
+def working_state(items):
+    # locals are out of scope: they die with the call
+    batch_cache = {}
+    for k, v in items:
+        batch_cache[k] = v
+    return batch_cache
+'''
+
+
+def test_sv_seeded_violations_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/serve/bad_caches.py": _SV_BAD},
+        only=["servebounds"])
+    assert rules_of(findings) == {"SV801", "SV802"}
+    assert sum(f.rule == "SV801" for f in findings) == 2
+    assert sum(f.rule == "SV802" for f in findings) == 1
+    assert all(f.severity == "error" for f in findings)
+    assert any("popitem" in f.message or "LRU" in f.message
+               for f in findings)
+
+
+def test_sv_bounded_idioms_pass():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/query/good_caches.py": _SV_CLEAN},
+        only=["servebounds"])
+    assert findings == []
+
+
+def test_sv_reassignment_reset_counts_as_bound():
+    # draining by rebinding (self.pending = still_pending) is a bound
+    findings = lint_sources({"hadoop_bam_tpu/serve/drained.py": '''
+class Builder:
+    def __init__(self):
+        self.pending_tiles = []
+
+    def add(self, t):
+        self.pending_tiles.append(t)
+
+    def reap(self):
+        done = [t for t in self.pending_tiles if t.ready()]
+        self.pending_tiles = [t for t in self.pending_tiles
+                              if not t.ready()]
+        return done
+'''}, only=["servebounds"])
+    assert findings == []
+
+
+def test_sv_outside_query_and_serve_not_scoped():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/formats/elsewhere.py": _SV_BAD,
+         "hadoop_bam_tpu/parallel/elsewhere.py": _SV_BAD},
+        only=["servebounds"])
+    assert findings == []
+
+
+def test_sv_non_cacheish_names_not_flagged():
+    # plain working-state containers (no cache-ish name) stay out of
+    # scope even when append-only — the rule targets lookup structures
+    findings = lint_sources({"hadoop_bam_tpu/serve/state.py": '''
+class Loop:
+    def __init__(self):
+        self.results = {}
+        self.errors = []
+
+    def run(self, k, v, e):
+        self.results[k] = v
+        self.errors.append(e)
+'''}, only=["servebounds"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip / suppression
 # ---------------------------------------------------------------------------
 
