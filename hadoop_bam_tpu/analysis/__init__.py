@@ -14,6 +14,9 @@ Five AST analyzers over correctness regimes generic linters cannot see:
   ``np.frombuffer(...).copy()`` materializations of inflated spans on
   the decode hot path (every extra sweep is a DRAM pass the fused
   decode exists to remove)
+- ``devicesync``   (DV9xx) — per-iteration host syncs (``np.asarray``,
+  ``jax.device_get``, ``.item()``) in loops inside the device decode
+  plane (each one stalls the token-feed pipeline behind the link)
 
 Findings carry file:line, rule id and severity; ``analysis/baseline.json``
 suppresses accepted legacy findings so CI fails only on regressions.

@@ -158,8 +158,8 @@ def analyzers() -> Dict[str, Analyzer]:
     """Name -> analyzer map (importing the analyzer modules on demand)."""
     # import for registration side effects
     from hadoop_bam_tpu.analysis import (  # noqa: F401
-        decodepath, feedpath, layout, lockstep, obsrules, querycache,
-        servebounds, taxonomy, trace_safety,
+        decodepath, devicesync, feedpath, layout, lockstep, obsrules,
+        querycache, servebounds, taxonomy, trace_safety,
     )
     return dict(_REGISTRY)
 

@@ -1,0 +1,103 @@
+"""DV9xx — device-decode-plane sync discipline: no per-iteration host
+syncs on device arrays.
+
+The round-11 device decode plane exists so inflated bytes never touch the
+host on the stats paths: LZ77 resolve, the record walk and the
+fixed-field unpack all run on the mesh, and the ONLY things that come
+back are psum'd counters plus three walk scalars per device, drained in
+one bulk ``jax.device_get`` at the end.  Its founding anti-pattern is the
+prototype it replaced: a per-block ``np.asarray(resolve_tokens(...))``
+copy loop that synced the device once per 64 KiB block and serialized the
+whole plane behind the link.
+
+- DV901: inside the device decode plane (``ops/inflate_device.py`` and
+  ``parallel/pipeline.py``), a host-sync call — ``np.asarray``,
+  ``jax.device_get``, ``.item()``, ``.tolist()`` — in a ``for``/``while``
+  loop body.  Each iteration's sync is a full pipeline stall; batch the
+  fetch outside the loop (one ``device_get`` of the collected handles)
+  or keep the value on device.
+
+``inflate_span_device`` is exempt by name: its CONTRACT is returning
+host bytes (the library span-inflate entry point), so its chunk-granular
+``np.asarray`` is the API boundary, not a leak — the driver paths the
+plane actually runs through must never sync per iteration.  Loop context
+does not cross a nested function boundary (a closure defined inside a
+loop is dispatched later, not per iteration).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from hadoop_bam_tpu.analysis.astutil import last_segment
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/ops/inflate_device.py",
+         "hadoop_bam_tpu/parallel/pipeline.py")
+
+# host-boundary functions whose contract IS a host copy
+EXEMPT_FUNCTIONS = ("inflate_span_device",)
+
+# attribute-call names that force a device->host sync
+_SYNC_ATTRS = {"item", "tolist"}
+# module-function calls that force one: np.asarray(x), jax.device_get(x)
+_SYNC_CALLS = {"asarray": ("np", "numpy"), "device_get": ("jax",)}
+
+
+def _sync_call(node: ast.AST) -> str:
+    """Return a human name when ``node`` is a host-sync call, else ''."""
+    if not isinstance(node, ast.Call):
+        return ""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return ""
+    name = fn.attr
+    if name in _SYNC_ATTRS:
+        return f".{name}()"
+    roots = _SYNC_CALLS.get(name)
+    if roots and isinstance(fn.value, ast.Name) and fn.value.id in roots:
+        return f"{fn.value.id}.{name}()"
+    return ""
+
+
+def _finding(path: str, node: ast.AST, sync: str, ctx: str) -> Finding:
+    return Finding(
+        rule="DV901", severity="error", path=path, line=node.lineno,
+        message=f"per-iteration host sync '{sync}' inside a loop in the "
+                f"device decode plane ('{ctx}') — every iteration's sync "
+                f"stalls the token-feed pipeline; batch the fetch outside "
+                f"the loop (one jax.device_get of the collected handles) "
+                f"or keep the value on device")
+
+
+@register("devicesync")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+
+        def visit(node: ast.AST, in_loop: bool, exempt: bool,
+                  where: str) -> None:
+            sync = _sync_call(node)
+            if sync and in_loop and not exempt:
+                findings.append(_finding(m.path, node, sync, where))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # fresh scope: an enclosing loop does not make a nested
+                # function body per-iteration code
+                ex = node.name in EXEMPT_FUNCTIONS
+                for child in ast.iter_child_nodes(node):
+                    visit(child, False, ex, node.name)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                # the iterator expression evaluates ONCE — a bulk
+                # device_get fed to a for loop is the approved idiom
+                visit(node.iter, in_loop, exempt, where)
+                for part in (node.target, *node.body, *node.orelse):
+                    visit(part, True, exempt, where)
+            elif isinstance(node, ast.While):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, True, exempt, where)
+            else:
+                for child in ast.iter_child_nodes(node):
+                    visit(child, in_loop, exempt, where)
+
+        visit(m.tree, False, False, "<module>")
+    return findings

@@ -86,6 +86,10 @@ _HADOOP_KEY_MAP = {
     # reference's analog was per-block zlib-over-JNI with no fusion)
     "hbam.use-fused-decode": "use_fused_decode",
     "hbam.decode-chunk-blocks": "decode_chunk_blocks",
+    # decode-plane selection (ops/inflate_device.py + the pipeline
+    # token-feed path; no reference analog — the JNI inflate had exactly
+    # one implementation)
+    "hbam.inflate-backend": "inflate_backend",
     # region-query serving knobs (query/; no reference analog — Hadoop-BAM
     # only ever trimmed scan plans with intervals, it never served them)
     "hbam.query-cache-bytes": "query_cache_bytes",
@@ -193,6 +197,20 @@ class HBamConfig:
     #                                  enough to stay cache-resident and
     #                                  stream tiles before the span tail
     #                                  inflates)
+    inflate_backend: str = "auto"    # decode-plane selection:
+    #                                  "auto"   = probe once per process
+    #                                             and pick fused-native
+    #                                             vs the device plane
+    #                                             (resolve_inflate_backend)
+    #                                  "native" = host C++ inflate
+    #                                             (+ fused single-pass)
+    #                                  "zlib"   = Python zlib (portable;
+    #                                             disables the fused path)
+    #                                  "device" = token-feed device decode
+    #                                             plane (host Huffman
+    #                                             tokenize + on-mesh LZ77
+    #                                             resolve/walk/unpack) on
+    #                                             drivers that support it
 
     # --- region-query serving (query/) ---
     query_cache_bytes: int = 256 << 20  # decoded-chunk LRU byte budget
@@ -290,3 +308,49 @@ def _coerce(kwargs: dict) -> dict:
 
 
 DEFAULT_CONFIG = HBamConfig()
+
+
+# ---------------------------------------------------------------------------
+# Decode-plane selection.  ``inflate_backend="auto"`` resolves ONCE per
+# process: the probe (ops/inflate_device.probe_device_plane) times the
+# host Huffman tokenize stage against the device LZ77 resolve and picks
+# the device plane only when its pipelined wall (max of the two
+# overlapped stages) beats host inflate — which can never happen when
+# the "device" is the host CPU running XLA, so the CPU backend resolves
+# straight to "native" without paying the probe's jit compile.  Drivers
+# without a device plane treat "device" as "native" (each driver
+# documents its planes; flagstat is the token-feed pilot).
+# ---------------------------------------------------------------------------
+
+INFLATE_BACKENDS = ("auto", "native", "zlib", "device")
+
+_PLANE_CACHE: dict = {}
+
+
+def resolve_inflate_backend(config: "HBamConfig | None") -> str:
+    """Resolve a config's ``inflate_backend`` to a concrete plane name
+    ("native" | "zlib" | "device").  "auto" probes once per process."""
+    backend = getattr(config, "inflate_backend", "auto") \
+        if config is not None else "auto"
+    if backend not in INFLATE_BACKENDS:
+        # PLAN class: a bad plane name is run configuration, not data —
+        # never retried, never quarantined (utils/errors classifies
+        # PlanError by type; imported lazily to keep this module light)
+        from hadoop_bam_tpu.utils.errors import PlanError
+        raise PlanError(
+            f"unknown inflate backend {backend!r}; "
+            f"expected one of {INFLATE_BACKENDS}")
+    if backend != "auto":
+        return backend
+    if "auto" not in _PLANE_CACHE:
+        _PLANE_CACHE["auto"] = _probe_auto_plane()
+    return _PLANE_CACHE["auto"]
+
+
+def _probe_auto_plane() -> str:
+    try:
+        from hadoop_bam_tpu.ops.inflate_device import probe_device_plane
+        probe = probe_device_plane()
+        return "device" if probe.get("device_wins") else "native"
+    except Exception:  # noqa: BLE001 — selection must never fail a run
+        return "native"

@@ -21,6 +21,7 @@ import dataclasses
 import functools
 import logging
 import os
+import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -30,9 +31,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hadoop_bam_tpu.parallel.mesh import shard_map
-from hadoop_bam_tpu.parallel.staging import FeedPipeline, TileSpec, bucket_cap
+from hadoop_bam_tpu.parallel.staging import (
+    FeedPipeline, StagingRing, TileSpec, _block_in_flight, bucket_cap,
+)
 
-from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.config import (
+    DEFAULT_CONFIG, HBamConfig, resolve_inflate_backend,
+)
 from hadoop_bam_tpu.formats.bam import SAMHeader
 from hadoop_bam_tpu.ops import inflate as inflate_ops
 from hadoop_bam_tpu.ops.flagstat import flagstat_from_columns
@@ -1168,9 +1173,16 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     pool = decode_pool(config)
     window = max(1, prefetch) * decode_pool_size(config)
 
+    # the payload family has no device plane (seq/qual are variable-
+    # length series the token-feed step doesn't pack); "device" rides
+    # the host planes here, "zlib"/"native" are honored as asked
+    backend = resolve_inflate_backend(config)
+    host_backend = "auto" if backend == "device" else backend
+
     # same chunk-streaming shape as flagstat_file: fused spans hand their
     # prefix/seq/qual chunks to the packer as the native walk lands them
-    stream_fused = _fused_stream_gate(config, intervals)
+    stream_fused = (_fused_stream_gate(config, intervals)
+                    and _use_fused(config, host_backend))
     if stream_fused:
         window = _stream_window(window)
 
@@ -1186,7 +1198,7 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                             config=_fused_off(config))[:3],
                         s, config))
             prefix, seq, qual, _v = decode_span_payload_host(
-                src, s, geometry, check_crc,
+                src, s, geometry, check_crc, host_backend,
                 intervals=intervals, header=header, config=config)
             return prefix, seq, qual
         with METRICS.timer("pipeline.host_decode"), \
@@ -1238,9 +1250,11 @@ class _StatTotals:
         tf = np.zeros(np.shape(f0), np.float64)
         ti = np.zeros(np.shape(i0), np.int64)
         with METRICS.span("pipeline.combine_wall", groups=len(self._pairs)):
-            for f, i in self._pairs:
-                tf += np.asarray(jax.device_get(f), np.float64)
-                ti += np.asarray(jax.device_get(i), np.int64)
+            # ONE bulk device_get for every queued group (a per-group
+            # fetch in the loop is a sync per group — DV901's territory)
+            for f, i in jax.device_get(self._pairs):
+                tf += f
+                ti += i
         return tf, ti
 
 
@@ -1639,6 +1653,392 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     return _attach_quarantine(_payload_stats_result(totals), quarantine)
 
 
+# ---------------------------------------------------------------------------
+# Device decode plane: the token-feed path (ops/inflate_device.py).
+#
+# Where the host planes inflate spans on CPU and ship packed ROW tiles, the
+# device plane ships LZ77 TOKEN chunks: pool workers run the bit-serial
+# native Huffman tokenize (the only unvectorizable half of inflate, CRC
+# folded in when asked) and the mesh step does everything else — LZ77
+# resolve, contiguous pack, the record walk (pointer doubling over the
+# block_size chain) and the FIXED_FIELDS unpack — so the inflated bytes
+# NEVER exist on the host on this path.  Chunks ride the existing
+# StagingRing with per-slot in-flight handles: host tokenize of group k+1
+# overlaps device resolve+unpack of group k.
+#
+# Spans whose final record is cut at the buffer end (and the remainder of
+# spans wider than the block ladder) complete through a host FIXUP decode
+# at drain time — the device reports each chunk's walk tail, and records
+# starting in [tail, span end) go through the ordinary projected-row host
+# path.  flagstat is the pilot driver; selection is config.inflate_backend
+# ("auto" probes once per process — see config.resolve_inflate_backend).
+# ---------------------------------------------------------------------------
+
+# widest token chunk one device step takes: 64 BGZF blocks (~4 MiB
+# inflated at the 64 KiB ladder rung).  Spans wider than this stream
+# their first 64 blocks through the device and the rest through the
+# host fixup, so the plane degrades gracefully instead of erroring.
+DEVICE_PLANE_MAX_BLOCKS = 64
+# compressed span grain the plane plans at when the caller didn't pin a
+# plan: small enough that a span's token chunk fits the ladder with room
+# to spare, big enough to amortize per-span Python overhead
+DEVICE_PLANE_SPAN_BYTES = 512 << 10
+
+
+@dataclasses.dataclass
+class _TokenChunk:
+    """One span's host-tokenized device-plane unit (<= MAX_BLOCKS blocks)."""
+    tokens: np.ndarray     # [used, P] u32 LZ77 tokens
+    n_tokens: np.ndarray   # [used] i32
+    isize: np.ndarray      # [used] i32
+    start: int             # record-walk start (inflated chunk coords)
+    stop: int              # ownership limit (records starting < stop)
+    used: int              # blocks tokenized for the device
+    P: int                 # ladder rung (token pad == per-block bytes)
+    n_blocks: int          # blocks in the WHOLE span (> used: host fixup)
+    span: FileVirtualSpan
+    ubase: np.ndarray      # [n_blocks+1] i64 inflated block starts
+    abs_coffs: np.ndarray  # [n_blocks] i64 absolute compressed offsets
+
+    def fixup_span(self, tail: int) -> FileVirtualSpan:
+        """The host-decoded remainder: records starting in
+        [tail, span end) — the cut final record, plus every block past
+        the device chunk for over-wide spans."""
+        blk = int(np.searchsorted(self.ubase[1:], tail, side="right"))
+        blk = min(blk, self.n_blocks - 1)
+        u = int(tail - self.ubase[blk])
+        start_v = (int(self.abs_coffs[blk]) << 16) | u
+        return FileVirtualSpan(self.span.path, start_v,
+                               self.span.end_voffset)
+
+
+def _tokenize_span_tokens(src, span: FileVirtualSpan,
+                          check_crc: bool = False
+                          ) -> Optional[_TokenChunk]:
+    """Host half of the device plane for one span: fetch + block table +
+    threaded native Huffman tokenize (CRC folded in when ``check_crc``).
+    BGZF-level faults (DEFLATE corruption, ISIZE, CRC) raise BGZFError
+    HERE, inside the retry boundary — exactly where the host planes
+    raise them.  Returns None for an empty span."""
+    from hadoop_bam_tpu.ops.inflate_device import ladder_pow2
+    from hadoop_bam_tpu.utils import native
+
+    src = as_byte_source(src)
+    raw, end_block_size, _next_c = _fetch_span_raw(src, span)
+    METRICS.count("pipeline.spans")
+    if not raw:
+        return None
+    table = inflate_ops.block_table(raw)
+    isize = table["isize"]
+    n = int(isize.size)
+    used = min(n, DEVICE_PLANE_MAX_BLOCKS)
+    src_arr = np.frombuffer(raw, dtype=np.uint8)
+    sub = isize[:used]
+    P = ladder_pow2(max(16, int(sub.max())))
+    with METRICS.span("bam.tokenize_wall", nbytes=len(raw), blocks=used):
+        try:
+            out = native.deflate_tokenize_batch(
+                src_arr, table["cdata_off"][:used],
+                table["cdata_len"][:used], P, 0, with_crc=check_crc)
+        except ValueError as e:
+            # same class as the host inflate backends: bad DEFLATE bytes
+            # are BGZF-level corruption whichever plane finds them
+            from hadoop_bam_tpu.formats import bgzf
+            raise bgzf.BGZFError(str(e)) from e
+    tokens, n_tokens, out_lens = out[:3]
+    if not np.array_equal(out_lens, sub):
+        from hadoop_bam_tpu.formats import bgzf
+        bad = int(np.nonzero(out_lens != sub)[0][0])
+        raise bgzf.BGZFError(
+            f"ISIZE mismatch in block {bad}: tokenized "
+            f"{int(out_lens[bad])}, footer says {int(sub[bad])}")
+    if check_crc:
+        expect = inflate_ops.footer_crcs(src_arr, table)[:used]
+        mism = np.nonzero(out[3] != expect)[0]
+        if mism.size:
+            from hadoop_bam_tpu.formats import bgzf
+            raise bgzf.BGZFError(
+                f"CRC32 mismatch in block(s) {mism[:8].tolist()}")
+    ub = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(isize, out=ub[1:])
+    if used == n and end_block_size:
+        stop = int(ub[n]) - int(isize[-1]) + span.end[1]
+    elif used == n:
+        stop = int(ub[n])
+    else:
+        stop = int(ub[used])
+    METRICS.count("pipeline.blocks", used)
+    METRICS.count("pipeline.inflated_bytes", int(ub[used]))
+    return _TokenChunk(tokens=tokens, n_tokens=n_tokens, isize=sub,
+                       start=span.start[1], stop=stop, used=used, P=P,
+                       n_blocks=n, span=span, ubase=ub,
+                       abs_coffs=table["coffset"] + span.start[0])
+
+
+def make_device_flagstat_step(mesh: Mesh, axis: str = "data") -> Callable:
+    """Jitted sharded step over token chunks: (tokens [n, B, P] u32,
+    n_tokens [n, B], isize [n, B], meta [n, 1, 2] (start, stop)) ->
+    (psum'd flagstat vector, per-device n_all / tail / bad).  The whole
+    decode — LZ77 resolve, contiguous pack, record walk, fixed-field
+    unpack, flagstat reduce — happens in the one jitted call; only the
+    16 counters and three walk scalars per device ever come back."""
+    key = ("device_flagstat", tuple(mesh.devices.flat), mesh.axis_names,
+           axis)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+
+    from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS
+    from hadoop_bam_tpu.ops.inflate_device import resolve_walk_fields
+
+    def per_device(tokens, n_tokens, isize, meta):
+        tokens, n_tokens = tokens[0], n_tokens[0]
+        isize, meta = isize[0], meta[0]
+        cols, valid, n_all, tail, bad = resolve_walk_fields(
+            tokens, n_tokens, isize, meta[0, 0], meta[0, 1])
+        stats = flagstat_from_columns(cols, valid)
+        vec = jnp.stack([stats[k] for k in FLAGSTAT_FIELDS])
+        return (jax.lax.psum(vec, axis),
+                n_all[None], tail[None], bad[None])
+
+    # check_vma=False: the while_loops inside the resolve and the walk
+    # have no varying-mesh-axes replication rule (same reason the Pallas
+    # seq-stats step opts out)
+    fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis),) * 4,
+                   out_specs=(P(), P(axis), P(axis), P(axis)),
+                   check_vma=False)
+    step = jax.jit(fn)
+    _STEP_CACHE[key] = step
+    return step
+
+
+def _flagstat_device_plane(path: str, mesh: Mesh, config: HBamConfig,
+                           header: SAMHeader,
+                           spans: Optional[Sequence[FileVirtualSpan]],
+                           quarantine: Optional[QuarantineManifest],
+                           prefetch: int = 2) -> Dict[str, int]:
+    """flagstat through the token-feed device decode plane.
+
+    Pool workers tokenize spans (bam.tokenize_wall) while this thread
+    packs token chunks into StagingRing slots and dispatches the fused
+    resolve+walk+unpack step (bam.device_resolve_wall, stage timer
+    pipeline.device_inflate) — tokenize of group k+1 overlaps device
+    decode of group k, and the ring's per-slot in-flight handles keep a
+    buffer from being overwritten while its transfer is still reading.
+    Walk tails drain once at the end; cut final records and over-wide
+    spans complete through the host projected-row fixup path."""
+    from hadoop_bam_tpu.ops.flagstat import FLAGSTAT_FIELDS
+    from hadoop_bam_tpu.ops.inflate_device import records_cap
+    from hadoop_bam_tpu.ops.rans import _round_pow2
+    from hadoop_bam_tpu.utils import native
+    from hadoop_bam_tpu.utils.errors import CorruptDataError
+
+    if not native.available():
+        raise PlanError(
+            "inflate_backend='device' needs the native tokenizer "
+            "(hbam_deflate_tokenize_batch); native library unavailable")
+    n_dev = int(np.prod(mesh.devices.shape))
+    if spans is None:
+        src0 = as_byte_source(path)
+        n_spans = max(n_dev, int(np.ceil(src0.size
+                                         / DEVICE_PLANE_SPAN_BYTES)))
+        src0.close()
+        from hadoop_bam_tpu.split.planners import plan_spans_cached
+        with METRICS.span("bam.plan_wall", spans=n_spans):
+            spans = plan_spans_cached(path, header, config,
+                                      num_spans=n_spans)
+    spans = list(spans)
+    if quarantine is not None and quarantine.total_spans is None:
+        quarantine.total_spans = len(spans)
+    check_crc = bool(getattr(config, "check_crc", False))
+    step = make_device_flagstat_step(mesh)
+    sharding = NamedSharding(mesh, P("data"))
+    src = _resilient_source(path, config)
+    pool = decode_pool(config)
+    window = max(1, prefetch) * decode_pool_size(config)
+    ring_slots = int(getattr(config, "feed_ring_slots", 2))
+    # the ring is sized LAZILY to the ladder shapes the plan actually
+    # produces (worst case [n_dev, 64, 65536] u32 is a quarter GB of
+    # token staging on a wide mesh; a small-block plan needs a tiny
+    # fraction of that).  Growing mints a fresh ring after draining the
+    # old slots' in-flight handles — shapes only cross a ladder rung a
+    # bounded number of times per run.
+    ring_state: Dict[str, object] = {"ring": None, "B": 0, "P": 0}
+    cancel = threading.Event()
+    totals_vec = None
+    pending: List[Tuple] = []          # (handles, chunks, records cap)
+
+    def get_ring(B: int, Pg: int) -> StagingRing:
+        ring = ring_state["ring"]
+        if ring is not None and B <= ring_state["B"] \
+                and Pg <= ring_state["P"]:
+            return ring
+        if ring is not None:
+            for slot in ring.slots:
+                if slot.in_flight is not None:
+                    _block_in_flight(slot.in_flight)
+                    slot.in_flight = None
+        ring_state["B"] = max(B, int(ring_state["B"]))
+        ring_state["P"] = max(Pg, int(ring_state["P"]))
+        ring_state["ring"] = StagingRing(
+            n_dev, int(ring_state["B"]),
+            [TileSpec((int(ring_state["P"]),), np.uint32),  # tokens
+             TileSpec((), np.int32),                        # n_tokens
+             TileSpec((), np.int32),                        # isize
+             TileSpec((2,), np.int32)],          # row 0: (start, stop)
+            slots=ring_slots)
+        return ring_state["ring"]
+
+    def decode(span):
+        def inner(s):
+            return _tokenize_span_tokens(src, s, check_crc)
+        with METRICS.timer("pipeline.host_decode"), \
+                METRICS.wall_timer("pipeline.host_decode_wall"), \
+                METRICS.span("bam.host_decode_wall"):
+            return decode_with_retry(inner, span, config,
+                                     quarantine=quarantine)
+
+    def dispatch_group(group: List[_TokenChunk]) -> None:
+        nonlocal totals_vec
+        B = max(_round_pow2(c.used, 8) for c in group)
+        Pg = max(c.P for c in group)
+        slot = get_ring(B, Pg).lease(cancel)
+        if slot.in_flight is not None:
+            # the slot's previous dispatch may still be transferring from
+            # — or, on the CPU backend, COMPUTING OVER an alias of —
+            # these buffers; the wait is time spent on device resolve of
+            # an earlier group, so it accrues to the resolve wall
+            with METRICS.timer("pipeline.device_inflate"), \
+                    METRICS.span("bam.device_resolve_wall", wait=True), \
+                    METRICS.span("staging.transfer_wait"):
+                _block_in_flight(slot.in_flight)
+            slot.in_flight = None
+        tok, nt, isz, meta = slot.arrays
+        for dev in range(n_dev):
+            if dev < len(group):
+                c = group[dev]
+                tok[dev, :c.used, :c.P] = c.tokens
+                nt[dev, :c.used] = c.n_tokens
+                isz[dev, :c.used] = c.isize
+                if c.used < B:
+                    # stale token rows are inert under n_tokens == 0 and
+                    # isize == 0; only the masks need zeroing
+                    nt[dev, c.used:B] = 0
+                    isz[dev, c.used:B] = 0
+                meta[dev, 0, 0] = c.start
+                meta[dev, 0, 1] = c.stop
+            else:
+                nt[dev, :B] = 0
+                isz[dev, :B] = 0
+                meta[dev, 0] = 0
+        views = (tok[:, :B, :Pg], nt[:, :B], isz[:, :B], meta[:, :1])
+        with METRICS.timer("pipeline.device_inflate"), \
+                METRICS.span("bam.device_resolve_wall",
+                             blocks=int(sum(c.used for c in group))):
+            args = [jax.device_put(v, sharding) for v in views]
+            vec, n_all, tails, bad = step(*args)
+            totals_vec = vec if totals_vec is None \
+                else _ADD(totals_vec, vec)
+        METRICS.count("pipeline.dispatch_bytes",
+                      sum(int(v.nbytes) for v in views))
+        # the slot's in-flight handle carries the step OUTPUTS, not just
+        # the transferred inputs: a [:, :B, :P] view of a ring slot is a
+        # CONTIGUOUS prefix, which CPU jax.device_put may zero-copy
+        # alias — the resolve step would then still be reading the
+        # buffer when the next group's pack overwrites it.  Waiting on
+        # the outputs means the compute (hence every read of the
+        # aliased memory) has finished before the slot is reused.
+        slot.in_flight = (tuple(args), (vec, n_all, tails, bad))
+        slot.release()
+        pending.append(((n_all, tails, bad), list(group),
+                        records_cap(B, Pg)))
+
+    group: List[_TokenChunk] = []
+    try:
+        for chunk in _iter_windowed(pool, spans, decode, window):
+            if chunk is None:
+                continue
+            group.append(chunk)
+            if len(group) == n_dev:
+                dispatch_group(group)
+                group = []
+        if group:
+            dispatch_group(group)
+    finally:
+        cancel.set()
+
+    # one bulk device_get drains every group's walk scalars (a per-group
+    # fetch in the loop would sync the pipeline it exists to overlap);
+    # the block accrues to the resolve wall — it IS waiting for the
+    # device to finish the outstanding groups
+    with METRICS.timer("pipeline.device_inflate"), \
+            METRICS.span("bam.device_resolve_wall", drain=True):
+        fetched = jax.device_get([p[0] for p in pending]) if pending \
+            else []
+    fix_spans: List[FileVirtualSpan] = []
+    n_records = 0
+    for (n_all, tails, bad), chunks, rec_cap in (
+            (f, p[1], p[2]) for f, p in zip(fetched, pending)):
+        for dev, c in enumerate(chunks):
+            if int(bad[dev]):
+                raise CorruptDataError(
+                    f"malformed BAM record chain in span {c.span}")
+            if int(n_all[dev]) > rec_cap:
+                raise CorruptDataError(
+                    f"record count {int(n_all[dev])} exceeds capacity "
+                    f"{rec_cap} in span {c.span}")
+            n_records += int(n_all[dev])
+            tail = int(tails[dev])
+            if tail < c.stop or c.used < c.n_blocks:
+                fix_spans.append(c.fixup_span(tail))
+    METRICS.count("pipeline.records", n_records)
+
+    if fix_spans:
+        # host fixup: the cut/remainder records go through the ordinary
+        # projected-row plane and the cached flagstat tile step
+        projection = FLAGSTAT_PROJECTION
+        row_bytes = projection_row_bytes(projection)
+        tile_step = make_flagstat_tile_step(mesh, projection=projection)
+
+        def fix_rows():
+            for fs in fix_spans:
+                def inner(s):
+                    return decode_span_prefix_host(
+                        src, s, check_crc, "auto", projection,
+                        want_voffs=False, header=header, config=config)[0]
+                with METRICS.timer("pipeline.host_decode"), \
+                        METRICS.wall_timer("pipeline.host_decode_wall"), \
+                        METRICS.span("bam.host_decode_wall"):
+                    rows = decode_with_retry(inner, fs, config,
+                                             quarantine=quarantine)
+                yield ((rows if rows is not None
+                        else np.empty((0, row_bytes), np.uint8)),)
+
+        fp = FeedPipeline(n_dev, 4096, (TileSpec((row_bytes,), np.uint8),),
+                          balance=True, config=config, fmt="bam")
+
+        def fix_dispatch(arrays, counts):
+            nonlocal totals_vec
+            t = jax.device_put(arrays[0], sharding)
+            cc = jax.device_put(counts, sharding)
+            with METRICS.span("bam.kernel_wall"):
+                v = tile_step(t, cc)
+                totals_vec = v if totals_vec is None \
+                    else _ADD(totals_vec, v)
+            return t, cc
+
+        fp.feed(fix_rows(), fix_dispatch)
+
+    if totals_vec is None:
+        host = np.zeros(len(FLAGSTAT_FIELDS), dtype=np.int64)
+    else:
+        with METRICS.timer("pipeline.device_drain"), \
+                METRICS.span("bam.combine_wall"):
+            host = np.asarray(jax.device_get(totals_vec), dtype=np.int64)
+    return _attach_quarantine(
+        {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)},
+        quarantine)
+
+
 def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                   config: HBamConfig = DEFAULT_CONFIG,
                   geometry: Optional[DecodeGeometry] = None,
@@ -1672,6 +2072,18 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     if header is None:
         header, _ = read_bam_header(path)
 
+    backend = resolve_inflate_backend(config)
+    intervals = parse_config_intervals(config, header)
+    if (backend == "device" and intervals is None
+            and not getattr(config, "skip_bad_spans", False)):
+        # the token-feed device decode plane (resolve+walk+unpack on the
+        # mesh).  Interval filtering needs whole-span offsets and
+        # skip_bad_spans needs span-granular quarantine — both fall back
+        # to the host planes, same gating as fused chunk streaming.
+        return _flagstat_device_plane(path, mesh, config, header, spans,
+                                      quarantine, prefetch=prefetch)
+    host_backend = "auto" if backend == "device" else backend
+
     if spans is None:
         # Span size trades host-decode parallelism (smaller = more threads
         # busy) against per-span Python overhead; tiles repack across span
@@ -1700,7 +2112,6 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     window = max(1, prefetch) * decode_pool_size(config)
     totals_vec = None
     check_crc = bool(getattr(config, "check_crc", False))
-    intervals = parse_config_intervals(config, header)
 
     # Chunk-streamed fused decode: each pool worker starts its span's
     # native job (fetch inside the retry boundary) and hands back a lazy
@@ -1710,7 +2121,8 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     # needs span-granular quarantine (a streamed span's early chunks
     # would already be dispatched when a late chunk turns out corrupt)
     # or when interval filtering needs the whole span's offsets.
-    stream_fused = _fused_stream_gate(config, intervals)
+    stream_fused = (_fused_stream_gate(config, intervals)
+                    and _use_fused(config, host_backend))
     if stream_fused:
         window = _stream_window(window)
     ranges = projection_ranges(projection)
@@ -1727,12 +2139,12 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                     check_crc=check_crc, config=config,
                     fallback_fn=lambda: decode_with_retry(
                         lambda s2: (decode_span_prefix_host(
-                            src, s2, check_crc, "auto", projection,
+                            src, s2, check_crc, host_backend, projection,
                             want_voffs=False, header=header,
                             config=_fused_off(config))[0],),
                         s, config))
             rows, _voffs = decode_span_prefix_host(
-                src, s, check_crc, "auto", projection,
+                src, s, check_crc, host_backend, projection,
                 want_voffs=False, intervals=intervals, header=header,
                 config=config)
             return rows
@@ -1811,14 +2223,18 @@ def decode_span_cigar_rows(source, span: FileVirtualSpan, max_cigar: int,
     span-retry boundary (a user-parameter error must not be retried or
     skip_bad_spans-eaten as corruption).
     """
+    # coverage has no device plane (the cigar series is variable-length);
+    # "device" rides the host planes, "zlib"/"native" are honored
+    backend = resolve_inflate_backend(config)
+    host_backend = "auto" if backend == "device" else backend
     got = _decode_span_fused(source, span, "offsets", check_crc=check_crc,
                              want_voffs=False, config=config) \
-        if _use_fused(config) else None
+        if _use_fused(config, host_backend) else None
     if got is not None:
         d, o, _voffs, _ = got      # fused: inflate+walk+CRC in one sweep
     else:
-        d, o, _voffs, _ = _decode_span_core(source, span, check_crc, "auto",
-                                            want_voffs=False)
+        d, o, _voffs, _ = _decode_span_core(source, span, check_crc,
+                                            host_backend, want_voffs=False)
     c = o.size
     w = _cigar_row_bytes(max_cigar)
     rows = np.zeros((c, w), dtype=np.uint8)

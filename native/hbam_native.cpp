@@ -1050,8 +1050,41 @@ int hbam_fused_finish(void* h, int64_t* tail, int64_t* n_rows,
   return rc;
 }
 
+// Resolve a block's LZ77 tokens into ``scratch`` (grown as needed) and
+// return the CRC32 of the inflated bytes — the tokenize-time CRC fold for
+// the device decode plane.  The resolved bytes are a thread-local
+// throwaway: the device resolves its own copy, this exists only so
+// check_crc can be verified against the BGZF footer WITHOUT a host
+// inflate pass materializing in the pipeline (the resolve here is
+// cache-resident and far cheaper than the Huffman stage just paid).
+static uint32_t hbam_tokens_crc32(const uint32_t* toks, int64_t nt,
+                                  int64_t out_len,
+                                  std::vector<uint8_t>* scratch) {
+  if (static_cast<int64_t>(scratch->size()) < out_len)
+    scratch->resize(static_cast<size_t>(out_len));
+  uint8_t* out = scratch->data();
+  int64_t p = 0;
+  for (int64_t t = 0; t < nt; ++t) {
+    const uint32_t tok = toks[t];
+    if (tok & 0x80000000u) {
+      const int64_t length = (tok >> 16) & 0x1FF;
+      const int64_t dist = (tok & 0xFFFFu) + 1;
+      // overlapping copies (dist < length) must run byte-serial
+      const uint8_t* s = out + p - dist;
+      for (int64_t k = 0; k < length; ++k) out[p + k] = s[k];
+      p += length;
+    } else {
+      out[p++] = static_cast<uint8_t>(tok & 0xFF);
+    }
+  }
+  return static_cast<uint32_t>(
+      crc32(0L, out, static_cast<uInt>(out_len)));
+}
+
 // Threaded batch tokenize over independent blocks (same pool shape as
 // hbam_inflate_batch).  tokens is [n_blocks, tok_stride] row-major.
+// out_crcs (nullable): per-block CRC32 of the inflated bytes, folded in
+// at tokenize time from a thread-local resolve scratch.
 // Returns 0, or (1000 + first failing block index + 1000000 * -rc) so the
 // caller can recover both which block failed and why (rc per
 // hbam_deflate_tokenize: -1 truncated, -2 malformed, -3 token capacity,
@@ -1060,11 +1093,12 @@ int hbam_deflate_tokenize_batch(const uint8_t* src, const int64_t* off,
                                 const int32_t* len, int32_t n_blocks,
                                 uint32_t* tokens, int64_t tok_stride,
                                 int32_t* n_tokens, int32_t* out_lens,
-                                int32_t n_threads) {
+                                uint32_t* out_crcs, int32_t n_threads) {
   if (n_threads < 1) n_threads = 1;
   std::atomic<int32_t> next(0);
   std::atomic<int32_t> fail(-1);
   auto worker = [&]() {
+    std::vector<uint8_t> scratch;
     for (;;) {
       const int32_t i = next.fetch_add(1);
       if (i >= n_blocks || fail.load(std::memory_order_relaxed) >= 0) break;
@@ -1080,6 +1114,9 @@ int hbam_deflate_tokenize_batch(const uint8_t* src, const int64_t* off,
       }
       n_tokens[i] = static_cast<int32_t>(nt);
       out_lens[i] = static_cast<int32_t>(ol);
+      if (out_crcs)
+        out_crcs[i] = hbam_tokens_crc32(
+            tokens + static_cast<int64_t>(i) * tok_stride, nt, ol, &scratch);
     }
   };
   if (n_threads == 1) {

@@ -31,6 +31,7 @@ def _fill_state(bench, n_notes=6):
         ("region_query_queries_per_sec", 41.7, "queries/s", 2.4),
         ("region_serve_queries_per_sec", 200.3, "queries/s", 9.5),
         ("obs_overhead_pct", 1.3, "%", None),
+        ("device_inflate_records_per_sec", 93211.4, "records/s", 0.42),
         ("fastq_reads_per_sec", 188001.0, "reads/s", 2.37),
         ("bam_write_records_per_sec", 301222.5, "records/s", 2.1),
         ("deflate_tokenize_gbps", 0.41, "GB/s", 0.8),
@@ -68,6 +69,22 @@ def _fill_state(bench, n_notes=6):
                        regions=250, distinct_windows=51)
         if m == "obs_overhead_pct":
             row.update(instrumented_s=0.1301, null_s=0.1284)
+        if m == "device_inflate_records_per_sec":
+            # r11: the decode-plane wall breakdown (tokenize vs on-mesh
+            # resolve and their overlap) rides the FULL row only
+            row.update(
+                fused_records_per_sec=221931.0, records=24000, spans=12,
+                decode_plane_walls={
+                    "device": {"tokenize_wall_s": 0.083,
+                               "device_resolve_wall_s": 0.211,
+                               "overlap_s": 0.064,
+                               "overlap_efficiency": 0.77,
+                               "nonoverlap_inflate_share": 0.071},
+                    "fused": {"fused_decode_wall_s": 0.0718,
+                              "dispatch_wall_s": 0.0441,
+                              "overlap_s": 0.011,
+                              "overlap_efficiency": 0.25,
+                              "nonoverlap_inflate_share": 0.56}})
         comps.append(row)
     comps.append({"metric": "broken_row", "error": "RuntimeError: boom"})
     comps.append({"metric": "late_row", "skipped": "deadline"})
@@ -158,10 +175,23 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     assert all(q > 0 for _c, q in rs["clients_qps"])
     ov = by_metric["obs_overhead_pct"]
     assert ov["instrumented_s"] > 0 and ov["null_s"] > 0
+    # r12: the device decode plane row pins the tokenize / device-resolve
+    # wall breakdown and overlap accounting — full row only, the compact
+    # line keeps just the rate
+    di = by_metric["device_inflate_records_per_sec"]
+    planes = di["decode_plane_walls"]
+    assert set(planes) == {"device", "fused"}
+    dv = planes["device"]
+    assert dv["tokenize_wall_s"] > 0 and dv["device_resolve_wall_s"] > 0
+    assert 0.0 <= dv["overlap_efficiency"] <= 1.0
+    assert 0.0 <= dv["nonoverlap_inflate_share"] <= 1.0
+    assert 0.0 <= planes["fused"]["nonoverlap_inflate_share"] <= 1.0
+    assert di["fused_records_per_sec"] > 0 and di["spans"] > 0
     line = json.dumps(bench._compact_snapshot(full))
     assert len(line) <= bench.FINAL_LINE_BUDGET
-    assert json.loads(line)["components"][
-        "region_query_queries_per_sec"] == 41.7
+    out = json.loads(line)
+    assert out["components"]["region_query_queries_per_sec"] == 41.7
+    assert out["components"]["device_inflate_records_per_sec"] == 93211.4
 
 
 def test_latency_component_dropped_before_components(bench):

@@ -4,7 +4,15 @@ Parity oracle is zlib — every payload below must survive
 compress -> tokenize -> device-resolve -> compare against the original
 bytes, across all DEFLATE block types (stored / fixed / dynamic), deep
 copy chains, and multi-block streams (SURVEY.md section 2.8 row 1: the
-zlib-JNI inflate the reference leaned on, section 7 hard part #1)."""
+zlib-JNI inflate the reference leaned on, section 7 hard part #1).
+
+The round-11 additions cover the production device decode plane:
+byte identity vs the zlib oracle over randomized split offsets and
+BCF/tabix-shaped BGZF containers, byte-flip fuzz pinning identical error
+classes on both planes, the tokenize-time CRC fold, the pow2 shape
+ladder's jit-cache bound, and the token-feed flagstat driver (walk +
+unpack on device, host fixup for cut/over-wide spans)."""
+import dataclasses
 import io
 import random
 import zlib
@@ -16,12 +24,16 @@ import pytest
 from hadoop_bam_tpu.formats import bgzf
 from hadoop_bam_tpu.ops.inflate import inflate_span
 from hadoop_bam_tpu.ops.inflate_device import (
-    inflate_span_device, resolve_tokens,
+    inflate_span_device, ladder_pow2, resolve_tokens,
+    resolve_tokens_packed,
 )
 from hadoop_bam_tpu.utils import native
 
-pytestmark = pytest.mark.skipif(
-    not native.available(), reason="native tokenizer unavailable")
+pytestmark = [
+    pytest.mark.device_inflate,
+    pytest.mark.skipif(not native.available(),
+                       reason="native tokenizer unavailable"),
+]
 
 
 def _tokenize_one(comp: bytes, out_cap: int):
@@ -147,3 +159,322 @@ def test_truncated_stream_rejected():
         native.deflate_tokenize_batch(
             src, np.array([0], np.int64),
             np.array([src.size], np.int32), len(data) + 16)
+
+
+# ---------------------------------------------------------------------------
+# round-11: the production device decode plane
+# ---------------------------------------------------------------------------
+
+def _bgzf_bytes(payload: bytes) -> bytes:
+    sink = io.BytesIO()
+    w = bgzf.BGZFWriter(sink)
+    w.write(payload)
+    w.close()
+    return sink.getvalue()
+
+
+def _bam_fixture(tmp_path, n=3000, seed=11, name="dev.bam"):
+    from fixtures import make_header, make_records
+
+    from hadoop_bam_tpu.formats.bamio import write_bam
+
+    h = make_header()
+    path = str(tmp_path / name)
+    write_bam(path, h, make_records(h, n, seed=seed))
+    return path, h
+
+
+def test_span_device_randomized_split_offsets():
+    """Byte identity vs the zlib oracle over BGZF streams whose block
+    boundaries land at randomized offsets (mixed tiny/large blocks —
+    the shapes real split plans produce)."""
+    rng = random.Random(41)
+    payload = bytes(rng.choice(b"ACGTNacgtn#!Fqual\t|") for _ in range(150000))
+    sink = io.BytesIO()
+    w = bgzf.BGZFWriter(sink)
+    pos = 0
+    while pos < len(payload):
+        take = rng.choice([37, 511, 2048, 30000, 65000])
+        w.write(payload[pos:pos + take])
+        w.flush_block() if hasattr(w, "flush_block") else None
+        pos += take
+    w.close()
+    raw = sink.getvalue()
+    host_data, host_ubase = inflate_span(raw, backend="zlib")
+    dev_data, dev_ubase = inflate_span_device(raw)
+    assert np.array_equal(host_data, dev_data)
+    assert np.array_equal(host_ubase, dev_ubase)
+    assert dev_data.tobytes() == payload
+
+
+def test_bcf_and_tabix_shaped_spans_device_identity(tmp_path):
+    """The plane is container-agnostic: BCF bytes (binary BGZF) and a
+    bgzipped VCF (the tabix container shape) inflate byte-identically
+    to the zlib oracle, like the BAM fixtures."""
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+
+    hdr_text = (
+        "##fileformat=VCFv4.2\n"
+        "##contig=<ID=chr20,length=64444167>\n"
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="Depth">\n'
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="GT">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\ts0\ts1\n")
+    header = VCFHeader.from_text(hdr_text)
+    rng = random.Random(5)
+    lines = []
+    bcf = str(tmp_path / "t.bcf")
+    with open_vcf_writer(bcf, header) as w:
+        for i in range(500):
+            rec = VcfRecord.from_line(
+                f"chr20\t{1000 + 7 * i}\t.\tA\tG\t{rng.randint(1, 99)}"
+                f"\tPASS\tDP={rng.randint(1, 60)}\tGT"
+                f"\t{rng.choice(['0/0', '0/1', '1/1'])}"
+                f"\t{rng.choice(['0/0', './.'])}")
+            w.write_record(rec)
+            lines.append(rec.to_line())
+    bcf_raw = open(bcf, "rb").read()
+    tabix_raw = _bgzf_bytes((hdr_text + "\n".join(lines) + "\n").encode())
+    for raw in (bcf_raw, tabix_raw):
+        host_data, host_ubase = inflate_span(raw, backend="zlib")
+        dev_data, dev_ubase = inflate_span_device(raw, check_crc=True)
+        assert np.array_equal(host_data, dev_data)
+        assert np.array_equal(host_ubase, dev_ubase)
+
+
+def test_byte_flip_fuzz_same_error_class_as_host():
+    """Flipping a byte anywhere in the compressed span raises the SAME
+    outcome on the device plane as on the zlib host plane: same
+    success/failure, BGZFError on both, same taxonomy class."""
+    from hadoop_bam_tpu.utils.errors import CORRUPT, classify_error
+
+    rng = random.Random(9)
+    payload = bytes(rng.choice(b"ACGT#F!") for _ in range(40000))
+    raw = _bgzf_bytes(payload)
+    positions = rng.sample(range(len(raw)), 40)
+    mismatches = []
+    for pos in positions:
+        bad = bytearray(raw)
+        bad[pos] ^= 0xFF
+        bad = bytes(bad)
+        outcomes = []
+        for run in (lambda: inflate_span(bad, backend="zlib"),
+                    lambda: inflate_span_device(bad)):
+            try:
+                data, _ = run()
+                outcomes.append(("ok", data.tobytes()))
+            except Exception as e:  # noqa: BLE001 — class comparison
+                outcomes.append(("err", isinstance(e, bgzf.BGZFError),
+                                 classify_error(e)))
+        if outcomes[0] != outcomes[1]:
+            mismatches.append((pos, outcomes))
+        if outcomes[0][0] == "err":
+            assert outcomes[0][2] == CORRUPT
+    assert not mismatches, mismatches
+
+
+def test_crc_flip_only_fails_with_check_crc():
+    rng = random.Random(3)
+    payload = bytes(rng.choice(b"ACGT") for _ in range(30000))
+    raw = _bgzf_bytes(payload)
+    from hadoop_bam_tpu.ops.inflate import block_table
+
+    table = block_table(raw)
+    # the CRC footer sits 8 bytes before each block's end
+    foot = int(table["cdata_off"][0] + table["cdata_len"][0])
+    bad = bytearray(raw)
+    bad[foot] ^= 0xFF
+    bad = bytes(bad)
+    data, _ = inflate_span_device(bad)              # fold off: passes
+    assert data.tobytes() == payload
+    with pytest.raises(bgzf.BGZFError, match="CRC32 mismatch"):
+        inflate_span_device(bad, check_crc=True)
+    # host parity: the separate verify sweep raises the same class
+    from hadoop_bam_tpu.ops.inflate import verify_crcs
+
+    hdata, hubase = inflate_span(bad, backend="zlib")
+    with pytest.raises(bgzf.BGZFError, match="CRC32 mismatch"):
+        verify_crcs(bad, block_table(bad), hdata, hubase)
+
+
+def test_native_missing_is_plan_error(monkeypatch):
+    """Selecting the device plane without the native tokenizer is a
+    configuration fault: PlanError (never retried, never quarantined),
+    not a transient or corrupt classification."""
+    from hadoop_bam_tpu.utils import errors
+
+    raw = _bgzf_bytes(b"ACGT" * 100)
+    monkeypatch.setattr(native, "available", lambda: False)
+    with pytest.raises(errors.PlanError) as ei:
+        inflate_span_device(raw)
+    assert errors.classify_error(ei.value) == errors.PLAN
+
+
+def test_jit_cache_ladder_pinned():
+    """Mixed spans whose max ISIZE wanders within one ladder rung share
+    ONE resolve compile; crossing a rung adds exactly one more — the
+    per-chunk-pow2 churn the ladder exists to kill."""
+    assert ladder_pow2(100) == 1 << 10
+    assert ladder_pow2(1024) == 1 << 10
+    assert ladder_pow2(1025) == 1 << 13
+    assert ladder_pow2(65536) == 1 << 16
+    with pytest.raises(bgzf.BGZFError):
+        ladder_pow2((1 << 16) + 1)
+
+    rng = random.Random(1)
+    before = resolve_tokens_packed._cache_size()
+    # three spans, max isize 200 / 600 / 1000 — same rung, same B pad
+    for size in (200, 600, 1000):
+        payload = bytes(rng.choice(b"ACGT") for _ in range(size))
+        inflate_span_device(_bgzf_bytes(payload))
+    mid = resolve_tokens_packed._cache_size()
+    assert mid - before <= 1, "same-rung spans recompiled the resolve"
+    # crossing to the next rung costs exactly one more entry
+    payload = bytes(rng.choice(b"ACGT") for _ in range(5000))
+    inflate_span_device(_bgzf_bytes(payload))
+    after = resolve_tokens_packed._cache_size()
+    assert after - mid <= 1
+
+
+# ---------------------------------------------------------------------------
+# the token-feed flagstat driver (resolve + walk + unpack on device)
+# ---------------------------------------------------------------------------
+
+def _flagstat(path, **kw):
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+
+    return flagstat_file(path, **kw)
+
+
+def test_flagstat_device_plane_matches_host(tmp_path):
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+
+    path, _h = _bam_fixture(tmp_path)
+    host = _flagstat(path)
+    cfg = dataclasses.replace(DEFAULT_CONFIG, inflate_backend="device")
+    assert _flagstat(path, config=cfg) == host
+
+
+def test_flagstat_device_plane_explicit_spans_and_crc(tmp_path):
+    """A pinned multi-span plan forces cut-final-record fixups (every
+    span boundary cuts a record); parity must hold, with and without
+    the tokenize-time CRC fold."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.split.planners import plan_spans_cached
+
+    path, _h = _bam_fixture(tmp_path, n=4000, seed=23)
+    host = _flagstat(path)
+    hdr, _ = read_bam_header(path)
+    spans = plan_spans_cached(path, hdr, DEFAULT_CONFIG, num_spans=6)
+    assert len(spans) > 1
+    cfg = dataclasses.replace(DEFAULT_CONFIG, inflate_backend="device")
+    assert _flagstat(path, config=cfg, spans=spans, header=hdr) == host
+    cfg_crc = dataclasses.replace(cfg, check_crc=True)
+    assert _flagstat(path, config=cfg_crc, spans=spans, header=hdr) == host
+
+
+def test_flagstat_device_plane_overwide_span_remainder(tmp_path,
+                                                      monkeypatch):
+    """A span wider than the 64-block device ladder degrades gracefully:
+    the device decodes its first 64 blocks, the host fixup decodes the
+    remainder, totals stay exact."""
+    monkeypatch.setattr(bgzf, "WRITE_PAYLOAD_SIZE", 2048)
+    path, _h = _bam_fixture(tmp_path, n=1500, seed=7, name="tiny.bam")
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.ops.inflate import block_table
+
+    assert block_table(open(path, "rb").read())["isize"].size > 64
+    host = _flagstat(path)
+    cfg = dataclasses.replace(DEFAULT_CONFIG, inflate_backend="device")
+    assert _flagstat(path, config=cfg) == host
+
+
+def test_flagstat_device_plane_corrupt_chain_same_class(tmp_path):
+    """A corrupted record chain (absurd block_size mid-span) raises the
+    CORRUPT taxonomy class on BOTH planes."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.ops.inflate import inflate_span as _is, walk_records
+    from hadoop_bam_tpu.utils.errors import CORRUPT, classify_error
+
+    path, _h = _bam_fixture(tmp_path, n=800, seed=3, name="chain.bam")
+    raw = open(path, "rb").read()
+    data, _ub = _is(raw)
+    _hdr, voff = read_bam_header(path)
+    offs, _tail = walk_records(data, start=voff & 0xFFFF)
+    victim = int(offs[len(offs) // 2])
+    bad = bytearray(data.tobytes())
+    bad[victim:victim + 4] = (5).to_bytes(4, "little")   # block_size 5
+    sink = io.BytesIO()
+    w = bgzf.BGZFWriter(sink)
+    w.write(bytes(bad))
+    w.close()
+    corrupt_path = str(tmp_path / "corrupt.bam")
+    with open(corrupt_path, "wb") as f:
+        f.write(sink.getvalue())
+    classes = []
+    for cfg in (DEFAULT_CONFIG,
+                dataclasses.replace(DEFAULT_CONFIG,
+                                    inflate_backend="device")):
+        with pytest.raises(Exception) as ei:
+            _flagstat(corrupt_path, config=cfg)
+        classes.append(classify_error(ei.value))
+    assert classes == [CORRUPT, CORRUPT]
+
+
+def test_flagstat_device_plane_requires_native(tmp_path, monkeypatch):
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.utils.errors import PLAN, PlanError, classify_error
+
+    path, _h = _bam_fixture(tmp_path, n=100, seed=1, name="n.bam")
+    import hadoop_bam_tpu.utils.native as native_mod
+
+    monkeypatch.setattr(native_mod, "available", lambda: False)
+    cfg = dataclasses.replace(DEFAULT_CONFIG, inflate_backend="device")
+    with pytest.raises(PlanError) as ei:
+        _flagstat(path, config=cfg)
+    assert classify_error(ei.value) == PLAN
+
+
+def test_inflate_backend_knob_and_selector():
+    from hadoop_bam_tpu.config import (
+        HBamConfig, resolve_inflate_backend,
+    )
+    from hadoop_bam_tpu.utils.errors import PlanError
+
+    cfg = HBamConfig.from_dict({"hbam.inflate-backend": "device"})
+    assert cfg.inflate_backend == "device"
+    assert resolve_inflate_backend(cfg) == "device"
+    assert resolve_inflate_backend(
+        HBamConfig(inflate_backend="zlib")) == "zlib"
+    with pytest.raises(PlanError):
+        resolve_inflate_backend(HBamConfig(inflate_backend="warp"))
+    # "auto" on the CPU backend resolves to the host plane without
+    # paying the probe's jit compile (the device cannot beat the host
+    # at being the host)
+    import jax
+
+    if jax.default_backend() == "cpu":
+        assert resolve_inflate_backend(HBamConfig()) == "native"
+
+
+def test_flagstat_zlib_backend_honored(tmp_path):
+    """inflate_backend='zlib' rides the host path with the fused native
+    plane disabled — same totals, portable plane."""
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+
+    path, _h = _bam_fixture(tmp_path, n=500, seed=2, name="z.bam")
+    host = _flagstat(path)
+    cfg = dataclasses.replace(DEFAULT_CONFIG, inflate_backend="zlib")
+    assert _flagstat(path, config=cfg) == host
+
+
+def test_probe_device_plane_reports_measurements():
+    from hadoop_bam_tpu.ops.inflate_device import probe_device_plane
+
+    out = probe_device_plane(payload_bytes=1 << 14, force=True)
+    assert set(out) >= {"device_wins", "tokenize_s", "resolve_s",
+                        "inflate_s", "backend"}
+    assert isinstance(out["device_wins"], bool)
+    assert out["tokenize_s"] > 0 and out["resolve_s"] > 0

@@ -105,6 +105,14 @@ tokens, n_tokens, out_lens = native.deflate_tokenize_batch(
     int(table["isize"].max()) + 16, n_threads=4)
 assert (out_lens == table["isize"]).all()
 
+# tokenize with the CRC fold (thread-local resolve scratch under ASan/
+# TSan: each worker resolves its blocks into its own growable buffer)
+toks_c, nt_c, ol_c, crcs = native.deflate_tokenize_batch(
+    src, table["cdata_off"], table["cdata_len"],
+    int(table["isize"].max()) + 16, n_threads=4, with_crc=True)
+assert (ol_c == table["isize"]).all()
+assert (crcs == inflate_ops.footer_crcs(src, table)).all()
+
 # batch ITF8 (CRAM fixed-series predecode), incl. the truncation path
 from hadoop_bam_tpu.formats.cram import write_itf8
 vals = [0, 1, 127, 128, 16383, 2**28, -1] * 50
