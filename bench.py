@@ -340,7 +340,10 @@ def _run_component(fn, label: str, est_s: float = 30.0) -> None:
     _emit_progress()
 
 
-def _median_time(fn, reps: int = 2):
+_MEDIAN_REPS = 2   # timed reps per row; every call runs 1 warmup more
+
+
+def _median_time(fn, reps: int = _MEDIAN_REPS):
     """Lower-median wall time of fn() over reps runs (first result
     returned): best-of for reps=2, true median for odd reps — never the
     max, so one GC/IO hiccup can't define a row.  reps default dropped
@@ -366,10 +369,16 @@ def build_fixture() -> str:
     from hadoop_bam_tpu.formats.bam import SAMHeader, encode_record
     from hadoop_bam_tpu.formats.bamio import BamWriter
 
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+
     header = SAMHeader.from_sam_text(_HDR_TEXT)
     rng = random.Random(1234)
     bases = "ACGT"
-    with BamWriter(BENCH_BAM + ".tmp", header) as w:
+    # fixture BGZF level rides the same config knob as every producing
+    # path (hbam.write-compress-level), so fixture bytes and write-path
+    # output stay comparable
+    with BamWriter(BENCH_BAM + ".tmp", header,
+                   level=DEFAULT_CONFIG.write_compress_level) as w:
         pos = 1
         for i in range(BENCH_RECORDS):
             l = 151
@@ -1326,10 +1335,12 @@ def bench_sort(path: str):
     if not os.path.exists(src):
         import random as _random
 
+        from hadoop_bam_tpu.config import DEFAULT_CONFIG
         from hadoop_bam_tpu.formats.bamio import BamWriter
         ds, recs = _collect_record_bytes(path, n_slice)
         _random.Random(9).shuffle(recs)
-        with BamWriter(src + ".tmp", ds.header) as w:
+        with BamWriter(src + ".tmp", ds.header,
+                       level=DEFAULT_CONFIG.write_compress_level) as w:
             for r in recs:
                 w.write_record_bytes(r)
         os.replace(src + ".tmp", src)
@@ -1357,6 +1368,72 @@ def bench_sort(path: str):
             # 8-device CPU mesh the same code is byte-identical to and
             # competitive with the single-process sort (test_mesh_sort).
             "note": "end-to-end incl. tunneled H2D of span bytes"}
+
+
+def bench_sort_write(path: str):
+    """Mesh-sort + parallel write throughput (write/ subsystem): the
+    sort's output stage through ParallelBGZFWriter + index-during-write
+    vs the same sort forced onto the serial in-line writer
+    (write_parallel_workers=0).  Value is output MB/s of the parallel
+    arm; ``write_deflate_share`` is the deflate stage's union-wall share
+    of the parallel arm's end-to-end wall.  The parallel-vs-serial ratio
+    is HOST-DEPENDENT: on this 1-core bench machine pool deflate cannot
+    beat in-line deflate (no spare cores), so the contract pins the row
+    shape and byte-identity, never a ratio."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
+    from hadoop_bam_tpu.utils.metrics import MetricsContext
+
+    n_slice = min(BENCH_RECORDS, int(os.environ.get("BENCH_SORT_RECORDS",
+                                                    "100000")))
+    src = os.path.join(BENCH_DIR, f"bench_sort_{n_slice}.bam")
+    if not os.path.exists(src):
+        bench_sort(path)                 # builds the shuffled fixture
+    tmp = tempfile.mkdtemp(prefix="hbam_bench_sortwrite_")
+    try:
+        par_out = os.path.join(tmp, "par.bam")
+        ser_out = os.path.join(tmp, "ser.bam")
+
+        with MetricsContext() as m:
+            def par_run():
+                return sort_bam_mesh(src, par_out, config=DEFAULT_CONFIG)
+            n, dt = _median_time(par_run)
+        snap = m.snapshot()
+        deflate_wall = float(snap["wall_timers"].get(
+            "write.deflate_wall", 0.0))
+        ser_cfg = dataclasses.replace(DEFAULT_CONFIG,
+                                      write_parallel_workers=0)
+
+        def ser_run():
+            return sort_bam_mesh(src, ser_out, config=ser_cfg)
+        bn, bdt = _median_time(ser_run)
+        assert n == bn
+        identical = open(par_out, "rb").read() == open(ser_out,
+                                                       "rb").read()
+        out_bytes = os.path.getsize(par_out)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    meas = out_bytes / dt / 1e6
+    base = out_bytes / bdt / 1e6
+    # MetricsContext accumulated deflate wall over warmup + reps runs;
+    # normalize to a per-run share of the measured wall
+    runs = _MEDIAN_REPS + 1
+    share = min(1.0, deflate_wall / runs / max(dt, 1e-9))
+    return {"metric": "sort_write_mb_per_sec",
+            "value": round(meas, 2), "unit": "MB/s",
+            "vs_baseline": round(meas / base, 3),
+            "serial_mb_per_sec": round(base, 2),
+            "write_deflate_share": round(share, 4),
+            "records": int(n), "output_bytes": int(out_bytes),
+            "byte_identical_to_serial": bool(identical),
+            "note": ("parallel-deflate vs serial-writer arm; ratio is "
+                     "host-dependent (1-core bench host has no spare "
+                     "cores for the pool) — contract pins row shape + "
+                     "byte identity, not a ratio")}
 
 
 def bench_bam_write(path: str):
@@ -1812,10 +1889,12 @@ def _scaling_fixture(path: str) -> str:
         return path
     dst = os.path.join(BENCH_DIR, f"bench_scaling_{n}.bam")
     if not os.path.exists(dst):
+        from hadoop_bam_tpu.config import DEFAULT_CONFIG
         from hadoop_bam_tpu.formats.bamio import BamWriter
 
         ds, recs = _collect_record_bytes(path, n)
-        with BamWriter(dst + ".tmp", ds.header) as w:
+        with BamWriter(dst + ".tmp", ds.header,
+                       level=DEFAULT_CONFIG.write_compress_level) as w:
             for r in recs:
                 w.write_record_bytes(r)
         os.replace(dst + ".tmp", dst)
@@ -1963,6 +2042,8 @@ def main() -> None:
                    "coverage_records_per_sec", est_s=35)
     _run_component(lambda: bench_sort(path), "sort_records_per_sec_mesh",
                    est_s=45)
+    _run_component(lambda: bench_sort_write(path), "sort_write_mb_per_sec",
+                   est_s=40)
 
     # the scaling curve outranks the single-kernel rows (VERDICT r4 #3)
     if _remaining() > 70:

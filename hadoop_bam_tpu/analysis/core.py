@@ -159,7 +159,7 @@ def analyzers() -> Dict[str, Analyzer]:
     # import for registration side effects
     from hadoop_bam_tpu.analysis import (  # noqa: F401
         decodepath, devicesync, feedpath, layout, lockstep, obsrules,
-        querycache, servebounds, taxonomy, trace_safety,
+        querycache, servebounds, taxonomy, trace_safety, writepath,
     )
     return dict(_REGISTRY)
 
@@ -258,7 +258,8 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                     "allocation discipline (PF5xx), query-cache key "
                     "identity (QE5xx), observability discipline (OB6xx), "
                     "decode-path copy discipline (DP7xx), serving-tier "
-                    "cache bounds (SV8xx)")
+                    "cache bounds (SV8xx), write-path atomicity/"
+                    "parallelism (WR10x)")
     p.add_argument("--root", default=None,
                    help="package directory to analyze (default: the "
                         "installed hadoop_bam_tpu package)")
@@ -266,7 +267,7 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                    metavar="ANALYZER",
                    help="run one analyzer (trace_safety, lockstep, "
                         "taxonomy, layout, feedpath, querycache, obs, "
-                        "decodepath, servebounds); repeatable")
+                        "decodepath, servebounds, writepath); repeatable")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline file (default: analysis/baseline.json)")
     p.add_argument("--no-baseline", action="store_true",

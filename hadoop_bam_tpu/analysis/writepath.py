@@ -1,0 +1,128 @@
+"""WR10x — write-path discipline: atomic publication, pooled deflate.
+
+The write subsystem (``hadoop_bam_tpu/write/``) has two invariants that
+read like style but are correctness at scale:
+
+- outputs are PUBLISHED atomically: data is written to a temp name and
+  ``os.replace``d into place, so a crashed writer never leaves a
+  plausible-looking truncated file under the final name (the multi-host
+  merger would concatenate it; the serve tier would cache it by a stale
+  identity).  A bare ``open(final_path, "wb")`` in ``write/`` is the
+  regression vector — WR101 flags any write-mode ``open`` whose path
+  expression carries no temp-ish name (tmp/temp/part/shard/scratch)
+  inside a function that never calls ``os.replace``/``os.rename``.
+
+- block deflate runs on the shared pool, committed in order by ONE
+  committer: a ``deflate_block`` call inside a loop anywhere in
+  ``write/`` outside the committer/submit machinery is the serial
+  bottleneck creeping back (the exact shape the subsystem exists to
+  remove) — WR102.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/write",)
+
+_TMPISH = ("tmp", "temp", "part", "shard", "scratch")
+_WRITE_MODES = ("w", "wb", "xb", "x", "wb+", "w+b", "ab")
+_ATOMIC_CALLS = {"replace", "rename"}
+_COMMITTERISH = ("commit", "submit", "deflate")
+
+
+def _func_defs(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _identifiers(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+def _is_write_open(call: ast.Call) -> bool:
+    fn = call.func
+    if not (isinstance(fn, ast.Name) and fn.id == "open"):
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and mode in _WRITE_MODES
+
+
+def _calls_atomic_rename(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _ATOMIC_CALLS:
+            return True
+    return False
+
+
+def _loops_of(fn: ast.AST) -> Iterator[ast.AST]:
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue                       # nested defs analyzed on their own
+        if isinstance(node, (ast.For, ast.While)):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register("writepath")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+        for fn in _func_defs(m.tree):
+            atomic = _calls_atomic_rename(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_write_open(node) and not atomic:
+                    path_arg = node.args[0] if node.args else node
+                    names = [n.lower() for n in _identifiers(path_arg)]
+                    if not any(t in n for n in names for t in _TMPISH):
+                        findings.append(Finding(
+                            rule="WR101", severity="error", path=m.path,
+                            line=node.lineno,
+                            message="non-atomic output publication: "
+                                    "write-mode open() of a final path "
+                                    "with no temp name and no os.replace "
+                                    "in the function — a crashed writer "
+                                    "leaves a truncated file readers "
+                                    "will trust; write to <path>.tmp and "
+                                    "os.replace into place"))
+            if any(c in fn.name for c in _COMMITTERISH):
+                continue                   # the committer/submit machinery
+            for loop in _loops_of(fn):
+                for node in ast.walk(loop):
+                    if isinstance(node, ast.Call):
+                        callee = node.func
+                        name = callee.id if isinstance(callee, ast.Name) \
+                            else (callee.attr
+                                  if isinstance(callee, ast.Attribute)
+                                  else "")
+                        if name == "deflate_block":
+                            findings.append(Finding(
+                                rule="WR102", severity="error",
+                                path=m.path, line=node.lineno,
+                                message="serial deflate_block loop "
+                                        "outside the committer: block "
+                                        "compression in write/ must ride "
+                                        "the shared pool through "
+                                        "ParallelBGZFWriter, not a "
+                                        "caller-thread loop"))
+    return findings

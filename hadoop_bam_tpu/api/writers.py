@@ -33,6 +33,7 @@ class BamShardWriter(BamWriter):
                  config: HBamConfig = DEFAULT_CONFIG, **kw):
         kw.setdefault("write_header", config.write_header)
         kw.setdefault("write_eof", config.write_terminator)
+        kw.setdefault("level", config.write_compress_level)
         super().__init__(sink, header, **kw)
 
 
@@ -121,8 +122,10 @@ class VcfShardWriter:
     def __init__(self, sink, header: "VCFHeader",
                  config: HBamConfig = DEFAULT_CONFIG,
                  write_header: Optional[bool] = None,
-                 compress: bool = False, level: int = 6):
+                 compress: bool = False, level: Optional[int] = None):
         from hadoop_bam_tpu.formats import bgzf
+        if level is None:
+            level = config.write_compress_level
         self._own = False
         if isinstance(sink, (str, os.PathLike)):
             sink = open(sink, "wb")
@@ -166,6 +169,7 @@ class BcfShardWriter(BcfWriter):
                  config: HBamConfig = DEFAULT_CONFIG, **kw):
         kw.setdefault("write_header", config.write_header)
         kw.setdefault("write_eof", config.write_terminator)
+        kw.setdefault("level", config.write_compress_level)
         super().__init__(sink, header, **kw)
 
 
@@ -187,8 +191,10 @@ class FastqShardWriter:
     emitted in the configured base-quality encoding."""
 
     def __init__(self, sink, config: HBamConfig = DEFAULT_CONFIG,
-                 compress: bool = False, level: int = 6):
+                 compress: bool = False, level: Optional[int] = None):
         from hadoop_bam_tpu.formats import bgzf
+        if level is None:
+            level = config.write_compress_level
         self._encoding = config.fastq_base_quality_encoding
         self._own = False
         if isinstance(sink, (str, os.PathLike)):

@@ -98,6 +98,11 @@ _HADOOP_KEY_MAP = {
     "hbam.query-max-in-flight": "query_max_in_flight",
     "hbam.query-queue-depth": "query_queue_depth",
     "hbam.query-deadline-s": "query_deadline_s",
+    # write-path knobs (write/; the reference's OutputFormats had only
+    # the Hadoop codec's mapreduce.output.* compression settings)
+    "hbam.write-compress-level": "write_compress_level",
+    "hbam.write-parallel-workers": "write_parallel_workers",
+    "hbam.write-index-kinds": "write_index_kinds",
     # serving knobs (serve/; no reference analog — Hadoop-BAM never ran
     # as a resident service)
     "hbam.serve-tile-cache-bytes": "serve_tile_cache_bytes",
@@ -126,6 +131,18 @@ class HBamConfig:
     vcf_output_format: str = "VCF"   # "VCF" | "BCF" (hb/VCFOutputFormat.java)
     write_header: bool = True        # per-shard header (KeyIgnoring*RecordWriter)
     write_terminator: bool = True    # BGZF EOF block on close
+    # write path (write/): BGZF deflate level for EVERY producing path
+    # (parallel writer, serial writers, shard parts, sort outputs);
+    # htsjdk's BlockCompressedOutputStream default is 5, zlib's is 6 —
+    # 6 kept for byte-compatibility with this repo's existing fixtures
+    write_compress_level: int = 6
+    write_parallel_workers: Optional[int] = None  # in-flight deflate
+    #                                  bound for ParallelBGZFWriter;
+    #                                  None = shared decode pool size,
+    #                                  0 = serial in-line deflate
+    write_index_kinds: str = "auto"  # sidecars co-written with outputs:
+    #                                  "auto" (BAM: bai+sbi, BCF: tbi),
+    #                                  "none", or a comma list
     # (3, 1) writes rANS Nx16 blocks.  EXPERIMENTAL: the Nx16 transform
     # metadata layouts are pinned by golden-byte tests against this repo's
     # own encoder only — no htslib cross-validation was possible in-image
@@ -295,6 +312,7 @@ def _coerce(kwargs: dict) -> dict:
     for k in ("span_retries", "io_read_retries", "feed_ring_slots",
               "feed_dispatch_depth", "decode_pool_workers",
               "decode_chunk_blocks",
+              "write_compress_level", "write_parallel_workers",
               "query_cache_bytes", "query_chunk_bytes",
               "query_tile_records", "query_max_in_flight",
               "query_queue_depth",

@@ -432,19 +432,22 @@ def _iter_run_frames(path: str):
         pos += ln
 
 
-def _merge_bucket_runs(run_paths: List[str]) -> Tuple[bytes, int]:
+def _merge_bucket_runs(run_paths: List[str]
+                       ) -> Tuple[bytes, np.ndarray]:
     """k-way merge of one bucket's per-round sorted runs by the framed
-    (hi, lo, gidx) key — the external-merge half of the MR shuffle."""
+    (hi, lo, gidx) key — the external-merge half of the MR shuffle.
+    Returns (concatenated record bytes, per-record lengths) so writers
+    can recover record boundaries for index-during-write."""
     import heapq
 
     chunks: List[bytes] = []
-    k = 0
+    lens: List[int] = []
     for _key, payload in heapq.merge(
             *(_iter_run_frames(p) for p in run_paths),
             key=lambda kv: kv[0]):
         chunks.append(payload)
-        k += 1
-    return b"".join(chunks), k
+        lens.append(len(payload))
+    return b"".join(chunks), np.asarray(lens, dtype=np.int64)
 
 
 def _sort_bam_mesh_bytes_spill(input_path: str, output_path: str, *, mesh,
@@ -694,21 +697,34 @@ def _sort_bam_mesh_bytes_spill_impl(input_path: str, output_path: str, *,
     written = 0
     merge_err: Optional[BaseException] = None
     if n_proc == 1:
-        with BamWriter(output_path, out_header) as w:
+        from hadoop_bam_tpu.write import write_bam_records
+
+        def bucket_chunks():
             for b in range(n_dev):
-                payload, k = _merge_bucket_runs(run_files.get(b, []))
-                w.write_raw(payload, n_records=k)
-                written += k
+                payload, lens = _merge_bucket_runs(run_files.get(b, []))
+                if lens.size:
+                    yield payload, np.cumsum(lens) - lens
+        written = write_bam_records(output_path, out_header,
+                                    bucket_chunks(), config=config).records
         # spill-dir removal lives in the caller's finally
     else:
+        from hadoop_bam_tpu.write import (
+            ShardedFileWriter, write_bam_shards_concat,
+        )
+        # parts live inside the existing .mesh-spill run dir (distinct
+        # "part-*" names), so the caller's finally removes them with the
+        # runs on every failure path
+        sw = ShardedFileWriter(output_path, n_dev,
+                               dir_suffix=".mesh-spill")
         try:
             for b in sorted(local_pos):
-                payload, k = _merge_bucket_runs(run_files.get(b, []))
-                part = os.path.join(shard_dir, f"part-{b:05d}")
-                with BamWriter(part, out_header, write_header=False,
-                               write_eof=False) as w:
-                    w.write_raw(payload, n_records=k)
-                written += k
+                payload, lens = _merge_bucket_runs(run_files.get(b, []))
+                with sw.open_shard(b) as f:
+                    with BamWriter(f, out_header, write_header=False,
+                                   write_eof=False,
+                                   level=config.write_compress_level) as w:
+                        w.write_raw(payload, n_records=int(lens.size))
+                written += int(lens.size)
         except Exception as e:  # noqa: BLE001 — flagged below
             merge_err = e
         g_written = np.asarray(multihost_utils.process_allgather(
@@ -723,20 +739,15 @@ def _sort_bam_mesh_bytes_spill_impl(input_path: str, output_path: str, *,
             raise RuntimeError(
                 f"mesh spill sort wrote {written} of {total} records — "
                 f"output is invalid")
-        from hadoop_bam_tpu.utils.mergers import merge_bam_shards_reblocked
         final_err = None
         if pid == 0:
             try:
-                parts = [os.path.join(shard_dir, f"part-{b:05d}")
-                         for b in range(n_dev)]
-                missing = [p for p in parts if not os.path.exists(p)]
-                if missing:
-                    raise RuntimeError(
-                        f"mesh spill sort shard(s) missing at merge "
-                        f"time: {missing[:3]} — is {shard_dir} on a "
-                        f"filesystem shared by all hosts?")
-                merge_bam_shards_reblocked(parts, output_path, out_header)
-                # spill-dir removal lives in the caller's finally
+                # spill-dir removal (parts included) lives in the
+                # caller's finally, which honors debug_keep_spill
+                sw.concatenate(
+                    lambda parts: write_bam_shards_concat(
+                        parts, output_path, out_header, config=config),
+                    what="mesh spill sort", cleanup=False)
             except Exception as e:  # noqa: BLE001 — must reach the barrier
                 final_err = e
         ok = np.asarray([0 if final_err is not None else 1], np.int32)
@@ -760,9 +771,6 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
     """Byte-exchange mesh sort: works multi-host.  Each process decodes
     only its devices' spans; record bytes ride the all_to_all; each host
     writes its buckets as headerless shards; host 0 merges."""
-    import os
-    import shutil
-
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -876,43 +884,54 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
                              _buckets(six_s))
 
     def bucket_payload(b):
+        """(concatenated record bytes, record start offsets) of one
+        bucket — record-aligned chunks the write path indexes."""
         keep = b_six[b] != _I32_SENTINEL
         n = int(keep.sum())
         if not n:
-            return b"", 0
+            return b"", np.zeros(0, np.int64)
         rows = b_rows[b][keep]
         lens = b_lens[b][keep].astype(np.int64)
         colmask = np.arange(stride)[None, :] < lens[:, None]
-        return rows[colmask].tobytes(), n
+        return rows[colmask].tobytes(), np.cumsum(lens) - lens
 
     written = 0
     if n_proc == 1:
-        # one continuous BGZF stream — byte-identical to sort_bam
-        with BamWriter(output_path, out_header) as w:
+        # one continuous BGZF stream — byte-identical to sort_bam —
+        # through the parallel write path, index sidecars co-written
+        from hadoop_bam_tpu.write import write_bam_records
+
+        def chunks():
             for b in sorted(b_rows):
-                payload, n = bucket_payload(b)
-                w.write_raw(payload, n_records=n)
-                written += n
+                payload, offs = bucket_payload(b)
+                if offs.size:
+                    yield payload, offs
+        written = write_bam_records(output_path, out_header, chunks(),
+                                    config=config).records
     else:
         # parallel headerless shard writes (each host deflates its own
         # buckets), then host 0 re-blocks them into the continuous
         # stream so the merged file still matches sort_bam exactly
-        shard_dir = output_path + ".mesh-shards"
+        from hadoop_bam_tpu.write import (
+            ShardedFileWriter, write_bam_shards_concat,
+        )
+        sw = ShardedFileWriter(output_path, n_dev,
+                               dir_suffix=".mesh-shards")
         if pid == 0:
             # stale parts from an earlier failed run must not survive
             # into this merge; barrier before anyone writes new ones
-            shutil.rmtree(shard_dir, ignore_errors=True)
+            sw.prepare()
         multihost_utils.process_allgather(np.zeros(1, np.int32))
         write_err = None
         try:
-            os.makedirs(shard_dir, exist_ok=True)
             for b in sorted(b_rows):
-                payload, n = bucket_payload(b)
-                part = os.path.join(shard_dir, f"part-{b:05d}")
-                with BamWriter(part, out_header, write_header=False,
-                               write_eof=False) as w:
-                    w.write_raw(payload, n_records=n)
-                written += n
+                payload, offs = bucket_payload(b)
+                with sw.open_shard(b) as f:
+                    with BamWriter(f, out_header, write_header=False,
+                                   write_eof=False,
+                                   level=config.write_compress_level) as w:
+                        w.write_raw(payload, n_records=int(offs.size))
+                written += int(offs.size)
         except Exception as e:  # noqa: BLE001 — must reach the collective
             # a raise here on one host only (ENOSPC, EIO, ...) would
             # strand the others in the allgather below; ship written=-1
@@ -933,25 +952,16 @@ def _sort_bam_mesh_bytes(input_path: str, output_path: str, *, mesh,
             f"mesh sort wrote {written} of {total} records — bucket "
             f"exchange lost data; output is invalid")
     if n_proc > 1:
-        from hadoop_bam_tpu.utils.mergers import merge_bam_shards_reblocked
         merge_err = None
         if pid == 0:
             try:
                 # every device position writes exactly one part (empty
                 # buckets included), so a missing part means shared-FS
                 # lag or data loss — refuse to merge a truncated file
-                parts = [os.path.join(shard_dir, f"part-{b:05d}")
-                         for b in range(n_dev)]
-                missing = [p for p in parts if not os.path.exists(p)]
-                if missing:
-                    raise RuntimeError(
-                        f"mesh sort shard(s) missing at merge time: "
-                        f"{missing[:3]}"
-                        f"{'...' if len(missing) > 3 else ''} — is "
-                        f"{shard_dir} on a filesystem shared by all "
-                        f"hosts?")
-                merge_bam_shards_reblocked(parts, output_path, out_header)
-                shutil.rmtree(shard_dir, ignore_errors=True)
+                sw.concatenate(
+                    lambda parts: write_bam_shards_concat(
+                        parts, output_path, out_header, config=config),
+                    what="mesh sort")
             except Exception as e:  # noqa: BLE001 — must reach the barrier
                 merge_err = e
         # barrier doubling as failure broadcast: a raise before this
@@ -993,7 +1003,7 @@ def sort_bam_mesh(input_path: str, output_path: str, *,
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from hadoop_bam_tpu.formats.bamio import BamWriter, read_bam_header
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.parallel.mesh import make_mesh
     from hadoop_bam_tpu.parallel.pipeline import _decode_span_core
     from hadoop_bam_tpu.split.planners import plan_bam_spans_balanced
@@ -1085,8 +1095,9 @@ def sort_bam_mesh(input_path: str, output_path: str, *,
     span_of = np.searchsorted(
         np.cumsum(counts), np.arange(total), side="right")
     out_header = _sorted_header(header, by_name=False)
-    written = 0
-    with BamWriter(output_path, out_header) as w:
+    from hadoop_bam_tpu.write import write_bam_records
+
+    def bucket_chunks():
         for d in range(n_dev):
             idxs = six[d]
             idxs = idxs[idxs != _I32_SENTINEL].astype(np.int64)
@@ -1113,8 +1124,10 @@ def sort_bam_mesh(input_path: str, output_path: str, *,
                      - np.repeat(np.cumsum(nb) - nb, nb))
                 out[np.repeat(dst0[m], nb) + f] = \
                     data[np.repeat(o_arr[m], nb) + f]
-            w.write_raw(out.tobytes(), n_records=idxs.size)
-            written += idxs.size
+            yield out, dst0
+
+    written = write_bam_records(output_path, out_header, bucket_chunks(),
+                                config=config).records
     if written != total:
         raise RuntimeError(
             f"mesh sort wrote {written} of {total} records — bucket "

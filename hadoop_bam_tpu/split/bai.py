@@ -279,20 +279,185 @@ class CsiIndex:
         return cls(min_shift=min_shift, depth=depth, refs=refs)
 
 
+class IncrementalBinningCore:
+    """Shared chunk/linear machinery of ``BAIBuilder`` and
+    ``split/tabix.TabixBuilder`` — BAI and tabix use the same 14/5 bin
+    arithmetic, the same deferred chunk ends, and the same 16 KiB
+    linear index, so the logic lives ONCE here (the PR-8 chunk-end bug
+    lived in exactly this code; two hand-synced copies would let the
+    index families silently diverge on the next fix).
+
+    Subclasses own ``self.refs`` (a list of ``RefIndex``) and call
+    ``_observe`` per mapped record after their own rid resolution.
+
+    Chunk ENDS are deferred: record i's chunk closes at record i+1's
+    start voffset (or at ``finalize``'s end voffset for the last
+    record), so every stored end carries a real block-boundary coffset.
+    The old fallback packed (coffset+1, 0), one BYTE past the block
+    start: BGZFReader-based chunk reads tolerated that by accident, but
+    block-table consumers (plan_interval_spans -> coverage's
+    _fetch_span_raw) need end coffsets on real block boundaries and
+    died mid-block with "truncated BGZF header".
+    """
+
+    refs: List[RefIndex]
+
+    def __init__(self):
+        self._pending: Optional[Tuple[int, int, int]] = None
+
+    def _close(self, v1: int) -> None:
+        if self._pending is None:
+            return
+        rid, b, v0 = self._pending
+        self._pending = None
+        chunks = self.refs[rid].bins.setdefault(b, [])
+        if chunks and chunks[-1][1] >= v0:          # adjacent: extend
+            chunks[-1] = (chunks[-1][0], v1)
+        else:
+            chunks.append((v0, v1))
+
+    def _observe(self, rid: int, beg: int, end: int, voffset: int) -> None:
+        """Record one mapped observation: open its (deferred-end) chunk
+        and fold it into the linear index."""
+        ref = self.refs[rid]
+        self._pending = (rid, reg2bin(beg, end), voffset)
+        w0 = beg >> _LINEAR_SHIFT
+        w1 = max(end - 1, beg) >> _LINEAR_SHIFT
+        if len(ref.linear) <= w1:
+            ref.linear.extend([0] * (w1 + 1 - len(ref.linear)))
+        for w in range(w0, w1 + 1):
+            if ref.linear[w] == 0 or voffset < ref.linear[w]:
+                ref.linear[w] = voffset
+
+
+class BAIBuilder(IncrementalBinningCore):
+    """Incremental BAI construction: one ``add`` per coordinate-sorted
+    record, ``finalize`` closes the trailing chunk — the reusable core
+    behind both the whole-file ``build_bai`` rescan and the write path's
+    index-during-write sink (``write/indexing.IndexingSink``), which
+    cannot afford a second pass over the file it just produced.
+    """
+
+    def __init__(self, n_ref: int):
+        super().__init__()
+        self.refs = [RefIndex() for _ in range(n_ref)]
+
+    def add(self, rid: int, beg: int, end: int, voffset: int) -> None:
+        """Observe one record: 0-based half-open [beg, end) on reference
+        ``rid`` (negative = unmapped, indexed only as a chunk closer),
+        starting at packed virtual offset ``voffset``."""
+        self._close(voffset)
+        if rid < 0:
+            return
+        self._observe(rid, beg, end, voffset)
+
+    def finalize(self, end_voffset: int) -> BaiIndex:
+        """Close the trailing chunk at ``end_voffset`` (end-of-data
+        position — block-aligned by construction) and return the index."""
+        self._close(end_voffset)
+        return BaiIndex(refs=self.refs)
+
+
+def _reg2bin_vec(beg: np.ndarray, end: np.ndarray) -> np.ndarray:
+    """Vectorized ``reg2bin`` over int64 column arrays."""
+    e = end - 1
+    return np.select(
+        [beg >> 14 == e >> 14, beg >> 17 == e >> 17,
+         beg >> 20 == e >> 20, beg >> 23 == e >> 23,
+         beg >> 26 == e >> 26],
+        [4681 + (beg >> 14), 585 + (beg >> 17), 73 + (beg >> 20),
+         9 + (beg >> 23), 1 + (beg >> 26)],
+        default=0)
+
+
+def bai_from_columns(n_ref: int, refid: np.ndarray, beg: np.ndarray,
+                     end: np.ndarray, voffsets: np.ndarray,
+                     end_voffset: int) -> BaiIndex:
+    """Vectorized twin of feeding the same file-ordered columns through
+    ``BAIBuilder.add`` row by row (bit-identical output; the fuzz test
+    pins it).  The write path's indexing sink already holds these
+    columns, and a per-record Python loop over 10^8 records would put
+    minutes of interpreter time on the critical path between the pooled
+    deflate and publication — here bins come from one ``np.select``,
+    chunks from same-(rid,bin) run detection, and the linear index from
+    ``np.minimum.at`` per window stride.
+    """
+    refid = np.asarray(refid, np.int64)
+    beg = np.asarray(beg, np.int64)
+    end = np.asarray(end, np.int64)
+    voffs = np.asarray(voffsets, np.uint64)
+    n = refid.size
+    refs = [RefIndex() for _ in range(n_ref)]
+    if not n:
+        return BaiIndex(refs=refs)
+
+    mapped = refid >= 0
+    bins = _reg2bin_vec(beg, end)
+    # record i's chunk closes at record i+1's start (see the core's
+    # deferred-end note); the last closes at end_voffset
+    cend = np.empty(n, np.uint64)
+    cend[:-1] = voffs[1:]
+    cend[-1] = np.uint64(end_voffset)
+
+    # a chunk extends exactly over a run of CONSECUTIVE mapped records
+    # sharing (rid, bin): any break (bin change, ref change, unmapped
+    # record between) moves the next start voffset past the closed
+    # chunk's end, so the serial builder never merges across it
+    prev_mapped = np.empty(n, bool)
+    prev_mapped[0] = False
+    prev_mapped[1:] = mapped[:-1]
+    same = np.zeros(n, bool)
+    same[1:] = (refid[1:] == refid[:-1]) & (bins[1:] == bins[:-1])
+    new_run = mapped & ~(same & prev_mapped)
+
+    midx = np.flatnonzero(mapped)
+    run_of = np.cumsum(new_run)[midx] - 1        # run id per mapped row
+    n_runs = int(run_of[-1]) + 1 if midx.size else 0
+    run_ids = np.arange(n_runs)
+    first = midx[np.searchsorted(run_of, run_ids, side="left")]
+    last = midx[np.searchsorted(run_of, run_ids, side="right") - 1]
+    run_rid = refid[first]
+    run_bin = bins[first]
+    run_v0 = voffs[first]
+    run_v1 = cend[last]
+    for k in range(n_runs):
+        refs[int(run_rid[k])].bins.setdefault(int(run_bin[k]), []).append(
+            (int(run_v0[k]), int(run_v1[k])))
+
+    unset = np.uint64(0xFFFFFFFFFFFFFFFF)
+    for rid in np.unique(refid[mapped]):
+        m = mapped & (refid == rid)
+        w0 = beg[m] >> _LINEAR_SHIFT
+        w1 = np.maximum(end[m] - 1, beg[m]) >> _LINEAR_SHIFT
+        lin = np.full(int(w1.max()) + 1, unset, np.uint64)
+        v = voffs[m]
+        span = w1 - w0
+        for k in range(int(span.max()) + 1):
+            sel = span >= k
+            np.minimum.at(lin, w0[sel] + k, v[sel])
+        lin[lin == unset] = 0
+        refs[int(rid)].linear = [int(x) for x in lin]
+    return BaiIndex(refs=refs)
+
+
 def build_bai(bam_path: str, header=None) -> BaiIndex:
     """Build a BAI from a coordinate-sorted BAM in one streaming pass
-    (the htsjdk/samtools `index` equivalent, columnar: bins and reference
-    spans come from vectorized batch columns)."""
+    (the htsjdk/samtools `index` equivalent) — a thin wrapper over the
+    incremental ``BAIBuilder``; bins and reference spans come from
+    vectorized batch columns.  Spans are record-aligned and contiguous,
+    so the builder's next-record chunk ends coincide with the per-span
+    end voffsets the pre-builder implementation used."""
     from hadoop_bam_tpu.api.dataset import open_bam
 
     ds = open_bam(bam_path)
     header = header or ds.header
-    refs = [RefIndex() for _ in header.ref_names]
-    prev_voffs: Optional[np.ndarray] = None
+    builder = BAIBuilder(len(header.ref_names))
+    end_v = 0
 
     for span in ds.spans():
         from hadoop_bam_tpu.split.planners import read_bam_span
         batch = read_bam_span(bam_path, span, header=header)
+        end_v = (int(span.end[0]) << 16) | int(span.end[1])
         n = len(batch)
         if not n:
             continue
@@ -304,37 +469,10 @@ def build_bai(bam_path: str, header=None) -> BaiIndex:
         pos = batch.pos.astype(np.int64)            # 0-based
         span_len = np.maximum(batch.reference_span(), 1).astype(np.int64)
         end = pos + span_len                        # half-open
-        # chunk end of record i = start voffset of record i+1 (same span);
-        # the final record's end is the SPAN's end voffset — conservative
-        # (covers every record starting in the span) and block-aligned.
-        # The old fallback packed (coffset+1, 0), one BYTE past the block
-        # start: BGZFReader-based chunk reads tolerated that by accident,
-        # but block-table consumers (plan_interval_spans -> coverage's
-        # _fetch_span_raw) need end coffsets on real block boundaries and
-        # died mid-block with "truncated BGZF header"
-        nxt = np.empty(n, dtype=np.uint64)
-        nxt[:-1] = voffs[1:]
-        nxt[-1] = (int(span.end[0]) << 16) | int(span.end[1])
         for i in range(n):
-            rid = int(refid[i])
-            if rid < 0:
-                continue
-            ref = refs[rid]
-            b = reg2bin(int(pos[i]), int(end[i]))
-            v0, v1 = int(voffs[i]), int(nxt[i])
-            chunks = ref.bins.setdefault(b, [])
-            if chunks and chunks[-1][1] >= v0:      # adjacent: extend
-                chunks[-1] = (chunks[-1][0], v1)
-            else:
-                chunks.append((v0, v1))
-            w0, w1 = int(pos[i]) >> _LINEAR_SHIFT, \
-                int(end[i] - 1) >> _LINEAR_SHIFT
-            if len(ref.linear) <= w1:
-                ref.linear.extend([0] * (w1 + 1 - len(ref.linear)))
-            for w in range(w0, w1 + 1):
-                if ref.linear[w] == 0 or v0 < ref.linear[w]:
-                    ref.linear[w] = v0
-    return BaiIndex(refs=refs)
+            builder.add(int(refid[i]), int(pos[i]), int(end[i]),
+                        int(voffs[i]))
+    return builder.finalize(end_v)
 
 
 def write_bai(bam_path: str, out_path: Optional[str] = None) -> str:

@@ -15,7 +15,8 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from hadoop_bam_tpu.split.bai import (
-    RefIndex, _LINEAR_SHIFT, _METADATA_BIN, reg2bin, reg2bins,
+    IncrementalBinningCore, RefIndex, _LINEAR_SHIFT, _METADATA_BIN,
+    reg2bins,
 )
 
 TBI_MAGIC = b"TBI\x01"
@@ -117,6 +118,44 @@ class TabixIndex:
         return merged
 
 
+class TabixBuilder(IncrementalBinningCore):
+    """Incremental tabix construction — the text/BCF sibling of
+    ``split/bai.BAIBuilder``: one ``add`` per coordinate-sorted record,
+    ``finalize`` closes the trailing chunk.  Shared by the whole-file
+    builders below and the write path's index-during-write sink
+    (``write/indexing.IndexingSink``), which observes records as they
+    are written instead of rescanning the output.  The chunk/linear
+    machinery itself lives in ``IncrementalBinningCore``; this class
+    only adds contig-name interning and the tabix format block."""
+
+    def __init__(self, fmt: int = TBX_VCF, col_seq: int = 1,
+                 col_beg: int = 2, col_end: int = 0,
+                 meta_char: int = ord("#"), skip: int = 0):
+        super().__init__()
+        self.names: List[str] = []
+        self.refs: List[RefIndex] = []
+        self._rid_of: Dict[str, int] = {}
+        self._fmt_args = dict(fmt=fmt, col_seq=col_seq, col_beg=col_beg,
+                              col_end=col_end, meta_char=meta_char,
+                              skip=skip)
+
+    def add(self, rname: str, beg0: int, end0: int, voffset: int) -> None:
+        """Observe one record: 0-based half-open [beg0, end0) on contig
+        ``rname``, starting at packed virtual offset ``voffset``."""
+        self._close(voffset)
+        rid = self._rid_of.get(rname)
+        if rid is None:
+            rid = self._rid_of[rname] = len(self.names)
+            self.names.append(rname)
+            self.refs.append(RefIndex())
+        self._observe(rid, beg0, end0, voffset)
+
+    def finalize(self, end_voffset: int) -> TabixIndex:
+        self._close(end_voffset)
+        return TabixIndex(names=self.names, refs=self.refs,
+                          **self._fmt_args)
+
+
 def build_tabix(vcf_gz_path: str) -> TabixIndex:
     """Build a .tbi for a coordinate-sorted BGZF VCF in one streaming
     pass.  Line voffsets are tracked exactly by re-reading with a
@@ -125,9 +164,7 @@ def build_tabix(vcf_gz_path: str) -> TabixIndex:
     from hadoop_bam_tpu.utils.seekable import as_byte_source
 
     src = as_byte_source(vcf_gz_path)
-    names: List[str] = []
-    rid_of: Dict[str, int] = {}
-    refs: List[RefIndex] = []
+    builder = TabixBuilder()
     try:
         r = bgzf.BGZFReader(src)
 
@@ -151,7 +188,6 @@ def build_tabix(vcf_gz_path: str) -> TabixIndex:
                 break
             if line[:1] == b"#":
                 continue
-            v1 = r.voffset()
             parts = line.split(b"\t", 8)
             rname = parts[0].decode()
             pos1 = int(parts[1])
@@ -166,28 +202,14 @@ def build_tabix(vcf_gz_path: str) -> TabixIndex:
                         except ValueError:
                             pass
                         break
-            beg0, end0 = pos1 - 1, end1
-            rid = rid_of.get(rname)
-            if rid is None:
-                rid = rid_of[rname] = len(names)
-                names.append(rname)
-                refs.append(RefIndex())
-            ref = refs[rid]
-            b = reg2bin(beg0, end0)
-            chunks = ref.bins.setdefault(b, [])
-            if chunks and chunks[-1][1] >= v0:
-                chunks[-1] = (chunks[-1][0], v1)
-            else:
-                chunks.append((v0, v1))
-            w0, w1 = beg0 >> _LINEAR_SHIFT, max(end0 - 1, beg0) >> _LINEAR_SHIFT
-            if len(ref.linear) <= w1:
-                ref.linear.extend([0] * (w1 + 1 - len(ref.linear)))
-            for w in range(w0, w1 + 1):
-                if ref.linear[w] == 0 or v0 < ref.linear[w]:
-                    ref.linear[w] = v0
+            # the builder closes this record's chunk at the NEXT record's
+            # v0 (== this line's end position: lines are contiguous), so
+            # chunk ends equal the old explicit per-line v1 tracking
+            builder.add(rname, pos1 - 1, end1, v0)
+        final_v = r.voffset()
     finally:
         src.close()
-    return TabixIndex(names=names, refs=refs)
+    return builder.finalize(final_v)
 
 
 def build_bcf_tabix(bcf_path: str) -> TabixIndex:
@@ -212,9 +234,7 @@ def build_bcf_tabix(bcf_path: str) -> TabixIndex:
                 f"{bcf_path} is a raw (non-BGZF) BCF — virtual-offset "
                 f"indexing needs the BGZF container")
         codec = BCFRecordCodec(header)
-        names: List[str] = []
-        rid_of: Dict[str, int] = {}
-        refs: List[RefIndex] = []
+        builder = TabixBuilder()
         r = bgzf.BGZFReader(src)
         r.seek_voffset(first_voffset)
         while True:
@@ -225,31 +245,12 @@ def build_bcf_tabix(bcf_path: str) -> TabixIndex:
             l_shared, l_indiv = _struct.unpack("<II", head)
             body = r.read(l_shared + l_indiv)
             rec, _ = codec.decode(head + body, 0)
-            v1 = r.voffset()
             beg0 = rec.pos - 1
-            end0 = beg0 + max(rec.rlen, 1)
-            rid = rid_of.get(rec.chrom)
-            if rid is None:
-                rid = rid_of[rec.chrom] = len(names)
-                names.append(rec.chrom)
-                refs.append(RefIndex())
-            ref = refs[rid]
-            b = reg2bin(beg0, end0)
-            chunks = ref.bins.setdefault(b, [])
-            if chunks and chunks[-1][1] >= v0:
-                chunks[-1] = (chunks[-1][0], v1)
-            else:
-                chunks.append((v0, v1))
-            w0 = beg0 >> _LINEAR_SHIFT
-            w1 = max(end0 - 1, beg0) >> _LINEAR_SHIFT
-            if len(ref.linear) <= w1:
-                ref.linear.extend([0] * (w1 + 1 - len(ref.linear)))
-            for w in range(w0, w1 + 1):
-                if ref.linear[w] == 0 or v0 < ref.linear[w]:
-                    ref.linear[w] = v0
+            builder.add(rec.chrom, beg0, beg0 + max(rec.rlen, 1), v0)
+        final_v = r.voffset()
     finally:
         src.close()
-    return TabixIndex(names=names, refs=refs)
+    return builder.finalize(final_v)
 
 
 def write_tabix(path: str, out_path: Optional[str] = None) -> str:

@@ -431,9 +431,32 @@ def cmd_vcf_stats(args) -> int:
 # sort
 # ---------------------------------------------------------------------------
 
+def _write_config(args):
+    """Write-path knobs shared by the sort verbs -> an HBamConfig."""
+    import dataclasses
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    overrides = {}
+    if getattr(args, "compress_level", None) is not None:
+        # validate at the argv boundary: an out-of-range level would
+        # otherwise surface as a raw zlib.error from a pool worker —
+        # and inconsistently, since the native backend accepts levels
+        # zlib rejects
+        if not 0 <= args.compress_level <= 9:
+            raise SystemExit(
+                f"--compress-level must be in 0-9, "
+                f"got {args.compress_level}")
+        overrides["write_compress_level"] = args.compress_level
+    if getattr(args, "no_write_index", False):
+        overrides["write_index_kinds"] = "none"
+    return dataclasses.replace(DEFAULT_CONFIG, **overrides) \
+        if overrides else DEFAULT_CONFIG
+
+
 def cmd_sort(args) -> int:
     if args.run_records is not None and args.run_records <= 0:
         raise SystemExit("--run-records must be positive")
+    cfg = _write_config(args)
     if args.mesh:
         if args.by_name:
             raise SystemExit(
@@ -442,9 +465,10 @@ def cmd_sort(args) -> int:
         from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
         # --run-records under --mesh selects the multi-round SPILL
         # exchange: device memory bounded by ~that many records per
-        # device per round (the MR shuffle's spill)
+        # device per round (the MR shuffle's spill).  Output rides the
+        # write/ subsystem: pooled deflate + co-written index sidecars
         n = sort_bam_mesh(args.input, args.output, exchange=args.exchange,
-                          round_records=args.run_records)
+                          round_records=args.run_records, config=cfg)
         mode = "mesh spill" if args.run_records is not None else "mesh"
         print(f"wrote {args.output} ({n} records, coordinate, {mode})")
         return 0
@@ -453,6 +477,7 @@ def cmd_sort(args) -> int:
     from hadoop_bam_tpu.utils.sort import sort_bam
 
     n = sort_bam(args.input, args.output, by_name=args.by_name,
+                 config=cfg,
                  run_records=args.run_records
                  if args.run_records is not None else 1_000_000)
     so = "queryname" if args.by_name else "coordinate"
@@ -644,7 +669,7 @@ def cmd_vcf_sort(args) -> int:
 
     if args.run_records <= 0:
         raise SystemExit("--run-records must be positive")
-    n = sort_vcf(args.input, args.output,
+    n = sort_vcf(args.input, args.output, config=_write_config(args),
                  run_records=args.run_records)
     print(f"wrote {args.output} ({n} records)")
     return 0
@@ -731,6 +756,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "all_to_all; single-host) or 'bytes' (record bytes "
                          "ride it; required and default under "
                          "jax.distributed multi-host runs)")
+    so.add_argument("--compress-level", type=int, default=None,
+                    metavar="0-9",
+                    help="BGZF deflate level for the output (default "
+                         "config write_compress_level = 6; the "
+                         "hbam.write-compress-level key)")
+    so.add_argument("--no-write-index", action="store_true",
+                    help="skip the BAI + splitting-index sidecars the "
+                         "write path co-writes with coordinate-sorted "
+                         "output (-n output is never indexed)")
     so.set_defaults(fn=cmd_sort, uses_device=False)
 
     cov = sub.add_parser("coverage",
@@ -814,14 +848,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "collective lockstep (CL2xx), error taxonomy "
                              "(ET3xx), layout contracts (LC4xx), "
                              "observability discipline (OB6xx), serving "
-                             "cache bounds (SV8xx); exits non-zero on "
-                             "unsuppressed findings")
+                             "cache bounds (SV8xx), write-path atomicity "
+                             "(WR10x); exits non-zero on unsuppressed "
+                             "findings")
     ln.add_argument("--root", default=None,
                     help="package directory to analyze")
     ln.add_argument("--only", action="append", metavar="ANALYZER",
                     help="run one analyzer (trace_safety, lockstep, "
                          "taxonomy, layout, feedpath, querycache, obs, "
-                         "decodepath, servebounds); repeatable")
+                         "decodepath, servebounds, writepath); repeatable")
     ln.add_argument("--baseline", default=None,
                     help="baseline file (default analysis/baseline.json)")
     ln.add_argument("--no-baseline", action="store_true")
@@ -835,6 +870,12 @@ def build_parser() -> argparse.ArgumentParser:
     vs.add_argument("input")
     vs.add_argument("output")
     vs.add_argument("--run-records", type=int, default=1_000_000)
+    vs.add_argument("--compress-level", type=int, default=None,
+                    metavar="0-9",
+                    help="BGZF deflate level for compressed output")
+    vs.add_argument("--no-write-index", action="store_true",
+                    help="skip the .tbi sidecar co-written with sorted "
+                         "BCF output")
     vs.set_defaults(fn=cmd_vcf_sort, uses_device=False)
     return p
 

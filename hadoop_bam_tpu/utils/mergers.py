@@ -15,14 +15,21 @@ import io
 import os
 from typing import Iterable, List, Optional, Sequence
 
+from hadoop_bam_tpu.config import DEFAULT_CONFIG
 from hadoop_bam_tpu.formats import bgzf
 from hadoop_bam_tpu.formats.bam import SAMHeader
 
 
-def prepare_bam_output(sink, header: SAMHeader, level: int = 6) -> None:
+def _level(level: Optional[int]) -> int:
+    # default follows the write_compress_level knob, not a literal 6
+    return DEFAULT_CONFIG.write_compress_level if level is None else level
+
+
+def prepare_bam_output(sink, header: SAMHeader,
+                       level: Optional[int] = None) -> None:
     """Write the initial (BGZF-compressed) BAM header bytes — the
     SAMOutputPreparer step when composing final outputs from shards."""
-    w = bgzf.BGZFWriter(sink, level=level, write_eof=False)
+    w = bgzf.BGZFWriter(sink, level=_level(level), write_eof=False)
     w.write(header.to_bam_bytes())
     w.close()
 
@@ -38,7 +45,8 @@ def _strip_trailing_eof(data: bytes) -> bytes:
 
 
 def merge_bam_shards(shard_paths: Sequence[str], out_path: str,
-                     header: SAMHeader, level: int = 6) -> None:
+                     header: SAMHeader,
+                     level: Optional[int] = None) -> None:
     """Header + concatenated shards + EOF terminator -> one legal BAM."""
     with open(out_path, "wb") as out:
         prepare_bam_output(out, header, level=level)
@@ -49,7 +57,8 @@ def merge_bam_shards(shard_paths: Sequence[str], out_path: str,
 
 
 def merge_bam_shards_reblocked(shard_paths: Sequence[str], out_path: str,
-                               header: SAMHeader, level: int = 6) -> None:
+                               header: SAMHeader,
+                               level: Optional[int] = None) -> None:
     """Like merge_bam_shards, but re-compresses the shards into ONE
     continuous BGZF stream (header and records share the 64 KiB block
     framing) instead of concatenating shard members.  The output is
@@ -62,7 +71,7 @@ def merge_bam_shards_reblocked(shard_paths: Sequence[str], out_path: str,
     from hadoop_bam_tpu.ops import inflate as inflate_ops
 
     with open(out_path, "wb") as out:
-        with BamWriter(out, header, level=level) as w:
+        with BamWriter(out, header, level=_level(level)) as w:
             for p in shard_paths:
                 raw = open(p, "rb").read()
                 if not raw:
@@ -85,13 +94,13 @@ def merge_sam_shards(shard_paths: Sequence[str], out_path: str,
 
 def merge_vcf_shards(shard_paths: Sequence[str], out_path: str,
                      header: "VCFHeader", compress: bool = False,
-                     level: int = 6) -> None:
+                     level: Optional[int] = None) -> None:
     """hb/util/VCFFileMerger.java: header once + headerless text shards; for
     BGZF output the header gets its own member(s) and shards concatenate as
     legal BGZF members, terminated by the EOF block."""
     if compress:
         with open(out_path, "wb") as out:
-            w = bgzf.BGZFWriter(out, level=level, write_eof=False)
+            w = bgzf.BGZFWriter(out, level=_level(level), write_eof=False)
             w.write(header.to_text().encode())
             w.close()
             for p in shard_paths:
@@ -109,12 +118,13 @@ def merge_vcf_shards(shard_paths: Sequence[str], out_path: str,
 
 
 def merge_bcf_shards(shard_paths: Sequence[str], out_path: str,
-                     header: "VCFHeader", level: int = 6) -> None:
+                     header: "VCFHeader",
+                     level: Optional[int] = None) -> None:
     """Header block once (BGZF member) + concatenated headerless BCF shards
     + EOF terminator -> one legal BCF."""
     from hadoop_bam_tpu.formats.bcf import encode_header
     with open(out_path, "wb") as out:
-        w = bgzf.BGZFWriter(out, level=level, write_eof=False)
+        w = bgzf.BGZFWriter(out, level=_level(level), write_eof=False)
         w.write(encode_header(header))
         w.close()
         for p in shard_paths:

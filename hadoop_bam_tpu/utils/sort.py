@@ -166,7 +166,35 @@ def sort_bam(input_path: str, output_path: str, *, by_name: bool = False,
                 w.write_record_bytes(rec)
 
     def write_output(recs) -> None:
-        with BamWriter(output_path, out_header) as w:
+        if not by_name:
+            # coordinate output rides the parallel write path: pooled
+            # deflate + co-written index sidecars (write_index_kinds /
+            # --no-write-index), byte-identical to the serial BamWriter.
+            # Queryname order keeps the plain writer — a genomic index
+            # on name-sorted records would be meaningless.
+            import numpy as np
+
+            from hadoop_bam_tpu.write import write_bam_records
+
+            def chunks():
+                buf: List[bytes] = []
+                offs: List[int] = []
+                pos = 0
+                for rec in recs:
+                    buf.append(rec)
+                    offs.append(pos)
+                    pos += len(rec)
+                    if pos >= (8 << 20):
+                        yield b"".join(buf), np.asarray(offs, np.int64)
+                        buf, offs, pos = [], [], 0
+                if buf:
+                    yield b"".join(buf), np.asarray(offs, np.int64)
+
+            write_bam_records(output_path, out_header, chunks(),
+                              config=config)
+            return
+        with BamWriter(output_path, out_header,
+                       level=config.write_compress_level) as w:
             for rec in recs:
                 w.write_record_bytes(rec)
 
@@ -218,6 +246,13 @@ def sort_vcf(input_path: str, output_path: str, *,
                 f.write(rec.to_line() + "\n")
 
     def write_output(recs) -> None:
+        if output_path.lower().endswith(".bcf"):
+            # BCF output routes through the parallel write path: pooled
+            # deflate + a co-written .tbi, so the sorted output is
+            # immediately region-queryable (byte-identical to BcfWriter)
+            from hadoop_bam_tpu.write import write_bcf_records
+            write_bcf_records(output_path, header, recs, config=config)
+            return
         with open_vcf_writer(output_path, header, config=config) as w:
             for rec in recs:
                 w.write_record(rec)

@@ -1,0 +1,26 @@
+"""Mesh-parallel write path: sharded BGZF writers with self-indexing.
+
+The OutputFormat side of the repo (PAPER.md §1: Hadoop-BAM ships
+OutputFormats alongside InputFormats).  Pieces:
+
+- ``parallel_bgzf.ParallelBGZFWriter`` — pool-parallel deflate with a
+  single order-preserving committer; byte-identical to the serial
+  ``formats/bgzf.BGZFWriter``.
+- ``sharded.ShardedFileWriter`` — deterministic per-shard temp files +
+  atomic final publication for multi-host producers.
+- ``indexing`` — BAI / tabix / splitting-index sidecars generated during
+  the write (no rescan).
+- ``api.write_bam_records`` / ``api.write_bcf_records`` — the front door
+  ``parallel/mesh_sort.py`` and the CLI route through.
+"""
+from hadoop_bam_tpu.write.api import (            # noqa: F401
+    WriteResult, write_bam_records, write_bam_shards_concat,
+    write_bcf_records,
+)
+from hadoop_bam_tpu.write.indexing import (       # noqa: F401
+    BamIndexingSink, BcfIndexingSink, resolve_index_kinds,
+)
+from hadoop_bam_tpu.write.parallel_bgzf import (  # noqa: F401
+    ParallelBGZFWriter,
+)
+from hadoop_bam_tpu.write.sharded import ShardedFileWriter  # noqa: F401

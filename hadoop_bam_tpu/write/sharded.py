@@ -1,0 +1,96 @@
+"""ShardedFileWriter — deterministic shard files + atomic publication.
+
+The multi-host write protocol of the mesh sort (and any future sharded
+producer): shard k is written by the host that owns device position k
+into a deterministic part file inside a sibling shard directory, hosts
+barrier (the caller owns the collective — this class is I/O only), and
+host 0 concatenates the parts into the final file.  Two atomicity rules,
+both enforced here so no caller can get them wrong:
+
+- each PART is written to ``part-NNNNN.tmp`` and renamed into place on
+  successful close, so a crashed host never leaves a plausible-looking
+  truncated part for the merger to concatenate;
+- the FINAL file is produced by a builder callback that itself writes
+  through a temp + ``os.replace`` (``write/api.py`` does), so a partial
+  output is never visible under the final name — readers either see the
+  old file or the complete new one.
+
+The shard directory lives next to the final path (``<final><suffix>``)
+— on a shared filesystem that is exactly the property multi-host needs
+(every host writes into the same directory host 0 reads).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+from typing import Callable, Iterator, List, Sequence
+
+
+class ShardedFileWriter:
+    """Per-shard temp files + ordered concatenation (module docstring)."""
+
+    def __init__(self, final_path: str, n_shards: int, *,
+                 dir_suffix: str = ".hbam-shards"):
+        self.final_path = final_path
+        self.n_shards = int(n_shards)
+        self.shard_dir = final_path + dir_suffix
+
+    # -- shard side (every host) --------------------------------------------
+
+    def prepare(self) -> None:
+        """Remove stale parts from an earlier failed run.  Call on ONE
+        host, BEFORE the barrier that precedes any shard write."""
+        shutil.rmtree(self.shard_dir, ignore_errors=True)
+
+    def shard_path(self, k: int) -> str:
+        return os.path.join(self.shard_dir, f"part-{k:05d}")
+
+    @contextlib.contextmanager
+    def open_shard(self, k: int) -> Iterator:
+        """Open shard ``k`` for writing; the part becomes visible under
+        its deterministic name only when the block exits cleanly."""
+        os.makedirs(self.shard_dir, exist_ok=True)
+        part = self.shard_path(k)
+        tmp_part = part + ".tmp"
+        f = open(tmp_part, "wb")
+        try:
+            yield f
+        except BaseException:
+            f.close()
+            with contextlib.suppress(OSError):
+                os.unlink(tmp_part)
+            raise
+        f.close()
+        os.replace(tmp_part, part)
+
+    # -- merge side (host 0) -------------------------------------------------
+
+    def parts(self) -> List[str]:
+        return [self.shard_path(k) for k in range(self.n_shards)]
+
+    def missing_parts(self) -> List[str]:
+        return [p for p in self.parts() if not os.path.exists(p)]
+
+    def concatenate(self, build: Callable[[Sequence[str]], object],
+                    what: str = "sharded write",
+                    cleanup: bool = True) -> object:
+        """Run ``build(parts)`` — which must publish the final file
+        atomically itself (``write_bam_records`` does) — then remove the
+        shard directory (``cleanup=False`` preserves it, e.g. under a
+        debug-keep flag).  Refuses on missing parts: every shard writes
+        exactly one part (empty shards included), so absence means
+        shared-filesystem lag or data loss, never a benign skip."""
+        missing = self.missing_parts()
+        if missing:
+            raise RuntimeError(
+                f"{what}: shard(s) missing at merge time: {missing[:3]}"
+                f"{'...' if len(missing) > 3 else ''} — is "
+                f"{self.shard_dir} on a filesystem shared by all hosts?")
+        result = build(self.parts())
+        if cleanup:
+            self.cleanup()
+        return result
+
+    def cleanup(self) -> None:
+        shutil.rmtree(self.shard_dir, ignore_errors=True)

@@ -37,6 +37,7 @@ def _fill_state(bench, n_notes=6):
         ("deflate_tokenize_gbps", 0.41, "GB/s", 0.8),
         ("coverage_records_per_sec", 375000.2, "records/s", 1.25),
         ("sort_records_per_sec_mesh", 47368.1, "records/s", 6.6),
+        ("sort_write_mb_per_sec", 38.52, "MB/s", 0.97),
         ("seq_pallas_kernel_bases_per_sec", 1.9e9, "bases/s", 12.2),
         ("cigar_pileup_kernel_records_per_sec", 8.1e6, "records/s", None),
         ("mesh_sort_device_sort_keys_per_sec", 5.4e7, "keys/s", None),
@@ -67,6 +68,14 @@ def _fill_state(bench, n_notes=6):
                        cold_p50_ms=44.2, warm_host_decode_share=0.0,
                        clients_qps=[[1, 196.0], [8, 188.9]],
                        regions=250, distinct_windows=51)
+        if m == "sort_write_mb_per_sec":
+            # the write-path row: parallel vs serial arm, deflate wall
+            # share, byte identity — full row only; the contract pins
+            # row SHAPE (the speedup is host-dependent on the 1-core
+            # bench machine), never a ratio
+            row.update(serial_mb_per_sec=39.7, write_deflate_share=0.41,
+                       records=100000, output_bytes=9_100_000,
+                       byte_identical_to_serial=True)
         if m == "obs_overhead_pct":
             row.update(instrumented_s=0.1301, null_s=0.1284)
         if m == "device_inflate_records_per_sec":
@@ -127,6 +136,7 @@ def test_final_line_fits_budget_and_parses(bench):
     assert out["vs_baseline"] == 2.87
     # compressed matrix: name -> value, errors/skips as strings
     assert out["components"]["bcf_variants_per_sec"] == 612345.7
+    assert out["components"]["sort_write_mb_per_sec"] == 38.52
     assert out["components"]["broken_row"] == "error"
     assert out["components"]["late_row"] == "skipped"
     # r9: the obs overhead row rides the compact matrix, and the warm
@@ -178,6 +188,13 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     # r12: the device decode plane row pins the tokenize / device-resolve
     # wall breakdown and overlap accounting — full row only, the compact
     # line keeps just the rate
+    # the write-path row pins the arm comparison fields and byte
+    # identity — shape only, no ratio (host-dependent on 1 core)
+    sw = by_metric["sort_write_mb_per_sec"]
+    assert sw["serial_mb_per_sec"] > 0
+    assert 0.0 <= sw["write_deflate_share"] <= 1.0
+    assert sw["byte_identical_to_serial"] is True
+    assert sw["records"] > 0 and sw["output_bytes"] > 0
     di = by_metric["device_inflate_records_per_sec"]
     planes = di["decode_plane_walls"]
     assert set(planes) == {"device", "fused"}

@@ -700,6 +700,99 @@ class Loop:
 
 
 # ---------------------------------------------------------------------------
+# write-path discipline (WR10x)
+# ---------------------------------------------------------------------------
+
+_WR_BAD = '''
+import os
+from hadoop_bam_tpu.formats.bgzf import deflate_block
+
+def publish(final_path, blocks):
+    with open(final_path, "wb") as f:      # WR101: no temp, no replace
+        for b in blocks:
+            f.write(b)
+
+def compress_all(payloads):
+    out = []
+    for p in payloads:
+        out.append(deflate_block(p, 6))    # WR102: serial deflate loop
+    return out
+'''
+
+_WR_CLEAN = '''
+import os
+from hadoop_bam_tpu.formats.bgzf import deflate_block
+
+def publish(final_path, blocks):
+    tmp_path = final_path + ".tmp"
+    with open(tmp_path, "wb") as f:        # temp name + atomic replace
+        for b in blocks:
+            f.write(b)
+    os.replace(tmp_path, final_path)
+
+def _deflate_task(payload):
+    return deflate_block(payload, 6)       # single block, pool-submitted
+
+class Writer:
+    def _commit_loop(self, q, sink):
+        while True:
+            fut = q.get()
+            if fut is None:
+                return
+            sink.write(fut.result())
+'''
+
+
+def test_wr_seeded_violations_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/write/bad_writer.py": _WR_BAD},
+        only=["writepath"])
+    assert rules_of(findings) == {"WR101", "WR102"}
+    assert all(f.severity == "error" for f in findings)
+    assert any("os.replace" in f.message for f in findings)
+    assert any("ParallelBGZFWriter" in f.message for f in findings)
+
+
+def test_wr_clean_idioms_pass():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/write/good_writer.py": _WR_CLEAN},
+        only=["writepath"])
+    assert findings == []
+
+
+def test_wr_replace_in_function_exempts_open():
+    # a function that opens the final path but renames it into place is
+    # the approved idiom even when the variable name is not tmp-ish
+    findings = lint_sources({"hadoop_bam_tpu/write/renamer.py": '''
+import os
+
+def publish(final_path, data):
+    staging = final_path + ".new"
+    with open(staging, "wb") as f:
+        f.write(data)
+    os.replace(staging, final_path)
+'''}, only=["writepath"])
+    assert findings == []
+
+
+def test_wr_outside_write_not_scoped():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/utils/elsewhere.py": _WR_BAD,
+         "hadoop_bam_tpu/formats/elsewhere.py": _WR_BAD},
+        only=["writepath"])
+    assert findings == []
+
+
+def test_wr_read_mode_open_not_flagged():
+    findings = lint_sources({"hadoop_bam_tpu/write/reader.py": '''
+def load(final_path):
+    with open(final_path, "rb") as f:
+        return f.read()
+'''}, only=["writepath"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip / suppression
 # ---------------------------------------------------------------------------
 

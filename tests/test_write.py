@@ -1,0 +1,640 @@
+"""Write-path tests (``pytest -m write``): ParallelBGZFWriter byte
+identity vs the serial oracle under randomized chunking and worker
+counts, index-during-write sidecars, atomic publication, the sharded
+writer protocol, and the write→query round trip — sorted output written
+by the new path opened COLD by the query engine using only its
+co-written sidecars, byte-identical to querying a serially-written
+oracle file.
+"""
+import concurrent.futures as cf
+import dataclasses
+import io
+import os
+import random
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG
+from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.formats.bamio import BamWriter
+from hadoop_bam_tpu.utils.errors import PlanError
+from hadoop_bam_tpu.write import (
+    ParallelBGZFWriter, ShardedFileWriter, resolve_index_kinds,
+    write_bam_records, write_bam_shards_concat, write_bcf_records,
+)
+
+from fixtures import make_header, make_records
+
+pytestmark = pytest.mark.write
+
+
+def _coord_sorted(header, recs):
+    def key(r):
+        rid = (header.ref_names.index(r.rname) if r.rname != "*"
+               else 1 << 30)
+        return (rid, r.pos)
+    return sorted(recs, key=key)
+
+
+def _record_chunks(header, recs, n_chunks=4):
+    """(data, offsets) chunks of encoded records, file order."""
+    blobs = [r.to_bam_bytes(header) for r in recs]
+    per = max(1, len(blobs) // n_chunks)
+    for i in range(0, len(blobs), per):
+        group = blobs[i:i + per]
+        lens = np.asarray([len(b) for b in group], np.int64)
+        yield b"".join(group), np.cumsum(lens) - lens
+
+
+# ---------------------------------------------------------------------------
+# ParallelBGZFWriter ≡ BGZFWriter bytes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("workers", [0, 1, 4, 8])
+def test_parallel_bgzf_byte_identity_fuzz(workers):
+    """The acceptance bar: byte-identical to the serial writer across
+    randomized payload splits and worker counts (0 = serial in-line)."""
+    rng = random.Random(workers)
+    data = (bytes(rng.randrange(256) for _ in range(200_000))
+            + b"G" * 400_000
+            + bytes(rng.randrange(4) for _ in range(150_000)))
+    oracle = io.BytesIO()
+    with bgzf.BGZFWriter(oracle, level=6) as w:
+        w.write(data)
+    pool = cf.ThreadPoolExecutor(max(workers, 1)) if workers else None
+    try:
+        sink = io.BytesIO()
+        pw = ParallelBGZFWriter(sink, level=6, pool=pool,
+                                max_inflight=workers)
+        i = 0
+        while i < len(data):
+            n = rng.randrange(1, 100_000)
+            pw.write(data[i:i + n])
+            i += n
+        pw.close()
+        assert sink.getvalue() == oracle.getvalue()
+    finally:
+        if pool:
+            pool.shutdown()
+
+
+def test_parallel_bgzf_levels_and_eof():
+    data = b"ACGT" * 50_000
+    for level in (1, 6, 9):
+        oracle = io.BytesIO()
+        with bgzf.BGZFWriter(oracle, level=level) as w:
+            w.write(data)
+        sink = io.BytesIO()
+        with ParallelBGZFWriter(sink, level=level, max_inflight=2,
+                                pool=cf.ThreadPoolExecutor(2)) as pw:
+            pw.write(data)
+        assert sink.getvalue() == oracle.getvalue()
+        assert sink.getvalue().endswith(bgzf.EOF_BLOCK)
+    # no-EOF flavor concatenates like a headerless shard
+    sink = io.BytesIO()
+    with ParallelBGZFWriter(sink, write_eof=False, max_inflight=0) as pw:
+        pw.write(data)
+    assert not sink.getvalue().endswith(bgzf.EOF_BLOCK)
+    assert bgzf.decompress_bytes(sink.getvalue()) == data
+
+
+def test_resolved_voffsets_match_serial_tracking():
+    """Payload-offset tokens resolve to exactly the voffsets the serial
+    BamWriter records at write time — the property every index sidecar
+    rests on."""
+    header = make_header()
+    recs = _coord_sorted(header, make_records(header, 800, seed=5))
+    blobs = [r.to_bam_bytes(header) for r in recs]
+
+    oracle = io.BytesIO()
+    w = BamWriter(oracle, header, track_voffsets=True)
+    for b in blobs:
+        w.write_record_bytes(b)
+    w.close()
+    serial_voffs = w.record_voffsets()
+
+    sink = io.BytesIO()
+    pw = ParallelBGZFWriter(sink, max_inflight=4,
+                            pool=cf.ThreadPoolExecutor(4))
+    tokens = []
+    pw.write(header.to_bam_bytes())
+    for b in blobs:
+        tokens.append(pw.tell_payload_offset())
+        pw.write(b)
+    pw.close()
+    assert sink.getvalue() == oracle.getvalue()
+    resolved = pw.resolve_voffsets(np.asarray(tokens, np.int64))
+    assert [int(v) for v in resolved] == [int(v) for v in serial_voffs]
+
+
+def test_resolve_before_close_is_plan_error():
+    pw = ParallelBGZFWriter(io.BytesIO(), max_inflight=0)
+    pw.write(b"x" * 10)
+    with pytest.raises(PlanError):
+        pw.resolve_voffsets(np.asarray([0]))
+    pw.close()
+
+
+def test_parallel_writer_sink_error_propagates_without_hang():
+    class BadSink:
+        def write(self, b):
+            raise OSError("disk on fire")
+
+    pw = ParallelBGZFWriter(BadSink(), max_inflight=2,
+                            pool=cf.ThreadPoolExecutor(2))
+    with pytest.raises(OSError, match="disk on fire"):
+        # enough payload to force blocks through the committer
+        for _ in range(64):
+            pw.write(b"z" * bgzf.WRITE_PAYLOAD_SIZE)
+        pw.close()
+
+
+# ---------------------------------------------------------------------------
+# write_bam_records: bytes, sidecars, atomicity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sorted_fixture():
+    header = make_header(2)
+    recs = _coord_sorted(header, make_records(header, 1500, seed=11))
+    return header, recs
+
+
+def _oracle_bam(tmp_path, header, recs, name="oracle.bam"):
+    path = str(tmp_path / name)
+    with BamWriter(path, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    return path
+
+
+def test_write_bam_records_byte_identical_with_sidecars(tmp_path,
+                                                        sorted_fixture):
+    header, recs = sorted_fixture
+    oracle = _oracle_bam(tmp_path, header, recs)
+    out = str(tmp_path / "par.bam")
+    res = write_bam_records(out, header, _record_chunks(header, recs))
+    assert res.records == len(recs)
+    assert open(out, "rb").read() == open(oracle, "rb").read()
+    assert sorted(res.sidecars) == [".bai", ".sbi"]
+    assert os.path.exists(out + ".bai") and os.path.exists(out + ".sbi")
+    # no tmp litter
+    assert not [f for f in os.listdir(tmp_path) if "hbam-write-tmp" in f]
+
+
+def test_cowritten_bai_queries_like_posthoc_bai(tmp_path, sorted_fixture):
+    """The co-written .bai answers interval queries exactly like a
+    post-hoc build_bai over the same bytes."""
+    from hadoop_bam_tpu.split.bai import BaiIndex, build_bai
+
+    header, recs = sorted_fixture
+    out = str(tmp_path / "q.bam")
+    write_bam_records(out, header, _record_chunks(header, recs))
+    cowritten = BaiIndex.from_bytes(open(out + ".bai", "rb").read())
+    posthoc = build_bai(out)
+    for rid in range(len(header.ref_names)):
+        for beg, end in ((0, 1 << 29), (5_000, 20_000), (0, 1),
+                         (100_000, 400_000)):
+            assert cowritten.query(rid, beg, end) \
+                == posthoc.query(rid, beg, end), (rid, beg, end)
+
+
+def test_cowritten_sbi_matches_index_on_write(tmp_path, sorted_fixture):
+    """The co-written .sbi equals BamWriter's index-on-write sidecar
+    byte for byte (same granularity, same sampled voffsets)."""
+    header, recs = sorted_fixture
+    g = DEFAULT_CONFIG.splitting_index_granularity
+    oracle = str(tmp_path / "o.bam")
+    with BamWriter(oracle, header, index_granularity=g,
+                   index_flavor="sbi") as w:
+        for r in recs:
+            w.write_sam_record(r)
+    out = str(tmp_path / "p.bam")
+    write_bam_records(out, header, _record_chunks(header, recs))
+    assert open(out + ".sbi", "rb").read() \
+        == open(oracle + ".sbi", "rb").read()
+
+
+def test_write_index_kinds_none_and_validation(tmp_path, sorted_fixture):
+    header, recs = sorted_fixture
+    out = str(tmp_path / "noidx.bam")
+    cfg = dataclasses.replace(DEFAULT_CONFIG, write_index_kinds="none")
+    res = write_bam_records(out, header, _record_chunks(header, recs),
+                            config=cfg)
+    assert res.sidecars == {}
+    assert not os.path.exists(out + ".bai")
+    with pytest.raises(PlanError):
+        resolve_index_kinds(
+            dataclasses.replace(DEFAULT_CONFIG, write_index_kinds="tbi"),
+            "bam")
+    assert resolve_index_kinds(DEFAULT_CONFIG, "bcf") == ("tbi",)
+
+
+def test_failed_write_leaves_nothing_visible(tmp_path, sorted_fixture):
+    header, recs = sorted_fixture
+    out = str(tmp_path / "crash.bam")
+
+    def bad_chunks():
+        yield from _record_chunks(header, recs, n_chunks=8)
+        raise RuntimeError("producer died")
+
+    with pytest.raises(RuntimeError, match="producer died"):
+        write_bam_records(out, header, bad_chunks())
+    assert not os.path.exists(out)
+    assert not os.path.exists(out + ".bai")
+    assert [f for f in os.listdir(tmp_path) if "crash" in f] == []
+
+
+def test_write_compress_level_threads_through(tmp_path, sorted_fixture):
+    header, recs = sorted_fixture
+    cfg = dataclasses.replace(DEFAULT_CONFIG, write_compress_level=1)
+    oracle = str(tmp_path / "l1.bam")
+    with BamWriter(oracle, header, level=1) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    out = str(tmp_path / "l1p.bam")
+    write_bam_records(out, header, _record_chunks(header, recs),
+                      config=cfg)
+    assert open(out, "rb").read() == open(oracle, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# ShardedFileWriter
+# ---------------------------------------------------------------------------
+
+def test_sharded_writer_parts_and_atomic_concat(tmp_path, sorted_fixture):
+    header, recs = sorted_fixture
+    final = str(tmp_path / "final.bam")
+    sw = ShardedFileWriter(final, 3)
+    sw.prepare()
+    thirds = [recs[i::3] for i in range(3)]
+    for k in range(3):
+        with sw.open_shard(k) as f:
+            with BamWriter(f, header, write_header=False,
+                           write_eof=False) as w:
+                for r in _coord_sorted(header, thirds[k]):
+                    w.write_sam_record(r)
+        assert os.path.exists(sw.shard_path(k))
+        assert not os.path.exists(sw.shard_path(k) + ".tmp")
+    assert sw.missing_parts() == []
+    res = sw.concatenate(lambda parts: write_bam_shards_concat(
+        parts, final, header))
+    assert res.records == len(recs)
+    assert not os.path.isdir(sw.shard_dir)
+    from hadoop_bam_tpu.formats.bamio import read_bam
+    _, batch = read_bam(final)
+    assert len(batch) == len(recs)
+
+
+def test_sharded_writer_missing_part_refuses(tmp_path):
+    final = str(tmp_path / "f.bam")
+    sw = ShardedFileWriter(final, 2)
+    with sw.open_shard(0) as f:
+        f.write(b"")
+    with pytest.raises(RuntimeError, match="missing"):
+        sw.concatenate(lambda parts: None, what="unit")
+    assert not os.path.exists(final)
+
+
+def test_sharded_writer_failed_shard_leaves_no_part(tmp_path):
+    sw = ShardedFileWriter(str(tmp_path / "f.bam"), 1)
+    with pytest.raises(RuntimeError, match="boom"):
+        with sw.open_shard(0) as f:
+            f.write(b"xx")
+            raise RuntimeError("boom")
+    assert sw.missing_parts() == [sw.shard_path(0)]
+    assert not os.path.exists(sw.shard_path(0) + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# write→query round trip (the acceptance bar)
+# ---------------------------------------------------------------------------
+
+def test_bam_write_query_round_trip_cold(tmp_path, sorted_fixture,
+                                         monkeypatch):
+    """Output written by the new path is served COLD by QueryEngine
+    using only the co-written sidecars — no rescan, no build_bai — with
+    results byte-identical to querying a serially-written oracle."""
+    from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+    import hadoop_bam_tpu.split.bai as bai_mod
+
+    header, recs = sorted_fixture
+    oracle = _oracle_bam(tmp_path, header, recs)
+    bai_mod.write_bai(oracle)
+    out = str(tmp_path / "cold.bam")
+    write_bam_records(out, header, _record_chunks(header, recs))
+
+    # any rescan attempt on the new file is a test failure
+    def no_rescan(*a, **kw):
+        raise AssertionError("build_bai called — the co-written sidecar "
+                             "should have served the query")
+    monkeypatch.setattr(bai_mod, "build_bai", no_rescan)
+
+    regions = [f"{header.ref_names[0]}:1-60000",
+               f"{header.ref_names[1]}:100000-900000",
+               f"{header.ref_names[0]}:999999-1000000"]
+    res_new = QueryEngine().query_records(
+        [QueryRequest(out, r) for r in regions])
+    res_old = QueryEngine().query_records(
+        [QueryRequest(oracle, r) for r in regions])
+    for a, b in zip(res_new, res_old):
+        assert [r.to_line() for r in a.records] \
+            == [r.to_line() for r in b.records]
+    assert sum(len(r.records) for r in res_new) > 0
+
+
+def _make_vcf_records(n, seed=3):
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+    hdr_text = (
+        "##fileformat=VCFv4.2\n"
+        "##contig=<ID=chr20,length=64444167>\n"
+        "##contig=<ID=chr21,length=46709983>\n"
+        '##INFO=<ID=DP,Number=1,Type=Integer,Description="Depth">\n'
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="GT">\n'
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\ts0\n")
+    header = VCFHeader.from_text(hdr_text)
+    rng = random.Random(seed)
+    recs = []
+    for chrom in ("chr20", "chr21"):
+        pos = 1
+        for i in range(n // 2):
+            pos += rng.randint(1, 50)
+            ref = rng.choice("ACGT")
+            alt = rng.choice([c for c in "ACGT" if c != ref])
+            recs.append(VcfRecord.from_line(
+                f"{chrom}\t{pos}\t.\t{ref}\t{alt}\t{30 + i % 40}\tPASS\t"
+                f"DP={i % 90}\tGT\t{rng.choice(['0/0', '0/1', '1/1'])}"))
+    return header, recs
+
+
+def test_bcf_write_query_round_trip_cold(tmp_path):
+    """BCF + co-written tabix: byte-identical to the serial BcfWriter,
+    cold-queried identically to a serially-written + write_tabix'd
+    oracle."""
+    from hadoop_bam_tpu.formats.bcfio import BcfWriter
+    from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+    from hadoop_bam_tpu.split.tabix import write_tabix
+
+    header, recs = _make_vcf_records(900)
+    oracle = str(tmp_path / "o.bcf")
+    with BcfWriter(oracle, header) as w:
+        for r in recs:
+            w.write_record(r)
+    write_tabix(oracle)
+
+    out = str(tmp_path / "p.bcf")
+    res = write_bcf_records(out, header, iter(recs))
+    assert res.records == len(recs)
+    assert open(out, "rb").read() == open(oracle, "rb").read()
+    assert sorted(res.sidecars) == [".tbi"]
+
+    regions = ["chr20:1-5000", "chr21:1-100000", "chr20:999000-999999"]
+    res_new = QueryEngine().query_records(
+        [QueryRequest(out, r) for r in regions])
+    res_old = QueryEngine().query_records(
+        [QueryRequest(oracle, r) for r in regions])
+    for a, b in zip(res_new, res_old):
+        assert [r.to_line() for r in a.records] \
+            == [r.to_line() for r in b.records]
+    assert sum(len(r.records) for r in res_new) > 0
+
+
+def test_mesh_sort_output_is_immediately_queryable(tmp_path):
+    """sort_bam_mesh through the write path: sidecars land next to the
+    output and the query engine opens it cold (the ISSUE acceptance
+    composition)."""
+    from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
+    from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+
+    header = make_header()
+    recs = make_records(header, 700, seed=23)
+    random.Random(4).shuffle(recs)
+    src = str(tmp_path / "in.bam")
+    with BamWriter(src, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    out = str(tmp_path / "sorted.bam")
+    n = sort_bam_mesh(src, out)
+    assert n == len(recs)
+    assert os.path.exists(out + ".bai")
+    assert os.path.exists(out + ".sbi")
+    res = QueryEngine().query_records(
+        [QueryRequest(out, f"{header.ref_names[0]}:1-400000")])
+    mapped = [r for r in recs
+              if r.rname == header.ref_names[0]
+              and r.pos <= 400000 and r.pos + len(r.seq) - 1 >= 1]
+    assert len(res[0].records) == len(mapped)
+
+
+def test_mesh_sort_no_write_index_cli(tmp_path):
+    from hadoop_bam_tpu.tools.cli import main
+
+    header = make_header()
+    recs = make_records(header, 200, seed=8)
+    src = str(tmp_path / "in.bam")
+    with BamWriter(src, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    out = str(tmp_path / "s.bam")
+    assert main(["sort", "--mesh", "--no-write-index",
+                 "--compress-level", "4", src, out]) == 0
+    assert os.path.exists(out)
+    assert not os.path.exists(out + ".bai")
+    # level threaded: bytes match a level-4 serial sort
+    from hadoop_bam_tpu.utils.sort import sort_bam
+    ref = str(tmp_path / "ref.bam")
+    cfg = dataclasses.replace(DEFAULT_CONFIG, write_compress_level=4)
+    sort_bam(src, ref, config=cfg)
+    assert open(out, "rb").read() == open(ref, "rb").read()
+
+
+def test_vcf_sort_bcf_output_gets_tabix(tmp_path):
+    """hbam vcf-sort to .bcf routes through write_bcf_records: sorted
+    output plus a co-written .tbi."""
+    from hadoop_bam_tpu.api.writers import open_vcf_writer
+    from hadoop_bam_tpu.utils.sort import sort_vcf
+
+    header, recs = _make_vcf_records(300, seed=9)
+    shuffled = list(recs)
+    random.Random(2).shuffle(shuffled)
+    src = str(tmp_path / "in.vcf")
+    with open_vcf_writer(src, header) as w:
+        for r in shuffled:
+            w.write_record(r)
+    out = str(tmp_path / "sorted.bcf")
+    n = sort_vcf(src, out)
+    assert n == len(recs)
+    assert os.path.exists(out + ".tbi")
+    from hadoop_bam_tpu.formats.bcfio import read_bcf
+    _, back = read_bcf(out)
+    assert [(r.chrom, r.pos) for r in back] \
+        == [(r.chrom, r.pos) for r in recs]
+
+
+def test_sidecar_write_failure_leaves_final_name_unpublished(
+        tmp_path, sorted_fixture):
+    """A sidecar I/O failure must abort BEFORE the data rename: the old
+    output and its old sidecars stay intact, nothing is half-published
+    (the 'ENOSPC between data rename and sidecar write' hole)."""
+    from hadoop_bam_tpu.write.api import _TMP_SUFFIX
+
+    header, recs = sorted_fixture
+    out = str(tmp_path / "v.bam")
+    old_data, old_bai = b"OLD-DATA", b"OLD-BAI"
+    with open(out, "wb") as f:
+        f.write(old_data)
+    with open(out + ".bai", "wb") as f:
+        f.write(old_bai)
+    # a directory squatting on the .bai temp name makes the sidecar
+    # temp write fail deterministically, standing in for ENOSPC
+    os.mkdir(out + ".bai" + _TMP_SUFFIX)
+    with pytest.raises(OSError):
+        write_bam_records(out, header, _record_chunks(header, recs))
+    assert open(out, "rb").read() == old_data
+    assert open(out + ".bai", "rb").read() == old_bai
+    leftovers = [f for f in os.listdir(tmp_path)
+                 if f.startswith("v.bam") and _TMP_SUFFIX in f
+                 and not os.path.isdir(str(tmp_path / f))]
+    assert leftovers == []
+
+
+def test_data_rename_failure_cleans_sidecar_temps(tmp_path,
+                                                  sorted_fixture):
+    """If the data-file os.replace itself fails (dir squatting on the
+    final name), the already-written sidecar temps must not leak."""
+    from hadoop_bam_tpu.write.api import _TMP_SUFFIX
+
+    header, recs = sorted_fixture
+    out = str(tmp_path / "w.bam")
+    os.mkdir(out)                       # os.replace(file -> dir) raises
+    with pytest.raises(OSError):
+        write_bam_records(out, header, _record_chunks(header, recs))
+    assert [f for f in os.listdir(tmp_path) if _TMP_SUFFIX in f] == []
+
+
+def test_bai_from_columns_matches_incremental_builder():
+    """The vectorized column build is bit-identical to per-record
+    BAIBuilder.add over randomized coordinate-sorted inputs: multi-ref,
+    multi-window spans, same-bin runs broken by bin hops and by
+    unmapped records, unmapped tail."""
+    from hadoop_bam_tpu.split.bai import BAIBuilder, bai_from_columns
+
+    for seed in range(5):
+        rng = random.Random(seed)
+        n_ref = rng.randint(1, 4)
+        rows = []
+        voff = (rng.randrange(1, 1000) << 16) | rng.randrange(100)
+        for rid in range(n_ref):
+            pos = 0
+            for _ in range(rng.randrange(0, 300)):
+                pos += rng.randrange(0, 60_000)     # bin/window hops
+                span = rng.choice([1, 50, 151, 20_000, 40_000])
+                rows.append((rid, pos, pos + span, voff))
+                voff += rng.randrange(1, 90_000)    # crosses blocks
+        for _ in range(rng.randrange(0, 4)):        # unmapped tail
+            rows.append((-1, -1, 0, voff))
+            voff += rng.randrange(1, 1000)
+        end_v = voff + 37
+        cols = np.asarray(rows, np.int64).reshape(-1, 4)
+        b = BAIBuilder(n_ref)
+        for rid, beg, end, v in rows:
+            b.add(rid, beg, end, v)
+        serial = b.finalize(end_v).to_bytes()
+        vec = bai_from_columns(
+            n_ref, cols[:, 0], cols[:, 1], cols[:, 2],
+            cols[:, 3].astype(np.uint64), end_v).to_bytes()
+        assert vec == serial, f"seed {seed}"
+
+
+def test_cli_compress_level_range_validated(tmp_path):
+    from hadoop_bam_tpu.tools.cli import main
+
+    with pytest.raises(SystemExit, match="0-9"):
+        main(["sort", "--compress-level", "15", "in.bam", "out.bam"])
+
+
+def test_bcf_write_honors_header_and_terminator_knobs(tmp_path):
+    """write_bcf_records keeps the BcfShardWriter semantics it replaced
+    in sort_vcf: config.write_header / write_terminator change the
+    output bytes identically on both writers."""
+    from hadoop_bam_tpu.api.writers import BcfShardWriter
+
+    header, recs = _make_vcf_records(120, seed=3)
+    for knobs in ({"write_terminator": False},
+                  {"write_header": False},
+                  {"write_header": False, "write_terminator": False}):
+        cfg = dataclasses.replace(DEFAULT_CONFIG, **knobs)
+        oracle = str(tmp_path / "o.bcf")
+        w = BcfShardWriter(oracle, header, cfg)
+        for r in recs:
+            w.write_record(r)
+        w.close()
+        out = str(tmp_path / "p.bcf")
+        write_bcf_records(out, header, iter(recs), config=cfg,
+                          index_kinds=())
+        assert open(out, "rb").read() == open(oracle, "rb").read(), knobs
+
+
+def test_plain_sort_cowrites_sidecars_and_honors_flags(tmp_path):
+    """Non-mesh `hbam sort` routes coordinate output through the write
+    path too: sidecars co-written, --no-write-index honored, -n
+    (queryname) output never indexed."""
+    from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+    from hadoop_bam_tpu.tools.cli import main
+
+    header = make_header()
+    recs = make_records(header, 250, seed=31)
+    random.Random(6).shuffle(recs)
+    src = str(tmp_path / "in.bam")
+    with BamWriter(src, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+
+    out = str(tmp_path / "s.bam")
+    assert main(["sort", src, out]) == 0
+    assert os.path.exists(out + ".bai")
+    assert os.path.exists(out + ".sbi")
+    res = QueryEngine().query_records(
+        [QueryRequest(out, f"{header.ref_names[0]}:1-500000")])
+    assert len(res[0].records) > 0
+
+    bare = str(tmp_path / "bare.bam")
+    assert main(["sort", "--no-write-index", src, bare]) == 0
+    assert not os.path.exists(bare + ".bai")
+    assert open(bare, "rb").read() == open(out, "rb").read()
+
+    by_name = str(tmp_path / "n.bam")
+    assert main(["sort", "-n", src, by_name]) == 0
+    assert not os.path.exists(by_name + ".bai")
+
+
+# ---------------------------------------------------------------------------
+# BAIBuilder (satellite: incremental core behind build_bai)
+# ---------------------------------------------------------------------------
+
+def test_bai_builder_incremental_matches_posthoc(tmp_path,
+                                                 sorted_fixture):
+    """Feeding BAIBuilder record-at-a-time from writer-tracked voffsets
+    reproduces build_bai's query answers on the same file."""
+    from hadoop_bam_tpu.split.bai import BAIBuilder, build_bai
+
+    header, recs = sorted_fixture
+    path = str(tmp_path / "b.bam")
+    w = BamWriter(path, header, track_voffsets=True)
+    spans = []
+    for r in recs:
+        rid = header.ref_names.index(r.rname) if r.rname != "*" else -1
+        spans.append((rid, r.pos - 1, r.pos - 1 + max(len(r.seq), 1)))
+        w.write_sam_record(r)
+    w.close()
+    builder = BAIBuilder(len(header.ref_names))
+    for (rid, beg, end), v in zip(spans, w.record_voffsets()):
+        builder.add(rid, beg, end, int(v))
+    # normalized end-of-data: coffset of the EOF block
+    incr = builder.finalize(os.path.getsize(path) << 16)
+    posthoc = build_bai(path)
+    for rid in range(len(header.ref_names)):
+        for beg, end in ((0, 1 << 29), (2_000, 30_000), (0, 1)):
+            assert incr.query(rid, beg, end) == posthoc.query(
+                rid, beg, end)
