@@ -967,6 +967,184 @@ def bench_region_serve(path: str):
                      "clients_qps pins 1->8 client saturation")}
 
 
+def bench_faulted_serve(path: str):
+    """The degrade-and-heal serving row (ISSUE 11), three arms:
+
+    1. CLEAN: warm ServeLoop p50 over a zipf region subset — the
+       healthy-path reference.
+    2. CHAOS: a fresh loop under a seed-derived byte-source fault
+       schedule (transient + slow reads, reproducible from chaos_seed)
+       with a tight tenant quota driven by 4 concurrent clients — the
+       shed rate (every shed must be TRANSIENT taxonomy, never a hang),
+       the degraded warm p50, and its ratio to the clean p50.
+    3. HEAL: the decode-plane demotion ladder's recovery time — native
+       faults demote flagstat to zlib (breaker opens), then measure the
+       wall time until a half-open probe heals the plane after the
+       cooldown (byte-identity vs the clean answer asserted on every
+       run, faulted or not).
+    """
+    import dataclasses as _dc
+    import threading as _th
+
+    from hadoop_bam_tpu import resilience
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+    from hadoop_bam_tpu.resilience.chaos import (
+        PointFault, fault_points_on,
+    )
+    from hadoop_bam_tpu.serve import ServeLoop
+    from hadoop_bam_tpu.utils.errors import (
+        CorruptDataError, TransientIOError,
+    )
+    from hadoop_bam_tpu.utils.metrics import MetricsContext
+    from hadoop_bam_tpu.utils.resilient import (
+        clear_chaos, install_chaos_seeded,
+    )
+
+    bam, regions = _region_query_fixture(path)
+    regions = regions[:80]
+    chaos_seed = int(getattr(DEFAULT_CONFIG, "chaos_seed", None) or 1234)
+    quiet = _dc.replace(DEFAULT_CONFIG, serve_prefetch=False)
+    resilience.reset()
+
+    # -- arm 1: clean warm p50 -------------------------------------------
+    with ServeLoop(config=quiet) as loop:
+        for region in dict.fromkeys(regions):
+            loop.query(bam, [region])            # warm tiles
+        with MetricsContext() as clean_m:
+            for region in regions:
+                loop.query(bam, [region])
+        clean_lat = clean_m.hist_summary("serve.latency_s")
+
+    # -- arm 2: seeded chaos + tight quota, 4 clients --------------------
+    # transient_rate is per-READ-OFFSET and chunk decodes touch many
+    # block offsets, so the effective per-chunk fault count is ~rate *
+    # reads — each retry heals one offset and may trip the next; the
+    # retry budget must cover the expected fault count per chunk
+    chaos_cfg = _dc.replace(
+        DEFAULT_CONFIG, serve_prefetch=False, span_retries=8,
+        retry_backoff_base_s=0.001, retry_backoff_max_s=0.01,
+        serve_tenant_max_in_flight=2, serve_tenant_queue_depth=1,
+        breaker_cooldown_s=0.2)
+    # per-thread counters, summed after join — list[0] += 1 from 4
+    # threads is a non-atomic read/add/store and loses increments
+    served_k = [0, 0, 0, 0]
+    shed_k = [0, 0, 0, 0]
+    unclassified = []
+    with ServeLoop(config=chaos_cfg) as loop:
+        loop.query(bam, [regions[0]])            # jit/meta warmup
+        install_chaos_seeded(bam, chaos_seed, transient_rate=0.08,
+                             slow_rate=0.05, delay_s=0.001)
+        try:
+            with MetricsContext() as chaos_m:
+                def client(k):
+                    for region in regions[k::4]:
+                        try:
+                            loop.query(bam, [region], tenant="web",
+                                       deadline_s=30.0)
+                            served_k[k] += 1
+                        except (TransientIOError, CorruptDataError):
+                            shed_k[k] += 1       # classified: the contract
+                        except Exception as e:  # noqa: BLE001
+                            unclassified.append(e)
+
+                # threads do NOT inherit contextvars: give each client a
+                # copy of the MetricsContext-carrying context, or the
+                # degraded latency histogram lands in the process global
+                import contextvars as _cv
+                ctxs = [_cv.copy_context() for _ in range(4)]
+                ts = [_th.Thread(target=ctxs[k].run, args=(client, k))
+                      for k in range(4)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                chaos_dt = time.perf_counter() - t0
+                served = [sum(served_k)]
+                shed = [sum(shed_k)]
+            # the acceptance bar's number: warm (tile-resident) p50 with
+            # chaos STILL INSTALLED, single client — what a well-behaved
+            # client sees from a degraded-but-serving loop; must regress
+            # < 2x vs the clean arm (tile hits never touch the faulting
+            # byte source, so the chaos tax here is dispatcher overhead)
+            with MetricsContext() as warm_chaos_m:
+                for region in regions:
+                    try:
+                        loop.query(bam, [region], tenant="warm",
+                                   deadline_s=30.0)
+                    except (TransientIOError, CorruptDataError):
+                        pass             # tolerated; not part of p50
+            warm_chaos_lat = warm_chaos_m.hist_summary("serve.latency_s")
+        finally:
+            clear_chaos(bam)
+    if unclassified:
+        raise AssertionError(
+            f"unclassified failure under chaos: {unclassified[0]!r}")
+    chaos_lat = chaos_m.hist_summary("serve.latency_s")
+    total = served[0] + shed[0]
+    shed_rate = shed[0] / total if total else 0.0
+    degraded_qps = served[0] / chaos_dt if chaos_dt else 0.0
+
+    # -- arm 3: ladder heal time -----------------------------------------
+    resilience.reset()
+    header, _ = read_bam_header(bam)
+    # threshold 1: a small fixture may plan a single span — one
+    # oracle-confirmed demotion must open the breaker so the heal
+    # measurement starts (the heal time, not the threshold, is the row)
+    heal_cfg = _dc.replace(
+        DEFAULT_CONFIG, inflate_backend="native",
+        retry_backoff_base_s=0.001, retry_backoff_max_s=0.01,
+        breaker_cooldown_s=0.2, breaker_failure_threshold=1.0)
+    clean_stats = flagstat_file(bam, header=header, config=heal_cfg)
+    key = f"decode/native/{os.path.abspath(bam)}"
+    with fault_points_on("decode.native",
+                         [PointFault("corrupt", count=10_000)]):
+        faulted_stats = flagstat_file(bam, header=header, config=heal_cfg)
+    if faulted_stats != clean_stats:
+        raise AssertionError("demoted flagstat diverged from clean run")
+    if resilience.registry().states().get(key, {}).get("state") != "open":
+        raise AssertionError("native-plane breaker did not open")
+    t0 = time.perf_counter()
+    heal_s = None
+    while time.perf_counter() - t0 < 30.0:
+        out = flagstat_file(bam, header=header, config=heal_cfg)
+        if out != clean_stats:
+            raise AssertionError("healing flagstat diverged")
+        st = resilience.registry().states().get(key, {})
+        if st.get("state") == "closed":
+            heal_s = time.perf_counter() - t0
+            break
+        time.sleep(0.05)
+    if heal_s is None:
+        raise AssertionError("native plane never healed")
+    resilience.reset()
+
+    clean_p50 = max(clean_lat.get("p50", 0.0), 1e-9)
+    degraded_p50 = max(chaos_lat.get("p50", 0.0), 1e-9)
+    warm_chaos_p50 = max(warm_chaos_lat.get("p50", 0.0), 1e-9)
+    return {"metric": "faulted_serve_queries_per_sec",
+            "value": round(degraded_qps, 1), "unit": "queries/s",
+            # baseline = clean warm p50 / warm-under-chaos p50; the
+            # acceptance bar is < 2x regression, i.e. vs_baseline > 0.5
+            # (tiles absorb the byte-source chaos; sheds are counted,
+            # never hung)
+            "vs_baseline": round(clean_p50 / warm_chaos_p50, 3),
+            "shed_rate": round(shed_rate, 4),
+            "served": served[0], "shed": shed[0],
+            "degraded_p50_ms": round(degraded_p50 * 1e3, 3),
+            "warm_chaos_p50_ms": round(warm_chaos_p50 * 1e3, 3),
+            "clean_p50_ms": round(clean_p50 * 1e3, 3),
+            "ladder_heal_s": round(heal_s, 3),
+            "chaos_seed": chaos_seed,
+            "note": ("seeded byte-source chaos (transient 0.08 / slow "
+                     "0.05 per offset) + 4 clients on a 2-deep tenant "
+                     "quota; every failure classified TRANSIENT/CORRUPT "
+                     "— no hangs; heal = demote-to-zlib then half-open "
+                     "re-probe wall time at 0.2s cooldown")}
+
+
 def bench_obs_overhead(path: str):
     """What the always-on instrumentation itself costs (tracing
     DISABLED, the default state): flagstat through an isolated normal
@@ -2030,6 +2208,8 @@ def main() -> None:
                    "region_query_queries_per_sec", est_s=45)
     _run_component(lambda: bench_region_serve(path),
                    "region_serve_queries_per_sec", est_s=50)
+    _run_component(lambda: bench_faulted_serve(path),
+                   "faulted_serve_queries_per_sec", est_s=50)
     _run_component(lambda: bench_obs_overhead(path),
                    "obs_overhead_pct", est_s=25)
     _run_component(lambda: bench_fastq(build_fastq_fixture()),

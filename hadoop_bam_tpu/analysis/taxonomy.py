@@ -27,7 +27,11 @@ from hadoop_bam_tpu.analysis.astutil import last_segment
 from hadoop_bam_tpu.analysis.core import Finding, Project, register
 
 # the policy boundaries decode_with_retry / RetryingByteSource /
-# broadcast_plan classify across (ISSUE 3 tentpole scope)
+# broadcast_plan classify across (ISSUE 3 tentpole scope), extended in
+# ISSUE 11 to the write-path and serve-tier boundary modules: a bare
+# builtin raised there reaches clients as the WRONG wire taxonomy kind
+# (transport.error_kind) or poisons the parallel writer with a class
+# the retry policy misreads
 SCOPE = (
     "hadoop_bam_tpu/formats/bgzf.py",
     "hadoop_bam_tpu/formats/bamio.py",
@@ -37,6 +41,15 @@ SCOPE = (
     "hadoop_bam_tpu/split/vcf_planners.py",
     "hadoop_bam_tpu/split/read_planners.py",
     "hadoop_bam_tpu/split/cram_planner.py",
+    "hadoop_bam_tpu/write/parallel_bgzf.py",
+    "hadoop_bam_tpu/write/sharded.py",
+    "hadoop_bam_tpu/write/api.py",
+    "hadoop_bam_tpu/write/indexing.py",
+    "hadoop_bam_tpu/serve/transport.py",
+    "hadoop_bam_tpu/serve/loop.py",
+    "hadoop_bam_tpu/serve/tenancy.py",
+    "hadoop_bam_tpu/serve/prefetch.py",
+    "hadoop_bam_tpu/serve/tiles.py",
 )
 
 _BARE = {

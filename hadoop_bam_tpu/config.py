@@ -114,6 +114,16 @@ _HADOOP_KEY_MAP = {
     "hbam.serve-tenant-queue-depth": "serve_tenant_queue_depth",
     "hbam.serve-max-tenants": "serve_max_tenants",
     "hbam.serve-ring-slots": "serve_ring_slots",
+    # resilience knobs (resilience/; no reference analog — Hadoop's only
+    # adaptive behavior was task re-execution)
+    "hbam.adaptive-planes": "adaptive_planes",
+    "hbam.breaker-failure-threshold": "breaker_failure_threshold",
+    "hbam.breaker-window-s": "breaker_window_s",
+    "hbam.breaker-cooldown-s": "breaker_cooldown_s",
+    "hbam.breaker-half-open-probes": "breaker_half_open_probes",
+    "hbam.serve-shed-retry-after-s": "serve_shed_retry_after_s",
+    "hbam.serve-prefetch-pause-pressure": "serve_prefetch_pause_pressure",
+    "hbam.chaos-seed": "chaos_seed",
 }
 
 
@@ -180,6 +190,37 @@ class HBamConfig:
     #                                  RetryingByteSource with this budget
     io_read_deadline_s: Optional[float] = None  # per-pread deadline
     check_crc: bool = False          # verify BGZF CRC32 footers on inflate
+
+    # --- resilience (resilience/: adaptive degrade-and-heal; rides on
+    # top of the failure policy above) ---
+    adaptive_planes: bool = True     # decode-backend demotion ladder:
+    #                                  oracle-confirmed plane-local
+    #                                  faults demote device -> native ->
+    #                                  zlib mid-run (byte-identical) and
+    #                                  heal back via half-open probes;
+    #                                  False = static plane selection
+    breaker_failure_threshold: float = 3.0  # decayed failures within
+    #                                  breaker_window_s that OPEN a
+    #                                  fault domain's circuit
+    breaker_window_s: float = 30.0   # failure-rate decay window
+    breaker_cooldown_s: float = 5.0  # OPEN -> HALF_OPEN delay; also the
+    #                                  retry_after hint open circuits
+    #                                  report
+    breaker_half_open_probes: int = 1  # concurrent probes HALF_OPEN
+    #                                  admits before re-deciding
+    serve_shed_retry_after_s: float = 0.1  # retry_after hint on
+    #                                  admission-queue sheds (breaker
+    #                                  sheds report their cooldown
+    #                                  remainder instead)
+    serve_prefetch_pause_pressure: float = 3.0  # registry-wide decayed
+    #                                  failure count above which serve
+    #                                  prefetch auto-pauses (speculative
+    #                                  decode is the wrong spend under
+    #                                  fault pressure)
+    chaos_seed: Optional[int] = None  # seed for deterministic chaos
+    #                                  schedules (tests/bench/soak);
+    #                                  None = chaos only via explicit
+    #                                  install_chaos / fault_points_on
 
     # --- debug ---
     debug_keep_spill: bool = False   # keep mesh-sort .mesh-spill run dirs
@@ -301,12 +342,15 @@ def _coerce(kwargs: dict) -> dict:
               "qseq_filter_failed_qc", "write_header", "write_terminator",
               "use_splitting_index", "use_native", "use_fused_decode",
               "keep_paired_reads_together", "skip_bad_spans",
-              "debug_keep_spill", "serve_prefetch"):
+              "debug_keep_spill", "serve_prefetch", "adaptive_planes"):
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
     for k in ("max_bad_span_fraction", "retry_backoff_base_s",
               "retry_backoff_max_s", "io_read_deadline_s",
-              "query_deadline_s"):
+              "query_deadline_s", "breaker_failure_threshold",
+              "breaker_window_s", "breaker_cooldown_s",
+              "serve_shed_retry_after_s",
+              "serve_prefetch_pause_pressure"):
         if k in out and isinstance(out[k], str):
             out[k] = float(out[k])
     for k in ("span_retries", "io_read_retries", "feed_ring_slots",
@@ -319,7 +363,8 @@ def _coerce(kwargs: dict) -> dict:
               "serve_tile_cache_bytes", "serve_tile_records",
               "serve_prefetch_depth", "serve_recent_regions",
               "serve_tenant_max_in_flight", "serve_tenant_queue_depth",
-              "serve_max_tenants", "serve_ring_slots"):
+              "serve_max_tenants", "serve_ring_slots",
+              "breaker_half_open_probes", "chaos_seed"):
         if k in out and isinstance(out[k], str):
             out[k] = int(out[k])
     return out
@@ -347,7 +392,14 @@ _PLANE_CACHE: dict = {}
 
 def resolve_inflate_backend(config: "HBamConfig | None") -> str:
     """Resolve a config's ``inflate_backend`` to a concrete plane name
-    ("native" | "zlib" | "device").  "auto" probes once per process."""
+    ("native" | "zlib" | "device").  "auto" probes once per process.
+
+    This is only the STARTING rung: with ``config.adaptive_planes`` the
+    drivers run the resolved plane through a ``resilience.DemotionLadder``
+    — oracle-confirmed plane-local faults demote it mid-run and a
+    half-open probe revisits the faster plane after the breaker
+    cooldown, so the once-per-process probe is no longer the last word
+    on plane selection."""
     backend = getattr(config, "inflate_backend", "auto") \
         if config is not None else "auto"
     if backend not in INFLATE_BACKENDS:

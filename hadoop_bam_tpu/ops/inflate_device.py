@@ -60,6 +60,7 @@ import numpy as np
 from hadoop_bam_tpu.formats import bgzf
 from hadoop_bam_tpu.ops.rans import _round_pow2
 from hadoop_bam_tpu.ops.unpack_bam import PREFIX, unpack_fixed_fields_tile
+from hadoop_bam_tpu.resilience import chaos
 from hadoop_bam_tpu.utils import native
 
 # BGZF caps a block's inflated size at 64 KiB [SPEC SAMv1 4.1]
@@ -297,6 +298,11 @@ def inflate_span_device(raw: bytes, table: Optional[dict] = None,
     expect = footer_crcs(src, table) if check_crc else None
 
     for lo in range(0, n, chunk):
+        # chaos point at the library-level device step: injected faults
+        # here hit the same plane boundary the pipeline's dispatch-level
+        # device.step point covers, for callers that use this entry
+        # directly (chunk index rides along for schedule targeting)
+        chaos.fire("device.step", chunk_lo=lo)
         hi = min(lo + chunk, n)
         sub_isize = isize[lo:hi]
         # canonical (B, T, P): P snaps to the ladder (not the chunk's own

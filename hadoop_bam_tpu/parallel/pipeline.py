@@ -46,6 +46,11 @@ from hadoop_bam_tpu.ops.unpack_bam import (
     projection_row_bytes, unpack_fixed_fields, unpack_fixed_fields_tile,
     unpack_projected_tile,
 )
+from hadoop_bam_tpu.resilience import chaos
+from hadoop_bam_tpu.resilience.domains import (
+    DemotionLadder, check_quarantine_gate, decode_ladder,
+    quarantine_run_ok,
+)
 from hadoop_bam_tpu.split.planners import plan_bam_spans
 from hadoop_bam_tpu.split.spans import FileVirtualSpan
 from hadoop_bam_tpu.utils import errors as hberrors
@@ -899,10 +904,8 @@ def parse_config_intervals(config: HBamConfig, header):
 
 
 def _span_retry_policy(config: HBamConfig) -> RetryPolicy:
-    return RetryPolicy(
-        retries=max(0, int(getattr(config, "span_retries", 0))),
-        backoff_base_s=float(getattr(config, "retry_backoff_base_s", 0.05)),
-        backoff_max_s=float(getattr(config, "retry_backoff_max_s", 2.0)))
+    from hadoop_bam_tpu.utils.resilient import span_retry_policy
+    return span_retry_policy(config)
 
 
 def _resilient_source(path, config: HBamConfig):
@@ -922,7 +925,8 @@ def _resilient_source(path, config: HBamConfig):
 def decode_with_retry(fn: Callable, span: FileVirtualSpan,
                       config: HBamConfig,
                       quarantine: Optional[QuarantineManifest] = None,
-                      policy: Optional[RetryPolicy] = None):
+                      policy: Optional[RetryPolicy] = None,
+                      ladder: Optional[DemotionLadder] = None):
     """Span-level failure policy (SURVEY.md section 5), fault-classified.
 
     A span is a self-describing, idempotent unit of work — the retry
@@ -947,31 +951,64 @@ def decode_with_retry(fn: Callable, span: FileVirtualSpan,
     ``pipeline.corrupt_spans`` counts corrupt failures.  The manifest's
     circuit breaker (``config.max_bad_span_fraction``) raises
     CircuitBreakerError when the run has quarantined too much of its plan
-    to stay meaningful."""
+    to stay meaningful.
+
+    With a ``ladder`` (resilience/domains.py) the CORRUPT branch grows a
+    demotion step and ``fn`` takes ``(span, plane)``: a span failing
+    corrupt on plane P re-decodes at the next plane down — byte-identical
+    but more battle-tested — instead of failing outright.  Blame is
+    oracle-confirmed: only when the LOWER plane succeeds on the same span
+    is the failure charged to P's fault domain (repeated charges open
+    P's breaker, demoting the whole run until a half-open probe heals
+    it); when every plane fails, the bytes — not the plane — are bad,
+    no domain is charged, and the classic raise/quarantine applies."""
     if policy is None:
         policy = _span_retry_policy(config)
     last: Optional[BaseException] = None
     kind = hberrors.CORRUPT
     attempts = 0
-    for attempt in range(policy.retries + 1):
-        attempts = attempt + 1
+    transient_tries = 0
+    plane = ladder.host_plane() if ladder is not None else None
+    blamed: List[Tuple[str, BaseException]] = []
+    while attempts <= policy.retries + len(blamed):
+        attempts += 1
         try:
-            return fn(span)
+            out = fn(span) if ladder is None else fn(span, plane)
+            if ladder is not None:
+                for bad_plane, exc in blamed:
+                    # a lower plane just decoded these bytes: the upper
+                    # plane's failure was plane-local — charge it
+                    ladder.confirm_failure(bad_plane, exc)
+                    METRICS.count("pipeline.span_demotions")
+                ladder.record_success(plane)
+            return out
         except Exception as e:  # noqa: BLE001 — policy boundary
             last = e
             kind = classify_error(e)
             if kind == hberrors.PLAN:
                 raise
             if kind != hberrors.TRANSIENT:
+                if ladder is not None:
+                    nxt = ladder.next_lower(plane)
+                    if nxt is not None and ladder.demotable(plane, e):
+                        logger.warning(
+                            "span %s failed on the %s plane (%s); "
+                            "re-decoding on %s", span, plane, e, nxt)
+                        blamed.append((plane, e))
+                        plane = nxt
+                        continue
                 METRICS.count("pipeline.corrupt_spans")
                 break
-            if attempt < policy.retries:
+            if transient_tries < policy.retries:
                 METRICS.count("pipeline.transient_retries")
-                d = policy.delay(attempt)
+                d = policy.delay(transient_tries)
+                transient_tries += 1
                 logger.debug("transient fault on span %s (attempt %d/%d), "
                              "retrying in %.3fs: %s", span, attempts,
                              policy.retries + 1, d, e)
                 policy.sleep(d)
+                continue
+            break
     if getattr(config, "skip_bad_spans", False):
         METRICS.count("pipeline.bad_spans")
         logger.warning("skipping bad span %s after %d attempt(s) [%s]: %s",
@@ -1000,20 +1037,33 @@ def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
     instead of leaving that to GC)."""
     from collections import deque
 
+    from hadoop_bam_tpu.utils.resilient import call_with_retry
+
     it = iter(items)
     dq: "deque[cf.Future]" = deque()
-    try:
+    # transient SUBMISSION failures (a saturated executor, an injected
+    # pool.submit chaos fault) retry briefly instead of killing the
+    # whole driver run — the task itself has its own failure policy
+    submit_policy = RetryPolicy(retries=3, backoff_base_s=0.01,
+                                backoff_max_s=0.1)
+
+    def _submit(item) -> cf.Future:
         # pools.submit, not pool.submit: the task carries the caller's
         # MetricsContext onto the worker thread and records its queue
         # wait + run into the pool.task_* histograms
+        return call_with_retry(lambda: pool_submit(pool, fn, item),
+                               submit_policy, what="decode pool submit",
+                               counter="pool.submit_retries")
+
+    try:
         for item in it:
-            dq.append(pool_submit(pool, fn, item))
+            dq.append(_submit(item))
             if len(dq) >= window:
                 break
         while dq:
             fut = dq.popleft()
             for item in it:
-                dq.append(pool_submit(pool, fn, item))
+                dq.append(_submit(item))
                 break
             yield fut.result()
     finally:
@@ -1166,6 +1216,9 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     widths = (PREFIX, geometry.seq_stride, geometry.qual_stride)
     check_crc = bool(getattr(config, "check_crc", False))
     intervals = parse_config_intervals(config, header)
+    # same fast-fail quarantine gate as flagstat_file: a file whose last
+    # run tripped the bad-span circuit sheds here while it is OPEN
+    check_quarantine_gate(path, config)
     src = _resilient_source(path, config)
     spans = list(spans)
     if quarantine is not None and quarantine.total_spans is None:
@@ -1178,6 +1231,11 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     # the host planes here, "zlib"/"native" are honored as asked
     backend = resolve_inflate_backend(config)
     host_backend = "auto" if backend == "device" else backend
+    # same demotion ladder as flagstat's host path: corrupt failures on
+    # the native rung re-decode on zlib (byte-identical) and oracle-
+    # confirmed blame opens the native domain's breaker
+    ladder = decode_ladder(path, backend, config) \
+        if getattr(config, "adaptive_planes", True) else None
 
     # same chunk-streaming shape as flagstat_file: fused spans hand their
     # prefix/seq/qual chunks to the packer as the native walk lands them
@@ -1187,8 +1245,11 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
         window = _stream_window(window)
 
     def decode(span):
-        def inner(s):
-            if stream_fused:
+        def inner(s, plane=None):
+            hb = host_backend if plane is None else plane
+            if hb in ("auto", "native"):
+                chaos.fire("decode.native", span=str(s))
+            if stream_fused and hb in ("auto", "native"):
                 return _iter_fused_span_chunks(
                     src, s, "payload", geometry=geometry,
                     check_crc=check_crc, config=config,
@@ -1198,14 +1259,15 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
                             config=_fused_off(config))[:3],
                         s, config))
             prefix, seq, qual, _v = decode_span_payload_host(
-                src, s, geometry, check_crc, host_backend,
-                intervals=intervals, header=header, config=config)
+                src, s, geometry, check_crc, hb,
+                intervals=intervals, header=header,
+                config=config if hb != "zlib" else _fused_off(config))
             return prefix, seq, qual
         with METRICS.timer("pipeline.host_decode"), \
                 METRICS.wall_timer("pipeline.host_decode_wall"), \
                 METRICS.span("bam.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
-                                    quarantine=quarantine)
+                                    quarantine=quarantine, ladder=ladder)
         return out if out is not None else (
             np.empty((0, PREFIX), np.uint8),
             np.empty((0, geometry.seq_stride), np.uint8),
@@ -1226,6 +1288,9 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     else:
         for arrays, counts in fp.groups(stream):
             yield [a.copy() for a in arrays], counts.copy()
+    # reached only when the whole span plan decoded without tripping the
+    # bad-span circuit: heals a half-open quarantine gate
+    quarantine_run_ok(path, config)
 
 
 class _StatTotals:
@@ -1931,6 +1996,11 @@ def _flagstat_device_plane(path: str, mesh: Mesh, config: HBamConfig,
                 isz[dev, :B] = 0
                 meta[dev, 0] = 0
         views = (tok[:, :B, :Pg], nt[:, :B], isz[:, :B], meta[:, :1])
+        # chaos point at the shard_map step boundary: an injected fault
+        # here models a device/runtime step failure — it unwinds the
+        # whole device-plane run, which is exactly what the flagstat
+        # ladder wrapper demotes on
+        chaos.fire("device.step", blocks=int(sum(c.used for c in group)))
         with METRICS.timer("pipeline.device_inflate"), \
                 METRICS.span("bam.device_resolve_wall",
                              blocks=int(sum(c.used for c in group))):
@@ -2072,16 +2142,52 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     if header is None:
         header, _ = read_bam_header(path)
 
+    # the upgraded quarantine circuit: a file whose last run tripped the
+    # bad-span-fraction breaker fast-fails here while OPEN (retry_after
+    # hint attached) instead of re-planning a doomed run; HALF_OPEN lets
+    # this run through as the probe and a clean finish heals it
+    check_quarantine_gate(path, config)
     backend = resolve_inflate_backend(config)
     intervals = parse_config_intervals(config, header)
-    if (backend == "device" and intervals is None
-            and not getattr(config, "skip_bad_spans", False)):
+    # the demotion ladder: plane-local faults demote device -> native ->
+    # zlib mid-run with byte-identical results and heal back through
+    # half-open probes (resilience/domains.py)
+    ladder = decode_ladder(path, backend, config) \
+        if getattr(config, "adaptive_planes", True) else None
+    device_blame: Optional[BaseException] = None
+    device_gated = (backend == "device" and intervals is None
+                    and not getattr(config, "skip_bad_spans", False))
+    # the breaker gate consumes a half-open probe slot, so consult it
+    # only when the device path would actually run (and report back)
+    if device_gated and ladder is not None \
+            and not ladder.allow_plane("device"):
+        device_gated = False         # OPEN device circuit: host planes
+    if device_gated:
         # the token-feed device decode plane (resolve+walk+unpack on the
         # mesh).  Interval filtering needs whole-span offsets and
         # skip_bad_spans needs span-granular quarantine — both fall back
         # to the host planes, same gating as fused chunk streaming.
-        return _flagstat_device_plane(path, mesh, config, header, spans,
-                                      quarantine, prefetch=prefetch)
+        try:
+            out = _flagstat_device_plane(path, mesh, config, header,
+                                         spans, quarantine,
+                                         prefetch=prefetch)
+            if ladder is not None:
+                ladder.record_success("device")
+            quarantine_run_ok(path, config)
+            return out
+        except Exception as e:  # noqa: BLE001 — plane policy boundary
+            if ladder is None or not ladder.demotable("device", e):
+                raise
+            # mid-run demotion: the device totals died with the
+            # exception, so the host planes recompute from scratch —
+            # byte-identical results, slower plane.  Blame lands on the
+            # device domain only if the host run COMPLETES (oracle
+            # confirmation, below); its breaker opening keeps later
+            # runs on the host planes until a half-open probe heals.
+            logger.warning("device decode plane failed (%s: %s); "
+                           "demoting to the host planes for %s",
+                           type(e).__name__, e, path)
+            device_blame = e
     host_backend = "auto" if backend == "device" else backend
 
     if spans is None:
@@ -2128,8 +2234,17 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     ranges = projection_ranges(projection)
 
     def decode(span):
-        def inner(s):
-            if stream_fused:
+        def inner(s, plane=None):
+            # ladder-aware: decode_with_retry drives ``plane`` down the
+            # demotion ladder on corrupt failures (None = static config
+            # plane, the ladder-off path)
+            hb = host_backend if plane is None else plane
+            if hb in ("auto", "native"):
+                # chaos point for plane-local native faults — fires
+                # INSIDE the retry/ladder boundary, so injected faults
+                # retry/demote exactly like real ones
+                chaos.fire("decode.native", span=str(s))
+            if stream_fused and hb in ("auto", "native"):
                 # the tail-cut fallback runs LATER, on the consumer
                 # thread: it re-reads the span, so it gets its own pass
                 # through the retry policy (transients there must heal
@@ -2144,15 +2259,15 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                             config=_fused_off(config))[0],),
                         s, config))
             rows, _voffs = decode_span_prefix_host(
-                src, s, check_crc, host_backend, projection,
+                src, s, check_crc, hb, projection,
                 want_voffs=False, intervals=intervals, header=header,
-                config=config)
+                config=config if hb != "zlib" else _fused_off(config))
             return rows
         with METRICS.timer("pipeline.host_decode"), \
                 METRICS.wall_timer("pipeline.host_decode_wall"), \
                 METRICS.span("bam.host_decode_wall"):
             out = decode_with_retry(inner, span, config,
-                                    quarantine=quarantine)
+                                    quarantine=quarantine, ladder=ladder)
         return out if out is not None \
             else np.empty((0, row_bytes), dtype=np.uint8)
 
@@ -2193,6 +2308,12 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
         with METRICS.timer("pipeline.device_drain"), \
                 METRICS.span("bam.combine_wall"):
             host = np.asarray(jax.device_get(totals_vec), dtype=np.int64)
+    if ladder is not None and device_blame is not None:
+        # the host planes completed the run the device plane could not:
+        # oracle-confirmed plane-local fault — charge the device domain
+        # (enough of these open its breaker; a half-open probe heals it)
+        ladder.confirm_failure("device", device_blame)
+    quarantine_run_ok(path, config)
     return _attach_quarantine(
         {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)}, quarantine)
 

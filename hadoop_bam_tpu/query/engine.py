@@ -272,19 +272,20 @@ class QueryEngine:
         hit = self.cache.get(key)
         if hit is not None:
             return hit
-        import os
-
         from hadoop_bam_tpu.formats.cram import (
             ContainerHeader, FileDefinition,
         )
+        from hadoop_bam_tpu.utils.seekable import scoped_byte_source
         table: List[Tuple[int, int, int, int, int]] = []
-        with open(path, "rb") as f:
-            FileDefinition.from_bytes(f.read(FileDefinition.SIZE))
-            fsize = os.fstat(f.fileno()).st_size
+        # through as_byte_source, not a bare open(): the TOC walk reads
+        # like any other engine read, so io_read_retries wraps it and
+        # the install_chaos registry observes it (audited seam)
+        with scoped_byte_source(path) as src:
+            FileDefinition.from_bytes(src.pread(0, FileDefinition.SIZE))
+            fsize = src.size
             pos = FileDefinition.SIZE
             while pos < fsize:
-                f.seek(pos)
-                chunk = f.read(1 << 16)
+                chunk = src.pread(pos, 1 << 16)
                 hdr, after = ContainerHeader.from_buffer(chunk, 0)
                 if hdr.is_eof:
                     break

@@ -99,7 +99,8 @@ class QueryScheduler:
 
     def __init__(self, max_in_flight: int = 8, queue_depth: int = 32,
                  default_deadline_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 shed_retry_after_s: float = 0.1):
         if max_in_flight < 1:
             raise PlanError(
                 f"query_max_in_flight must be >= 1, got {max_in_flight}")
@@ -112,6 +113,7 @@ class QueryScheduler:
         self.max_in_flight = int(max_in_flight)
         self.queue_depth = int(queue_depth)
         self.default_deadline_s = default_deadline_s
+        self.shed_retry_after_s = float(shed_retry_after_s)
         self._clock = clock
         self._cond = threading.Condition()
         self._in_flight = 0
@@ -134,11 +136,15 @@ class QueryScheduler:
             if self._in_flight >= self.max_in_flight \
                     and self._waiting >= self.queue_depth:
                 METRICS.count("query.rejected")
+                # the retry_after hint rides the shed so transports can
+                # put a concrete backoff on the wire (never a hang, and
+                # never a client guessing)
                 raise TransientIOError(
                     f"query admission rejected: {self._in_flight} in "
                     f"flight (limit {self.max_in_flight}) and "
                     f"{self._waiting} queued (limit {self.queue_depth}) "
-                    f"— retry with backoff")
+                    f"— retry with backoff",
+                    retry_after_s=self.shed_retry_after_s)
             self._waiting += 1
             try:
                 while self._in_flight >= self.max_in_flight:

@@ -129,7 +129,8 @@ class ServeLoop:
             self._finish_admission(job)
             job.future.set_exception(
                 TransientIOError("serve loop stopped before this "
-                                 "request was dispatched — retry"))
+                                 "request was dispatched — retry",
+                                 retry_after_s=1.0))
         if self._builder is not None:
             self._builder.close()
 
@@ -159,7 +160,8 @@ class ServeLoop:
                 # a stopped loop sheds instead of silently resurrecting:
                 # restart is an explicit start() by whoever owns the loop
                 raise TransientIOError("serve loop is stopped — retry "
-                                       "after it restarts")
+                                       "after it restarts",
+                                       retry_after_s=1.0)
         if self._thread is None:
             self.start()
         # entered HERE (client thread: admission wait + shed happen to
@@ -175,7 +177,8 @@ class ServeLoop:
         with self._cond:
             if self._stopping:
                 self._finish_admission(job)
-                raise TransientIOError("serve loop is stopping — retry")
+                raise TransientIOError("serve loop is stopping — retry",
+                                       retry_after_s=1.0)
             heapq.heappush(self._heap, job)
             self._cond.notify()
         return job.future
@@ -190,6 +193,29 @@ class ServeLoop:
                 "chunks": self.engine.cache.stats(),
                 "prefetch": self.prefetcher.stats(),
                 "tenants": self.tenants.stats()}
+
+    def health(self) -> Dict[str, object]:
+        """The degraded-mode diagnosis surface (``{"op": "health"}`` on
+        the wire, and the CLI's shutdown report): loop liveness plus
+        every adaptive-policy state — tenant breakers, the resilience
+        registry's fault domains (decode-ladder + quarantine circuits),
+        registry fault pressure, and whether prefetch auto-paused."""
+        from hadoop_bam_tpu import resilience
+
+        reg = resilience.registry()
+        with self._cond:
+            stopping = self._stopping
+            queued = len(self._heap)
+        return {
+            "status": "stopping" if stopping else "serving",
+            "queued": queued,
+            "fault_pressure": round(reg.fault_pressure(), 4),
+            "open_breakers": reg.open_breakers(),
+            "domains": reg.states(),
+            "tenant_breakers": self.tenants.breaker_states(),
+            "prefetch": self.prefetcher.stats(),
+            "tiles": self.tiles.stats(),
+        }
 
     # -- dispatcher ----------------------------------------------------------
 
@@ -225,8 +251,18 @@ class ServeLoop:
                               regions=len(job.regions)):
                 results = [self._serve_region(job, region)
                            for region in job.regions]
+            # outcome is recorded BEFORE the future resolves: a client
+            # that saw its request fail and immediately retries must
+            # find the breaker already fed (recording after set_result
+            # races the next submit)
+            self.tenants.record_outcome(job.tenant, None)
             job.future.set_result(results)
         except BaseException as e:  # noqa: BLE001 — crosses to the client
+            # feed the tenant's half-open breaker: repeated serving
+            # failures open it and the tenant sheds at admission until
+            # a cooled-down probe succeeds (PLAN-class rejections are
+            # the client's problem and never count)
+            self.tenants.record_outcome(job.tenant, e)
             job.future.set_exception(e)
         finally:
             METRICS.observe("serve.latency_s",

@@ -51,6 +51,9 @@ class Prefetcher:
         self.depth = max(0, int(getattr(config, "serve_prefetch_depth", 2)))
         self.recent_window = max(1, int(
             getattr(config, "serve_recent_regions", 16)))
+        self.pause_pressure = float(getattr(
+            config, "serve_prefetch_pause_pressure", 3.0))
+        self.paused_total = 0
         self._config = config
         self._lock = threading.Lock()
         # per-file recency rings: ident -> deque of (rid, beg, end)
@@ -80,9 +83,28 @@ class Prefetcher:
         METRICS.count("serve.prefetch_useful")
         return True
 
+    def fault_paused(self) -> bool:
+        """Auto-pause under fault pressure: when the resilience
+        registry's decayed failure count crosses the config threshold,
+        speculative decode is exactly the wrong way to spend pool
+        capacity (every prefetched chunk competes with the retries and
+        demoted-plane re-decodes that are healing the system) — so
+        prediction pauses and resumes by itself as the pressure decays."""
+        from hadoop_bam_tpu import resilience
+
+        if self.pause_pressure <= 0:
+            return False
+        if resilience.registry().fault_pressure() < self.pause_pressure:
+            return False
+        self.paused_total += 1
+        METRICS.count("serve.prefetch_paused")
+        return True
+
     def note(self, meta, iv) -> None:
         """Record a served interval and issue adjacent-window prefetch."""
         if not self.enabled or self.depth == 0:
+            return
+        if self.fault_paused():
             return
         rid = meta.ref_names.index(iv.rname)
         width = max(1, iv.end - iv.start + 1)
@@ -127,8 +149,17 @@ class Prefetcher:
                 self._prefetched[key] = False   # completion flips it
                 self.issued += 1
             METRICS.count("serve.prefetch_issued")
-            fut = pools.submit(pool, self._decode_quietly, meta, s, e,
-                               priority="bg")
+            try:
+                fut = pools.submit(pool, self._decode_quietly, meta, s, e,
+                                   priority="bg")
+            except Exception:  # noqa: BLE001 — speculative work only
+                # a failed SUBMISSION (pool shutting down, injected
+                # pool.submit chaos) must never surface through the
+                # foreground serve path — the prediction just stays cold
+                METRICS.count("serve.prefetch_errors")
+                with self._lock:
+                    self._prefetched.pop(key, None)
+                continue
             with self._lock:
                 self._outstanding.append(fut)
                 self._outstanding = [f for f in self._outstanding
@@ -170,4 +201,5 @@ class Prefetcher:
         with self._lock:
             issued, useful = self.issued, self.useful
         return {"issued": issued, "useful": useful,
-                "hit_rate": (useful / issued) if issued else 0.0}
+                "hit_rate": (useful / issued) if issued else 0.0,
+                "paused_total": self.paused_total}

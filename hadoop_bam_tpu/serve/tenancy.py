@@ -17,7 +17,14 @@ neighbours.  This module layers multi-tenancy onto the PR-5
   deadline (the isolation contract, pinned in tests/test_serve.py);
 - idle tenant gates are LRU-evicted past ``serve_max_tenants`` — a
   long-running server accepting arbitrary tenant strings must not grow
-  a scheduler per string forever (the SV801 bound).
+  a scheduler per string forever (the SV801 bound);
+- each tenant also carries a half-open ``CircuitBreaker``
+  (``resilience/breaker.py``): repeated serving failures for one tenant
+  (its files corrupt, its requests chronically deadline-missing) OPEN
+  its breaker and the tenant sheds instantly with a ``retry_after_s``
+  hint — no decode work spent — while every other tenant serves
+  normally; after the cooldown one half-open probe request re-tests,
+  and a success heals the tenant.  ``ServeLoop`` records the outcomes.
 """
 from __future__ import annotations
 
@@ -29,7 +36,11 @@ from typing import Callable, Dict, Optional
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 from hadoop_bam_tpu.query.scheduler import QueryScheduler
-from hadoop_bam_tpu.utils.errors import PlanError
+from hadoop_bam_tpu.resilience.breaker import CircuitBreaker
+from hadoop_bam_tpu.utils.errors import (
+    PlanError, TransientIOError, classify_error, PLAN,
+)
+from hadoop_bam_tpu.utils.metrics import METRICS
 
 # lower sorts first in the dispatch heap
 PRIORITIES: Dict[str, int] = {"interactive": 0, "batch": 1}
@@ -57,8 +68,13 @@ class TenantQuotas:
         self.default_deadline_s: Optional[float] = getattr(
             config, "query_deadline_s", None)
         self._clock = clock
+        self._config = config
         self._lock = threading.Lock()
         self._tenants: "OrderedDict[str, QueryScheduler]" = OrderedDict()
+        # tenant -> half-open breaker; same LRU life as the scheduler
+        # gates (evicting an idle tenant forgets its failure history —
+        # acceptable: a returning tenant starts CLOSED)
+        self._breakers: "OrderedDict[str, CircuitBreaker]" = OrderedDict()
 
     def scheduler(self, tenant: str) -> QueryScheduler:
         """This tenant's admission gate (created on first use; idle gates
@@ -78,12 +94,54 @@ class TenantQuotas:
                 for name in list(self._tenants):
                     if self._tenants[name].in_flight == 0:
                         self._tenants.pop(name)
+                        self._breakers.pop(name, None)
                         break
-            sched = QueryScheduler(self.max_in_flight, self.queue_depth,
-                                   self.default_deadline_s,
-                                   clock=self._clock)
+            sched = QueryScheduler(
+                self.max_in_flight, self.queue_depth,
+                self.default_deadline_s, clock=self._clock,
+                shed_retry_after_s=float(getattr(
+                    self._config, "serve_shed_retry_after_s", 0.1)))
             self._tenants[tenant] = sched
             return sched
+
+    def breaker(self, tenant: str) -> CircuitBreaker:
+        """This tenant's half-open failure breaker (created CLOSED on
+        first use, bounded by the same tenant LRU)."""
+        with self._lock:
+            br = self._breakers.get(tenant)
+            if br is None:
+                cfg = self._config
+                br = CircuitBreaker(
+                    failure_threshold=float(getattr(
+                        cfg, "breaker_failure_threshold", 3.0)),
+                    window_s=float(getattr(cfg, "breaker_window_s", 30.0)),
+                    cooldown_s=float(getattr(
+                        cfg, "breaker_cooldown_s", 5.0)),
+                    half_open_probes=int(getattr(
+                        cfg, "breaker_half_open_probes", 1)),
+                    clock=self._clock, name=f"tenant/{tenant}")
+                while len(self._breakers) >= self.max_tenants:
+                    self._breakers.popitem(last=False)
+                self._breakers[tenant] = br
+            else:
+                self._breakers.move_to_end(tenant)
+            return br
+
+    def record_outcome(self, tenant: str,
+                       exc: Optional[BaseException]) -> None:
+        """Feed one finished request's outcome into the tenant breaker.
+        PLAN-class failures (the client's malformed request) and
+        admission sheds don't count — they prove nothing about whether
+        serving this tenant's data works; everything else (corrupt
+        files, deadline misses surfacing as TransientIOError from the
+        serve path, unknown errors) does."""
+        br = self.breaker(tenant)
+        if exc is None:
+            br.record_success()
+            return
+        if classify_error(exc) == PLAN:
+            return
+        br.record_failure()
 
     @contextlib.contextmanager
     def admit(self, tenant: str, deadline_s: Optional[float] = None):
@@ -95,7 +153,19 @@ class TenantQuotas:
         scheduler (splitting the tenant's quota across instances), so
         after admitting we re-validate membership — reinstalling the
         gate if it was evicted, or retrying on the replacement a racing
-        creator installed."""
+        creator installed.
+
+        The tenant's breaker gates FIRST: an OPEN tenant sheds here —
+        before any queueing — with the cooldown remainder as the
+        ``retry_after_s`` hint; a HALF_OPEN tenant admits exactly its
+        probe budget (the probes' outcomes decide heal vs re-open)."""
+        br = self.breaker(tenant)
+        if not br.allow():
+            METRICS.count("resilience.tenant_shed")
+            raise TransientIOError(
+                f"tenant {tenant!r} circuit is {br.state} after repeated "
+                f"serving failures — retry in {br.retry_after_s():.3g}s",
+                retry_after_s=br.retry_after_s() or None)
         while True:
             sched = self.scheduler(tenant)
             with sched.admit(deadline_s) as deadline:
@@ -116,5 +186,20 @@ class TenantQuotas:
 
     def stats(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
-            return {name: {"in_flight": sched.in_flight}
-                    for name, sched in self._tenants.items()}
+            names = list(self._tenants)
+            scheds = dict(self._tenants)
+            breakers = dict(self._breakers)
+        out: Dict[str, Dict[str, float]] = {}
+        for name in names:
+            row: Dict[str, float] = {"in_flight": scheds[name].in_flight}
+            br = breakers.get(name)
+            if br is not None:
+                row["breaker"] = br.state
+            out[name] = row
+        return out
+
+    def breaker_states(self) -> Dict[str, dict]:
+        """Health-surface snapshot of every tracked tenant breaker."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {name: br.snapshot() for name, br in breakers.items()}

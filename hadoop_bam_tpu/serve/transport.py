@@ -16,15 +16,23 @@ priority classes is not submission order::
 
 Failures answer on the same line protocol with the PR-1 taxonomy class
 spelled out, so clients can implement retry policy without parsing
-message strings::
+message strings — sheds (admission overflow, open tenant breakers, a
+stopping loop) additionally carry the server's backoff hint::
 
-    {"id": 2, "error": "...", "kind": "transient"}   # back off + retry
+    {"id": 2, "error": "...", "kind": "transient", "retry_after_s": 0.1}
     {"id": 3, "error": "...", "kind": "plan"}        # fix the request
+
+``{"op": "health"}`` answers out of band with the loop's breaker and
+demotion-ladder state (``ServeLoop.health``) — the liveness/diagnosis
+surface a degraded server keeps serving even while it sheds queries.
 
 The TCP flavor is a thread-per-connection ``socketserver`` veneer over
 the same per-line handler; every connection funnels into the ONE
 ``ServeLoop`` dispatcher, so device work stays single-threaded no
-matter how many sockets are open.
+matter how many sockets are open.  A dropped connection (real, or a
+``serve.transport`` chaos fault) ends THAT stream only: in-flight
+responses for it are abandoned at the socket, the dispatcher and every
+other connection keep serving (pinned by tests).
 """
 from __future__ import annotations
 
@@ -34,9 +42,12 @@ import threading
 import time
 from typing import Dict, List
 
+from hadoop_bam_tpu.resilience import chaos
 from hadoop_bam_tpu.utils.errors import (
-    CorruptDataError, HBamError, PlanError, TransientIOError,
+    CircuitBreakerError, CorruptDataError, HBamError, PlanError,
+    TransientIOError,
 )
+from hadoop_bam_tpu.utils.metrics import METRICS
 
 
 def error_kind(exc: BaseException) -> str:
@@ -50,6 +61,17 @@ def error_kind(exc: BaseException) -> str:
     if isinstance(exc, CorruptDataError):
         return "corrupt"
     return "error"
+
+
+def error_doc(req_id, exc: BaseException, kind: "str | None" = None) -> Dict:
+    """The wire shape of one failed request: taxonomy kind + the
+    server's ``retry_after_s`` backoff hint when the shed carries one."""
+    doc = {"id": req_id, "error": str(exc),
+           "kind": kind if kind is not None else error_kind(exc)}
+    ra = getattr(exc, "retry_after_s", None)
+    if ra is not None:
+        doc["retry_after_s"] = round(float(ra), 4)
+    return doc
 
 
 def _result_doc(req_id, tenant: str, results, t_enqueue: float) -> Dict:
@@ -82,72 +104,91 @@ def handle_stream(loop, rfile, wfile) -> int:
     def write(doc: Dict) -> None:
         line = json.dumps(doc)
         with wlock:
-            wfile.write(line + "\n")
             try:
+                wfile.write(line + "\n")
                 wfile.flush()
             except (OSError, ValueError):
                 pass              # client went away mid-response
 
     n = 0
-    for raw in rfile:
-        line = raw.strip()
-        if not line:
-            continue
-        n += 1
-        req_id: object = n
-        t_enqueue = time.perf_counter()
-        try:
-            doc = json.loads(line)
-            if not isinstance(doc, dict):
-                raise ValueError("request must be a JSON object")
-            req_id = doc.get("id", n)
-            regions = doc.get("regions")
-            if regions is None:
-                regions = [doc["region"]] if "region" in doc else None
-            if not regions or "path" not in doc:
-                raise ValueError(
-                    'request needs "path" and "regions" (or "region")')
-            fut = loop.submit(
-                doc["path"], regions,
-                tenant=str(doc.get("tenant", "default")),
-                priority=str(doc.get("priority", "interactive")),
-                deadline_s=doc.get("deadline_s"),
-                want_records=bool(doc.get("records", False)))
-        except (ValueError, KeyError, TypeError) as e:
-            # malformed line / PlanError-class rejection: answer, keep
-            # serving the stream (one bad client line must not kill the
-            # connection)
-            write({"id": req_id, "error": str(e),
-                   "kind": error_kind(e) if isinstance(e, HBamError)
-                   else "plan"})
-            continue
-        except OSError as e:      # admission shed (TransientIOError)
-            write({"id": req_id, "error": str(e), "kind": error_kind(e)})
-            continue
-
-        ev = threading.Event()
-
-        def _done(f: cf.Future, req_id=req_id,
-                  tenant=str(doc.get("tenant", "default")),
-                  t_enqueue=t_enqueue, ev=ev) -> None:
+    try:
+        for raw in rfile:
+            # injectable disconnect (chaos point serve.transport): raises
+            # ConnectionResetError exactly where a real peer reset
+            # surfaces — the handler below ends THIS stream cleanly
+            chaos.fire("serve.transport")
+            line = raw.strip()
+            if not line:
+                continue
+            n += 1
+            req_id: object = n
+            t_enqueue = time.perf_counter()
             try:
-                exc = f.exception()
-                if exc is not None:
-                    write({"id": req_id, "error": str(exc),
-                           "kind": error_kind(exc)})
-                else:
-                    write(_result_doc(req_id, tenant, f.result(),
-                                      t_enqueue))
-            finally:
-                ev.set()
+                doc = json.loads(line)
+                if not isinstance(doc, dict):
+                    raise PlanError("request must be a JSON object")
+                req_id = doc.get("id", n)
+                if doc.get("op") == "health":
+                    # degraded-mode diagnosis surface: answered inline
+                    # on the reader thread (never enters the dispatch
+                    # heap, so it works even when every tenant sheds)
+                    write({"id": req_id, "health": loop.health()})
+                    continue
+                regions = doc.get("regions")
+                if regions is None:
+                    regions = [doc["region"]] if "region" in doc else None
+                if not regions or "path" not in doc:
+                    raise PlanError(
+                        'request needs "path" and "regions" (or "region")')
+                fut = loop.submit(
+                    doc["path"], regions,
+                    tenant=str(doc.get("tenant", "default")),
+                    priority=str(doc.get("priority", "interactive")),
+                    deadline_s=doc.get("deadline_s"),
+                    want_records=bool(doc.get("records", False)))
+            except (ValueError, KeyError, TypeError) as e:
+                # malformed line / PlanError-class rejection: answer,
+                # keep serving the stream (one bad client line must not
+                # kill the connection)
+                write(error_doc(req_id, e,
+                                kind=None if isinstance(e, HBamError)
+                                else "plan"))
+                continue
+            except (TransientIOError, CircuitBreakerError, OSError) as e:
+                # admission / tenant-breaker / quarantine-circuit shed:
+                # a classified answer with the backoff hint, never a
+                # hang and never a dropped connection (a bare
+                # RuntimeError is a bug and must propagate, not serve)
+                write(error_doc(req_id, e))
+                continue
 
-        fut.add_done_callback(_done)
-        written.append(ev)
-        # prune responses already on the wire: a connection held open
-        # for millions of requests must not grow this list without
-        # bound (the SV802 discipline, applied to a local)
-        if len(written) > 64:
-            written[:] = [e for e in written if not e.is_set()]
+            ev = threading.Event()
+
+            def _done(f: cf.Future, req_id=req_id,
+                      tenant=str(doc.get("tenant", "default")),
+                      t_enqueue=t_enqueue, ev=ev) -> None:
+                try:
+                    exc = f.exception()
+                    if exc is not None:
+                        write(error_doc(req_id, exc))
+                    else:
+                        write(_result_doc(req_id, tenant, f.result(),
+                                          t_enqueue))
+                finally:
+                    ev.set()
+
+            fut.add_done_callback(_done)
+            written.append(ev)
+            # prune responses already on the wire: a connection held
+            # open for millions of requests must not grow this list
+            # without bound (the SV802 discipline, applied to a local)
+            if len(written) > 64:
+                written[:] = [e for e in written if not e.is_set()]
+    except OSError:
+        # the connection died mid-read (peer reset / injected
+        # disconnect): stop reading THIS stream; queued work still
+        # completes below and the server keeps serving other streams
+        METRICS.count("serve.transport_disconnects")
     for ev in written:
         ev.wait(timeout=60.0)
     return n

@@ -560,6 +560,7 @@ def cmd_serve(args) -> int:
     per-tenant admission quotas, priority classes, and predictive
     prefetch."""
     import dataclasses
+    import json as _json
 
     from hadoop_bam_tpu.config import DEFAULT_CONFIG
     from hadoop_bam_tpu.serve import ServeLoop, make_tcp_server, serve_stdio
@@ -572,6 +573,8 @@ def cmd_serve(args) -> int:
         overrides["serve_tile_cache_bytes"] = args.tile_cache_bytes
     if args.no_prefetch:
         overrides["serve_prefetch"] = False
+    if getattr(args, "breaker_cooldown", None) is not None:
+        overrides["breaker_cooldown_s"] = args.breaker_cooldown
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     _start_obs(args)
@@ -599,6 +602,12 @@ def cmd_serve(args) -> int:
             print("-- serve stats --", file=sys.stderr)
             for section, stats in sorted(loop.stats().items()):
                 print(f"{section}\t{stats}", file=sys.stderr)
+        # the degrade-and-heal surface, always reported at shutdown:
+        # breaker/ladder state is exactly what an operator needs when a
+        # server that kept serving was quietly demoted or shedding
+        # (clients get the same document live via {"op": "health"})
+        print("-- serve health --", file=sys.stderr)
+        print(_json.dumps(loop.health(), default=str), file=sys.stderr)
     _finish_obs(args)
     if args.port is None:
         print(f"served {n} request(s)", file=sys.stderr)
@@ -829,6 +838,11 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--metrics", action="store_true",
                     help="dump tile/chunk/prefetch/tenant stats to "
                          "stderr at shutdown")
+    sv.add_argument("--breaker-cooldown", type=float, default=None,
+                    help="seconds an OPEN breaker (tenant / decode "
+                         "plane / quarantine) waits before its "
+                         "half-open re-probe (default "
+                         "config.breaker_cooldown_s)")
     _add_obs_flags(sv)
     sv.set_defaults(fn=cmd_serve, uses_device=True)
 

@@ -32,7 +32,16 @@ class HBamError(Exception):
 class TransientIOError(HBamError, OSError):
     """A read/communication failure that may heal on retry: flaky network
     filesystem, object-store throttling, a dropped tunnel link, an injected
-    chaos fault.  The retry policy backs off and re-attempts these."""
+    chaos fault.  The retry policy backs off and re-attempts these.
+
+    ``retry_after_s`` is the optional server-supplied backoff hint a shed
+    (admission reject, open tenant breaker, stopping serve loop) carries —
+    transports forward it on the wire so clients back off for the right
+    duration instead of guessing."""
+
+    def __init__(self, *args, retry_after_s: "float | None" = None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
 
 
 class CorruptDataError(HBamError, ValueError):
@@ -51,8 +60,15 @@ class PlanError(HBamError, ValueError):
 
 class CircuitBreakerError(HBamError, RuntimeError):
     """Raised when the quarantined-span fraction crosses
-    ``config.max_bad_span_fraction``: the run aborts loudly instead of
-    silently degrading into a mostly-skipped answer."""
+    ``config.max_bad_span_fraction`` — or when a ``resilience`` circuit
+    for the subsystem is OPEN: the run aborts (or the request sheds)
+    loudly instead of silently degrading.  No longer one-way: the
+    half-open machinery in ``resilience/breaker.py`` re-probes after a
+    cooldown, and ``retry_after_s`` tells callers when that is."""
+
+    def __init__(self, *args, retry_after_s: "float | None" = None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
 
 
 # builtins that indicate the environment, not the bytes, failed

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Optional, Tuple
 
+from hadoop_bam_tpu.resilience import chaos
+
 _LOCK = threading.Lock()
 _POOL: Optional[cf.ThreadPoolExecutor] = None
 _POOL_SIZE = 0
@@ -95,6 +97,10 @@ def submit(pool: cf.ThreadPoolExecutor, fn, *args,
         from hadoop_bam_tpu.utils.errors import PlanError
         raise PlanError(f"pool priority must be 'fg' or 'bg', "
                         f"got {priority!r}")
+    # chaos point: an injected submission failure surfaces HERE — on the
+    # submitter's thread, classified TRANSIENT — exactly where a real
+    # saturated/failing executor would (no-op unless armed)
+    chaos.fire("pool.submit", priority=priority)
     ctx = contextvars.copy_context()
     t_submit = time.perf_counter()
     if priority == "fg":

@@ -64,6 +64,16 @@ class RetryPolicy:
         return d
 
 
+def span_retry_policy(config) -> "RetryPolicy":
+    """The one way to derive a span-grain RetryPolicy from config —
+    decode spans, deflate workers, and shard-concat reads must agree on
+    the knob names (and their fallbacks) or they silently diverge."""
+    return RetryPolicy(
+        retries=max(0, int(getattr(config, "span_retries", 2))),
+        backoff_base_s=float(getattr(config, "retry_backoff_base_s", 0.05)),
+        backoff_max_s=float(getattr(config, "retry_backoff_max_s", 2.0)))
+
+
 def call_with_retry(fn: Callable[[], object], policy: RetryPolicy,
                     what: str = "operation",
                     counter: str = "resilient.retries"):
@@ -146,18 +156,73 @@ class FaultSpec:
 _FAULT_LOCK = threading.Lock()
 
 
+class SeededFaultSchedule:
+    """A deterministic chaos schedule derived from ONE integer seed
+    (``config.chaos_seed``) — the reproducibility contract for chaos /
+    soak runs: the same seed produces the same fault timeline on every
+    run, every host, regardless of thread interleaving or how many
+    source instances a run opens.
+
+    Decisions are therefore keyed on the READ'S OFFSET, not a read
+    counter: ``roll(kind, offset)`` hashes ``(seed, kind, offset)`` into
+    [0, 1) and fires when it lands under that kind's rate.  ``once``
+    (default) gives each (kind, offset) a single firing budget shared
+    across all sources on the schedule — so a transient fault at offset
+    X heals when the retry re-reads X, exactly the transient-then-
+    success shape, while a re-run with the same seed faults at the same
+    offsets again."""
+
+    def __init__(self, seed: int, transient_rate: float = 0.0,
+                 slow_rate: float = 0.0, bitflip_rate: float = 0.0,
+                 delay_s: float = 0.005, xor_mask: int = 0x01,
+                 once: bool = True):
+        self.seed = int(seed)
+        self.rates = {"transient": float(transient_rate),
+                      "slow": float(slow_rate),
+                      "bitflip": float(bitflip_rate)}
+        self.delay_s = float(delay_s)
+        self.xor_mask = int(xor_mask)
+        self.once = bool(once)
+        self._fired: set = set()
+        self._lock = threading.Lock()
+
+    def roll(self, kind: str, offset: int) -> bool:
+        import zlib
+        h = zlib.crc32(f"{self.seed}:{kind}:{int(offset)}".encode())
+        return (h / 2.0 ** 32) < self.rates.get(kind, 0.0)
+
+    def faults_for(self, offset: int, size: int) -> List[FaultSpec]:
+        """Fault specs firing on a ``pread(offset, size)`` (consumes the
+        once-budget for each)."""
+        hits: List[FaultSpec] = []
+        for kind, rate in self.rates.items():
+            if rate <= 0.0 or not self.roll(kind, offset):
+                continue
+            if self.once:
+                with self._lock:
+                    if (kind, offset) in self._fired:
+                        continue
+                    self._fired.add((kind, offset))
+            hits.append(FaultSpec(kind, count=1, delay_s=self.delay_s,
+                                  xor_mask=self.xor_mask))
+        return hits
+
+
 class FaultInjectingByteSource(ByteSource):
     """Deterministic chaos wrapper over an intact source.
 
     Faults fire by per-source read index or by offset overlap (see
-    ``FaultSpec``); injected transients raise ``TransientIOError`` so the
-    retry layer treats them exactly like real ones.  ``injected`` counts
-    firings by kind for assertions."""
+    ``FaultSpec``), or by a seed-derived offset-keyed schedule
+    (``SeededFaultSchedule``); injected transients raise
+    ``TransientIOError`` so the retry layer treats them exactly like
+    real ones.  ``injected`` counts firings by kind for assertions."""
 
-    def __init__(self, inner, faults: Sequence[FaultSpec],
-                 sleep: Callable[[float], None] = time.sleep):
+    def __init__(self, inner, faults: Sequence[FaultSpec] = (),
+                 sleep: Callable[[float], None] = time.sleep,
+                 schedule: Optional[SeededFaultSchedule] = None):
         self.inner = seekable.as_byte_source(inner)
         self.faults = list(faults)
+        self.schedule = schedule
         self.size = self.inner.size
         self.path = getattr(self.inner, "path", None)
         self.reads = 0
@@ -181,6 +246,11 @@ class FaultInjectingByteSource(ByteSource):
                         match = offset < hi and offset + size > lo
                 if match:
                     f.count -= 1
+                    self.injected[f.kind] += 1
+                    METRICS.count("chaos.injected_faults")
+                    hits.append(f)
+            if self.schedule is not None:
+                for f in self.schedule.faults_for(offset, size):
                     self.injected[f.kind] += 1
                     METRICS.count("chaos.injected_faults")
                     hits.append(f)
@@ -211,13 +281,33 @@ class FaultInjectingByteSource(ByteSource):
 # Registry hook: install_chaos(path, ...) makes every ByteSource that
 # as_byte_source() opens for that path go through a FaultInjectingByteSource
 # — zero plumbing through the drivers, usable from tests and bench.py.
-_CHAOS: Dict[str, Tuple[List[FaultSpec], Callable[[float], None]]] = {}
+_CHAOS: Dict[str, Tuple[List[FaultSpec], Callable[[float], None],
+                        Optional[SeededFaultSchedule]]] = {}
 
 
-def install_chaos(path, faults: Sequence[FaultSpec],
-                  sleep: Callable[[float], None] = time.sleep) -> None:
-    _CHAOS[os.path.abspath(os.fspath(path))] = (list(faults), sleep)
+def install_chaos(path, faults: Sequence[FaultSpec] = (),
+                  sleep: Callable[[float], None] = time.sleep,
+                  schedule: Optional[SeededFaultSchedule] = None) -> None:
+    _CHAOS[os.path.abspath(os.fspath(path))] = (list(faults), sleep,
+                                                schedule)
     seekable._SOURCE_WRAPPER = _wrap_registered
+
+
+def install_chaos_seeded(path, seed: int, *,
+                         transient_rate: float = 0.0,
+                         slow_rate: float = 0.0,
+                         bitflip_rate: float = 0.0,
+                         delay_s: float = 0.005,
+                         sleep: Callable[[float], None] = time.sleep
+                         ) -> SeededFaultSchedule:
+    """The one-knob chaos entry: a ``SeededFaultSchedule`` derived from
+    ``seed`` (``config.chaos_seed``) installed for ``path``.  Returns
+    the schedule so callers can assert on / share it."""
+    schedule = SeededFaultSchedule(
+        seed, transient_rate=transient_rate, slow_rate=slow_rate,
+        bitflip_rate=bitflip_rate, delay_s=delay_s)
+    install_chaos(path, (), sleep=sleep, schedule=schedule)
+    return schedule
 
 
 def clear_chaos(path=None) -> None:
@@ -232,10 +322,11 @@ def clear_chaos(path=None) -> None:
 class chaos_on:
     """``with chaos_on(path, faults):`` — scoped install_chaos."""
 
-    def __init__(self, path, faults: Sequence[FaultSpec],
-                 sleep: Callable[[float], None] = time.sleep):
+    def __init__(self, path, faults: Sequence[FaultSpec] = (),
+                 sleep: Callable[[float], None] = time.sleep,
+                 schedule: Optional[SeededFaultSchedule] = None):
         self._path = path
-        install_chaos(path, faults, sleep)
+        install_chaos(path, faults, sleep, schedule=schedule)
 
     def __enter__(self):
         return self
@@ -248,8 +339,8 @@ def _wrap_registered(src: ByteSource) -> ByteSource:
     hit = _CHAOS.get(os.path.abspath(getattr(src, "path", "") or ""))
     if hit is None:
         return src
-    faults, sleep = hit
-    return FaultInjectingByteSource(src, faults, sleep)
+    faults, sleep, schedule = hit
+    return FaultInjectingByteSource(src, faults, sleep, schedule=schedule)
 
 
 # ---------------------------------------------------------------------------
@@ -337,10 +428,25 @@ class QuarantineManifest:
         limit = float(getattr(config, "max_bad_span_fraction", 1.0))
         frac = self.bad_fraction()
         if frac > limit:
+            # no longer one-way: the trip force-opens the per-file
+            # quarantine circuit in the resilience registry, so future
+            # runs on the same file fast-fail at the driver's
+            # check_quarantine_gate while OPEN, get a half-open probe
+            # after the cooldown, and heal on a clean finish
+            retry_after = None
+            try:
+                from hadoop_bam_tpu import resilience
+                for p in sorted({e.path for e in self}):
+                    br = resilience.quarantine_breaker(p, config=config)
+                    br.force_open()
+                    retry_after = br.retry_after_s()
+            except Exception:  # noqa: BLE001 — the abort must still fire
+                pass
             raise CircuitBreakerError(
                 f"quarantined {len(self)}/{self.total_spans} spans "
                 f"({frac:.1%}) exceeds max_bad_span_fraction={limit:g} — "
-                "aborting instead of degrading further")
+                "aborting instead of degrading further",
+                retry_after_s=retry_after)
 
     def to_dicts(self) -> List[dict]:
         with self._lock:

@@ -224,9 +224,26 @@ def write_bam_shards_concat(parts: Sequence[str], path: str, header,
     from hadoop_bam_tpu.ops import inflate as inflate_ops
 
     def chunks():
+        from hadoop_bam_tpu.utils.resilient import (
+            call_with_retry, span_retry_policy,
+        )
+        from hadoop_bam_tpu.utils.seekable import scoped_byte_source
+
+        policy = span_retry_policy(config)
+
+        def read_part(p):
+            # through as_byte_source, not a bare open(): part reads on a
+            # shared filesystem fault like any other read — transient
+            # faults retry with backoff, and the install_chaos registry
+            # observes them (the audited shard-concat seam, pinned by
+            # test)
+            with scoped_byte_source(p) as src:
+                return src.pread(0, src.size)
+
         for p in parts:
-            with open(p, "rb") as f:
-                raw = f.read()
+            raw = call_with_retry(lambda p=p: read_part(p), policy,
+                                  what=f"shard part read {p}",
+                                  counter="write.part_read_retries")
             if not raw:
                 continue
             table = inflate_ops.block_table(raw)

@@ -36,8 +36,12 @@ from typing import List, Optional
 import numpy as np
 
 from hadoop_bam_tpu.formats import bgzf
+from hadoop_bam_tpu.resilience import chaos
 from hadoop_bam_tpu.utils.errors import PlanError
 from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.utils.resilient import (
+    call_with_retry, span_retry_policy,
+)
 
 _SENTINEL = object()
 
@@ -69,6 +73,12 @@ class ParallelBGZFWriter:
         if max_inflight is not None and max_inflight < 0:
             raise PlanError(f"max_inflight must be >= 0, "
                             f"got {max_inflight}")
+        # deflate-worker fault recovery: transient-classified faults in
+        # a worker (an injected write.deflate chaos fault, a wobbly
+        # memory allocator) retry in place instead of poisoning the
+        # writer — deflate is deterministic, so a healed retry keeps the
+        # output byte-identical; corrupt/plan classes still fail fast
+        self._retry = span_retry_policy(config)
         serial = max_inflight == 0
         self._pool = None
         self._committer = None
@@ -137,8 +147,16 @@ class ParallelBGZFWriter:
         self._q.put(pools.submit(self._pool, self._deflate, payload))
 
     def _deflate(self, payload: bytes) -> bytes:
-        with METRICS.span("write.deflate_wall", nbytes=len(payload)):
+        def run() -> bytes:
+            # chaos point: a fault inside the deflate worker — the
+            # schedule decides whether it heals on retry (transient) or
+            # poisons the writer (corrupt)
+            chaos.fire("write.deflate", nbytes=len(payload))
             return bgzf.deflate_block(payload, self._level)
+
+        with METRICS.span("write.deflate_wall", nbytes=len(payload)):
+            return call_with_retry(run, self._retry, what="bgzf deflate",
+                                   counter="write.deflate_retries")
 
     # -- committer side ------------------------------------------------------
 

@@ -83,7 +83,12 @@ class ShardedFileWriter:
         shared-filesystem lag or data loss, never a benign skip."""
         missing = self.missing_parts()
         if missing:
-            raise RuntimeError(
+            # TRANSIENT class: on a shared filesystem a part that every
+            # host barriered on is visible-soon lag, not corruption —
+            # the retrying caller (or operator) should re-attempt the
+            # merge, not classify the output as bad data
+            from hadoop_bam_tpu.utils.errors import TransientIOError
+            raise TransientIOError(
                 f"{what}: shard(s) missing at merge time: {missing[:3]}"
                 f"{'...' if len(missing) > 3 else ''} — is "
                 f"{self.shard_dir} on a filesystem shared by all hosts?")

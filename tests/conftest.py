@@ -30,3 +30,18 @@ def cpu_devices():
     import jax
 
     return jax.devices("cpu")
+
+
+@pytest.fixture(autouse=True)
+def _pristine_resilience():
+    """Every test starts with closed breakers, empty fault domains and
+    no armed chaos points — adaptive state (an OPEN native-plane breaker
+    from a corruption test, say) must never leak into the next test's
+    plane selection."""
+    from hadoop_bam_tpu import resilience
+
+    resilience.reset()
+    resilience.chaos.clear_fault_points()
+    yield
+    resilience.reset()
+    resilience.chaos.clear_fault_points()

@@ -30,6 +30,7 @@ def _fill_state(bench, n_notes=6):
         ("bcf_variants_per_sec", 612345.7, "variants/s", 1.21),
         ("region_query_queries_per_sec", 41.7, "queries/s", 2.4),
         ("region_serve_queries_per_sec", 200.3, "queries/s", 9.5),
+        ("faulted_serve_queries_per_sec", 151.2, "queries/s", 0.81),
         ("obs_overhead_pct", 1.3, "%", None),
         ("device_inflate_records_per_sec", 93211.4, "records/s", 0.42),
         ("fastq_reads_per_sec", 188001.0, "reads/s", 2.37),
@@ -68,6 +69,14 @@ def _fill_state(bench, n_notes=6):
                        cold_p50_ms=44.2, warm_host_decode_share=0.0,
                        clients_qps=[[1, 196.0], [8, 188.9]],
                        regions=250, distinct_windows=51)
+        if m == "faulted_serve_queries_per_sec":
+            # the r14 degrade-and-heal row: shed accounting, degraded vs
+            # clean p50, ladder heal time and the reproducibility seed —
+            # full row only; the compact line keeps the number
+            row.update(shed_rate=0.175, served=66, shed=14,
+                       degraded_p50_ms=6.1, warm_chaos_p50_ms=5.2,
+                       clean_p50_ms=4.8, ladder_heal_s=0.41,
+                       chaos_seed=1234)
         if m == "sort_write_mb_per_sec":
             # the write-path row: parallel vs serial arm, deflate wall
             # share, byte identity — full row only; the contract pins
@@ -190,6 +199,17 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     # line keeps just the rate
     # the write-path row pins the arm comparison fields and byte
     # identity — shape only, no ratio (host-dependent on 1 core)
+    # r14: the degrade-and-heal serving row pins shed accounting (rate
+    # consistent with the counts), the degraded-vs-clean p50 pair, the
+    # ladder heal time and the chaos seed — shape only, no host ratio
+    fs = by_metric["faulted_serve_queries_per_sec"]
+    assert 0.0 <= fs["shed_rate"] <= 1.0
+    assert fs["shed_rate"] == pytest.approx(
+        fs["shed"] / (fs["served"] + fs["shed"]), abs=1e-3)
+    assert fs["degraded_p50_ms"] > 0 and fs["clean_p50_ms"] > 0
+    assert fs["warm_chaos_p50_ms"] > 0
+    assert fs["ladder_heal_s"] > 0
+    assert isinstance(fs["chaos_seed"], int)
     sw = by_metric["sort_write_mb_per_sec"]
     assert sw["serial_mb_per_sec"] > 0
     assert 0.0 <= sw["write_deflate_share"] <= 1.0

@@ -227,8 +227,17 @@ def test_quarantine_manifest_names_bad_span(bam, tmp_path):
     assert entry["span_end"] == bad_spans[0].end_voffset
     assert entry["path"] == bad_spans[0].path  # the span is self-describing
     assert entry["error_class"] == "corrupt"
-    assert entry["attempts"] == 1          # zero re-decodes of corruption
+    # ONE oracle re-decode, zero retry-policy re-decodes: corruption is
+    # never retried on the same plane, but since ISSUE 11 the demotion
+    # ladder confirms the failure on the zlib oracle before quarantining
+    # (the data — not the native plane — is what gets blamed here), so
+    # attempts counts the native try plus the zlib confirmation
+    assert entry["attempts"] == 2
     assert METRICS.get("pipeline.transient_retries") == 0
+    # no fault domain was charged: BOTH planes failed, so the ladder
+    # correctly classified this as data corruption, not a plane fault
+    from hadoop_bam_tpu import resilience
+    assert resilience.registry().states() == {}
     # the manifest also rides the result dict (non-empty runs only)
     assert stats["quarantine"] == q.to_dicts()
     assert q.total_spans == len(spans)

@@ -181,6 +181,46 @@ def f(n):
     assert findings == []
 
 
+def test_et_scope_covers_write_and_serve_boundaries():
+    """ISSUE 11 scope extension: bare builtins raised in the write-path
+    and serve-tier boundary modules reach clients as the WRONG wire
+    taxonomy (transport.error_kind) or poison the parallel writer —
+    ET301 now fires there too."""
+    bad = '''
+def merge(parts, missing):
+    if missing:
+        raise RuntimeError("shards missing at merge time")
+'''
+    for mod in ("hadoop_bam_tpu/write/sharded.py",
+                "hadoop_bam_tpu/write/parallel_bgzf.py",
+                "hadoop_bam_tpu/serve/transport.py",
+                "hadoop_bam_tpu/serve/loop.py"):
+        findings = lint_sources({mod: bad}, only=["taxonomy"])
+        assert rules_of(findings) == {"ET301"}, mod
+    # non-boundary serve-adjacent code stays out of scope
+    findings = lint_sources(
+        {"hadoop_bam_tpu/serve/__init__.py": bad}, only=["taxonomy"])
+    assert findings == []
+
+
+def test_et_write_serve_clean_twin_passes():
+    """The classified version of the same boundary code is clean."""
+    good = '''
+from hadoop_bam_tpu.utils.errors import PlanError, TransientIOError
+
+def merge(parts, missing):
+    if missing:
+        raise TransientIOError("shards missing — shared-fs lag, retry")
+
+def parse(doc):
+    if not isinstance(doc, dict):
+        raise PlanError("request must be a JSON object")
+'''
+    for mod in ("hadoop_bam_tpu/write/sharded.py",
+                "hadoop_bam_tpu/serve/transport.py"):
+        assert lint_sources({mod: good}, only=["taxonomy"]) == []
+
+
 def test_et_classified_raises_pass():
     findings = lint_sources({"hadoop_bam_tpu/formats/bgzf.py": '''
 from hadoop_bam_tpu.utils.errors import CorruptDataError, PlanError
