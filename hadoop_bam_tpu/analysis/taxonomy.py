@@ -28,10 +28,12 @@ from hadoop_bam_tpu.analysis.core import Finding, Project, register
 
 # the policy boundaries decode_with_retry / RetryingByteSource /
 # broadcast_plan classify across (ISSUE 3 tentpole scope), extended in
-# ISSUE 11 to the write-path and serve-tier boundary modules: a bare
+# ISSUE 11 to the write-path and serve-tier boundary modules — a bare
 # builtin raised there reaches clients as the WRONG wire taxonomy kind
 # (transport.error_kind) or poisons the parallel writer with a class
-# the retry policy misreads
+# the retry policy misreads — and in ISSUE 12 to the cohort plane's
+# boundary modules, where the class decides whether a faulting sample
+# input QUARANTINES (data) or fails the build (configuration)
 SCOPE = (
     "hadoop_bam_tpu/formats/bgzf.py",
     "hadoop_bam_tpu/formats/bamio.py",
@@ -50,6 +52,9 @@ SCOPE = (
     "hadoop_bam_tpu/serve/tenancy.py",
     "hadoop_bam_tpu/serve/prefetch.py",
     "hadoop_bam_tpu/serve/tiles.py",
+    "hadoop_bam_tpu/cohort/manifest.py",
+    "hadoop_bam_tpu/cohort/join.py",
+    "hadoop_bam_tpu/cohort/serving.py",
 )
 
 _BARE = {

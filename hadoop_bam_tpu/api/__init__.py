@@ -17,3 +17,6 @@ from hadoop_bam_tpu.api.read_datasets import (  # noqa: F401
     open_qseq,
 )
 from hadoop_bam_tpu.api.query import query_regions  # noqa: F401
+from hadoop_bam_tpu.cohort import (  # noqa: F401
+    CohortDataset, CohortManifest, cohort_gwas, open_cohort,
+)

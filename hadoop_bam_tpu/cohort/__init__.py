@@ -1,0 +1,25 @@
+"""Cohort variant plane: mesh-joined multi-sample dosage tensors.
+
+The million-user workload (ROADMAP item 3): thousands of single-sample
+VCF/BCF files joined on position into one ``[variants, samples]``
+dosage/genotype tensor, built as a mesh program —
+
+- ``manifest``: the named input set + its cache-keying identity;
+- ``harmonize``: per-site allele harmonization (multi-allelic
+  split/merge, REF/ALT swaps, duplicate positions);
+- ``join``: the k-way streaming position merge (split/kmerge.py core)
+  with per-input-file fault domains;
+- ``dataset``: ``CohortDataset.tensor_batches`` — joined tiles through
+  the shared FeedPipeline with the PR-4 missing-value sentinels;
+- ``gwas``: allele frequency / call rate / HWE / score-test mesh
+  drivers;
+- ``serving``: cohort-slice requests from device-resident dosage tiles
+  (``hbam serve`` integration).
+"""
+from hadoop_bam_tpu.cohort.manifest import (      # noqa: F401
+    CohortManifest, CohortSample, as_manifest, load_manifest,
+)
+from hadoop_bam_tpu.cohort.dataset import (       # noqa: F401
+    CohortDataset, open_cohort,
+)
+from hadoop_bam_tpu.cohort.gwas import GWAS_COLUMNS, cohort_gwas  # noqa: F401

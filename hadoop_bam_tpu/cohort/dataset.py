@@ -1,0 +1,182 @@
+"""CohortDataset: the [variants, samples] tensor surface over a manifest.
+
+The cohort twin of ``api.vcf_dataset.VcfDataset``: where that class
+tiles ONE file's variants, this one streams k single-sample files
+through the position join (cohort/join.py) and tiles the JOINED columns
+onto the mesh through the same shared ``variant_feed``/``FeedPipeline``
+machinery — so sentinel padding (-1 dosage / NaN qual), ring-slot
+reuse, and the in-flight transfer discipline are all inherited, not
+re-implemented.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.cohort.join import (
+    _JoinState, build_contig_space, guarded_sites, iter_joined_chunks,
+    iter_sample_sites,
+)
+from hadoop_bam_tpu.cohort.manifest import CohortManifest, as_manifest
+
+
+class CohortDataset:
+    """Mesh-tiled access to a cohort of single-sample VCF/BCF files.
+
+    ``tensor_batches`` yields device-resident dicts sharded over the
+    mesh's data axis::
+
+        chrom    int32  [n_dev, cap]
+        pos      int32  [n_dev, cap]
+        n_allele int16  [n_dev, cap]
+        dosage   int8   [n_dev, cap, samples_pad]   (-1 missing)
+        qual     float32[n_dev, cap, samples_pad]   (NaN missing)
+        n_records int32 [n_dev]
+
+    Rows beyond a shard's ``n_records`` carry the missing-value
+    sentinels uniformly (dosage -1, qual NaN, 0 elsewhere) — the PR-4
+    convention, enforced by the shared TileSpec pads.  Column ``j`` is
+    ``manifest.samples[j]``; a sample whose input quarantined mid-join
+    is sentinel-filled from the fault onward and listed in
+    ``self.manifest.quarantined``.
+    """
+
+    def __init__(self, source: Union[str, CohortManifest, List[str]],
+                 config: HBamConfig = DEFAULT_CONFIG):
+        from hadoop_bam_tpu.api.vcf_dataset import VcfDataset
+        from hadoop_bam_tpu.parallel.variant_pipeline import VariantGeometry
+        from hadoop_bam_tpu.resilience import file_ident, registry
+        from hadoop_bam_tpu.utils.errors import (
+            CorruptDataError, PLAN, classify_error,
+        )
+        from hadoop_bam_tpu.utils.metrics import METRICS
+
+        self.config = config
+        self.manifest = as_manifest(source)
+        quarantine = bool(getattr(config, "cohort_quarantine_inputs",
+                                  True))
+        # header reads: a MISSING path is configuration (PLAN, raises);
+        # a file whose header bytes are corrupt is data — under the
+        # quarantine policy its column goes sentinel before the join
+        # even starts (the slot is kept as None so sample indices stay
+        # stable)
+        self._datasets: List = []
+        for s in self.manifest.samples:
+            try:
+                self._datasets.append(VcfDataset(s.path, config))
+            except Exception as e:  # noqa: BLE001 — classified below
+                if classify_error(e) == PLAN or not quarantine:
+                    raise
+                registry().domain("cohort", "input", file_ident(s.path),
+                                  config=config).record_failure(e)
+                self.manifest.record_quarantine(
+                    s.sample_id, f"{type(e).__name__}: {e}")
+                METRICS.count("cohort.samples_quarantined")
+                self._datasets.append(None)
+        n_dead = sum(1 for d in self._datasets if d is None)
+        max_frac = float(getattr(config, "cohort_max_quarantine_fraction",
+                                 0.5))
+        if n_dead / max(1, self.manifest.n_samples) > max_frac:
+            raise CorruptDataError(
+                f"cohort build: {n_dead}/{self.manifest.n_samples} "
+                f"sample inputs quarantined at header read — over the "
+                f"cohort_max_quarantine_fraction={max_frac} circuit")
+        self.contigs = build_contig_space(
+            [ds.header for ds in self._datasets if ds is not None])
+        self._cmap = {c: i for i, c in enumerate(self.contigs)}
+        self.geometry = VariantGeometry(n_samples=self.manifest.n_samples)
+
+    @property
+    def n_samples(self) -> int:
+        return self.manifest.n_samples
+
+    @property
+    def sample_ids(self) -> List[str]:
+        return self.manifest.sample_ids
+
+    def contig_index(self, name: str) -> int:
+        return self._cmap.get(name, -1)
+
+    # -- host-side joined columns (the serve tier + oracle surface) ----------
+
+    def site_chunks(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Stream the joined cohort as host column chunks (up to
+        ``config.cohort_chunk_sites`` rows each) — the input of both the
+        mesh feed below and the serve tier's tile builder."""
+        state = _JoinState(
+            self.manifest.n_samples,
+            float(getattr(self.config, "cohort_max_quarantine_fraction",
+                          0.5)))
+        # header-time casualties count toward the fraction circuit
+        state.quarantined = sum(1 for d in self._datasets if d is None)
+        streams = []
+        for ds, sample in zip(self._datasets, self.manifest.samples):
+            if ds is None:
+                streams.append(iter(()))   # quarantined at header read
+                continue
+            # every join starts from the file's FIRST span: records()
+            # only auto-resets after a fully-exhausted iteration, and a
+            # join abandoned mid-stream (early tensor_batches break, a
+            # fraction-circuit trip) would otherwise silently RESUME
+            # mid-file on the next call and serve a truncated cohort
+            ds._next_span = 0
+            sites = iter_sample_sites(ds.records(), self._cmap)
+            streams.append(guarded_sites(
+                sites, sample.sample_id, sample.path, self.manifest,
+                state, self.config))
+        return iter_joined_chunks(self.manifest, streams,
+                                  self.geometry.samples_pad, self.config)
+
+    # -- mesh feed -----------------------------------------------------------
+
+    def tensor_batches(self, mesh=None, geometry=None) -> Iterator[Dict]:
+        """Yield device-resident joined tensor batches (class
+        docstring).  Same feed discipline as
+        ``VcfDataset.tensor_batches``: ring-slot groups, async
+        device_put with in-flight handles, fixed-shape tiles."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from hadoop_bam_tpu.parallel.mesh import make_mesh
+        from hadoop_bam_tpu.parallel.variant_pipeline import variant_feed
+
+        if mesh is None:
+            mesh = make_mesh()
+        if geometry is None:
+            geometry = self.geometry
+        n_dev = int(np.prod(mesh.devices.shape))
+        sharding = NamedSharding(mesh, P("data"))
+
+        keys, fp, tuples = variant_feed(self.site_chunks(), n_dev,
+                                        geometry.tile_records, self.config,
+                                        fixed_shape=True, fmt="cohort")
+        if fp is None:
+            return
+
+        def emit(arrays, counts) -> Dict:
+            # the device dict doubles as the slot's in-flight handle
+            out = {k: jax.device_put(a, sharding)
+                   for k, a in zip(keys, arrays)}
+            out["n_records"] = jax.device_put(counts, sharding)
+            return out
+
+        yield from fp.stream(tuples, emit)
+
+    # -- drivers -------------------------------------------------------------
+
+    def gwas(self, phenotype=None, mesh=None) -> Dict[str, np.ndarray]:
+        """Per-variant GWAS columns (cohort/gwas.py): allele frequency,
+        call rate, HWE chi-square, and — with a phenotype vector — the
+        score-test association chi-square."""
+        from hadoop_bam_tpu.cohort.gwas import cohort_gwas
+        return cohort_gwas(self, phenotype=phenotype, mesh=mesh,
+                           config=self.config)
+
+
+def open_cohort(source: Union[str, CohortManifest, List[str]],
+                config: HBamConfig = DEFAULT_CONFIG) -> CohortDataset:
+    """Resolve a manifest (path / object / bare path list) into the
+    cohort dataset — the cohort analog of ``api.open_vcf``."""
+    return CohortDataset(source, config)

@@ -124,6 +124,12 @@ _HADOOP_KEY_MAP = {
     "hbam.serve-shed-retry-after-s": "serve_shed_retry_after_s",
     "hbam.serve-prefetch-pause-pressure": "serve_prefetch_pause_pressure",
     "hbam.chaos-seed": "chaos_seed",
+    # cohort variant plane knobs (cohort/; no reference analog — Hadoop-BAM
+    # never joined inputs, it only split them)
+    "hbam.cohort-chunk-sites": "cohort_chunk_sites",
+    "hbam.cohort-quarantine-inputs": "cohort_quarantine_inputs",
+    "hbam.cohort-max-quarantine-fraction": "cohort_max_quarantine_fraction",
+    "hbam.serve-cohort-manifests": "serve_cohort_manifests",
 }
 
 
@@ -221,6 +227,27 @@ class HBamConfig:
     #                                  schedules (tests/bench/soak);
     #                                  None = chaos only via explicit
     #                                  install_chaos / fault_points_on
+
+    # --- cohort variant plane (cohort/: k-way position join of
+    # single-sample VCF/BCF inputs into [variants, samples] mesh tiles) ---
+    cohort_chunk_sites: int = 1024   # joined sites per host column chunk
+    #                                  handed to the feed pipeline (bounds
+    #                                  host memory: k streams buffer one
+    #                                  record each + one chunk of columns)
+    cohort_quarantine_inputs: bool = True  # a sample file that faults
+    #                                  mid-join (corrupt bytes, exhausted
+    #                                  transient retries) is QUARANTINED:
+    #                                  its column goes sentinel (-1/NaN)
+    #                                  from the fault onward and the join
+    #                                  completes; False = raise.  PLAN
+    #                                  errors (bad paths/params) always
+    #                                  raise either way
+    cohort_max_quarantine_fraction: float = 0.5  # abort the build once
+    #                                  more than this fraction of samples
+    #                                  quarantined — a cohort that lost
+    #                                  half its columns is not a result
+    serve_cohort_manifests: int = 8  # cohort manifests kept resident in
+    #                                  the serve tier before LRU eviction
 
     # --- debug ---
     debug_keep_spill: bool = False   # keep mesh-sort .mesh-spill run dirs
@@ -342,7 +369,8 @@ def _coerce(kwargs: dict) -> dict:
               "qseq_filter_failed_qc", "write_header", "write_terminator",
               "use_splitting_index", "use_native", "use_fused_decode",
               "keep_paired_reads_together", "skip_bad_spans",
-              "debug_keep_spill", "serve_prefetch", "adaptive_planes"):
+              "debug_keep_spill", "serve_prefetch", "adaptive_planes",
+              "cohort_quarantine_inputs"):
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
     for k in ("max_bad_span_fraction", "retry_backoff_base_s",
@@ -350,7 +378,8 @@ def _coerce(kwargs: dict) -> dict:
               "query_deadline_s", "breaker_failure_threshold",
               "breaker_window_s", "breaker_cooldown_s",
               "serve_shed_retry_after_s",
-              "serve_prefetch_pause_pressure"):
+              "serve_prefetch_pause_pressure",
+              "cohort_max_quarantine_fraction"):
         if k in out and isinstance(out[k], str):
             out[k] = float(out[k])
     for k in ("span_retries", "io_read_retries", "feed_ring_slots",
@@ -364,7 +393,8 @@ def _coerce(kwargs: dict) -> dict:
               "serve_prefetch_depth", "serve_recent_regions",
               "serve_tenant_max_in_flight", "serve_tenant_queue_depth",
               "serve_max_tenants", "serve_ring_slots",
-              "breaker_half_open_probes", "chaos_seed"):
+              "breaker_half_open_probes", "chaos_seed",
+              "cohort_chunk_sites", "serve_cohort_manifests"):
         if k in out and isinstance(out[k], str):
             out[k] = int(out[k])
     return out

@@ -435,15 +435,18 @@ def _iter_run_frames(path: str):
 def _merge_bucket_runs(run_paths: List[str]
                        ) -> Tuple[bytes, np.ndarray]:
     """k-way merge of one bucket's per-round sorted runs by the framed
-    (hi, lo, gidx) key — the external-merge half of the MR shuffle.
+    (hi, lo, gidx) key — the external-merge half of the MR shuffle,
+    running on the shared ``split/kmerge.py`` heap core (ties break in
+    run order, exactly ``heapq.merge``'s stability, so the extraction
+    is byte-identical — re-pinned by tests/test_kmerge.py).
     Returns (concatenated record bytes, per-record lengths) so writers
     can recover record boundaries for index-during-write."""
-    import heapq
+    from hadoop_bam_tpu.split.kmerge import kmerge
 
     chunks: List[bytes] = []
     lens: List[int] = []
-    for _key, payload in heapq.merge(
-            *(_iter_run_frames(p) for p in run_paths),
+    for _key, payload in kmerge(
+            (_iter_run_frames(p) for p in run_paths),
             key=lambda kv: kv[0]):
         chunks.append(payload)
         lens.append(len(payload))

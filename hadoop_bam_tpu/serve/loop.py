@@ -56,13 +56,17 @@ from hadoop_bam_tpu.utils.metrics import METRICS
 @dataclasses.dataclass
 class ServeResult:
     """One served region: the match count is always computed (tile
-    path); ``records`` materialize only when asked for."""
+    path); ``records`` materialize only when asked for.  ``extra``
+    carries projection-specific aggregates (the cohort plane reports
+    ``n_samples`` / ``mean_af`` / ``quarantined`` through it) and
+    rides the wire doc verbatim."""
     region: str
     count: int
     n_candidates: int
     tile_hits: int               # chunks served from resident tiles
     tile_misses: int             # chunks that needed a tile build
     records: Optional[List[object]] = None
+    extra: Optional[Dict[str, object]] = None
 
 
 @dataclasses.dataclass(order=True)
@@ -78,6 +82,9 @@ class _Job:
     future: cf.Future = dataclasses.field(compare=False)
     ctx: contextvars.Context = dataclasses.field(compare=False)
     t_enqueue: float = dataclasses.field(compare=False)
+    # cohort-slice request: ``path`` is a cohort manifest JSON and the
+    # regions slice the joined [variants, samples] tensor
+    cohort: bool = dataclasses.field(compare=False, default=False)
 
 
 class ServeLoop:
@@ -96,6 +103,7 @@ class ServeLoop:
         self.prefetcher = Prefetcher(self.engine, config)
         self.tile_cap = int(getattr(config, "serve_tile_records", 4096))
         self._builder: Optional[TileBuilder] = None
+        self._cohort = None          # lazy cohort/serving.CohortServer
         self._cond = threading.Condition()
         self._heap: List[_Job] = []
         self._seq = itertools.count()
@@ -145,13 +153,18 @@ class ServeLoop:
     def submit(self, path: str, regions: Sequence[str], *,
                tenant: str = "default", priority: str = "interactive",
                deadline_s: Optional[float] = None,
-               want_records: bool = False) -> cf.Future:
+               want_records: bool = False,
+               cohort: bool = False) -> cf.Future:
         """Enqueue one request (a path + its regions) for serving.
 
         Blocks (bounded) on THIS thread for tenant admission — the
         backpressure lands on the flooding client — then returns a
         Future of ``[ServeResult, ...]``.  Over-quota tenants shed with
-        ``TransientIOError``; bad parameters raise ``PlanError``."""
+        ``TransientIOError``; bad parameters raise ``PlanError``.
+
+        With ``cohort=True``, ``path`` names a cohort manifest JSON and
+        each region is answered from the device-resident joined dosage
+        tiles (cohort/serving.py) instead of the per-file index path."""
         if not regions:
             raise PlanError("submit() needs at least one region")
         rank = priority_rank(priority)
@@ -173,7 +186,7 @@ class ServeLoop:
                    want_records=bool(want_records), deadline=deadline,
                    admission=admission, future=cf.Future(),
                    ctx=contextvars.copy_context(),
-                   t_enqueue=time.perf_counter())
+                   t_enqueue=time.perf_counter(), cohort=bool(cohort))
         with self._cond:
             if self._stopping:
                 self._finish_admission(job)
@@ -189,10 +202,13 @@ class ServeLoop:
         return self.submit(path, regions, **kwargs).result()
 
     def stats(self) -> Dict[str, object]:
-        return {"tiles": self.tiles.stats(),
-                "chunks": self.engine.cache.stats(),
-                "prefetch": self.prefetcher.stats(),
-                "tenants": self.tenants.stats()}
+        out = {"tiles": self.tiles.stats(),
+               "chunks": self.engine.cache.stats(),
+               "prefetch": self.prefetcher.stats(),
+               "tenants": self.tenants.stats()}
+        if self._cohort is not None:
+            out["cohort"] = self._cohort.stats()
+        return out
 
     def health(self) -> Dict[str, object]:
         """The degraded-mode diagnosis surface (``{"op": "health"}`` on
@@ -279,7 +295,20 @@ class ServeLoop:
                 int(getattr(self.config, "serve_ring_slots", 3)))
         return self._builder
 
+    def _cohort_or_make(self):
+        if self._cohort is None:
+            from hadoop_bam_tpu.cohort.serving import CohortServer
+            self._cohort = CohortServer(self.engine._mesh_or_make(),
+                                        self.config)
+        return self._cohort
+
     def _serve_region(self, job: _Job, region: str) -> ServeResult:
+        if job.cohort:
+            # the cohort plane: joined [variants, samples] tiles in the
+            # SAME device cache, keyed by the manifest identity
+            return self._cohort_or_make().serve(
+                job.path, region, self.tiles,
+                want_records=job.want_records, deadline=job.deadline)
         engine = self.engine
         job.deadline.check("serve resolve")
         meta = engine._file_meta(job.path)

@@ -22,6 +22,12 @@ stopping loop) additionally carry the server's backoff hint::
     {"id": 2, "error": "...", "kind": "transient", "retry_after_s": 0.1}
     {"id": 3, "error": "...", "kind": "plan"}        # fix the request
 
+``"cohort": true`` marks a cohort-slice request: ``path`` names a
+cohort manifest JSON and each region slices the joined
+[variants, samples] tensor from device-resident dosage tiles
+(cohort/serving.py); results additionally carry ``n_samples`` /
+``mean_af`` / ``quarantined``.
+
 ``{"op": "health"}`` answers out of band with the loop's breaker and
 demotion-ladder state (``ServeLoop.health``) — the liveness/diagnosis
 surface a degraded server keeps serving even while it sheds queries.
@@ -83,7 +89,13 @@ def _result_doc(req_id, tenant: str, results, t_enqueue: float) -> Dict:
             {"region": r.region, "count": r.count,
              "candidates": r.n_candidates, "tile_hits": r.tile_hits,
              "tile_misses": r.tile_misses,
-             **({"records": [rec.to_line() for rec in r.records]}
+             # cohort-plane aggregates (n_samples/mean_af/quarantined)
+             # ride the result doc verbatim
+             **(r.extra if getattr(r, "extra", None) else {}),
+             # region records carry to_line(); cohort slice records are
+             # already wire-shaped dicts
+             **({"records": [rec.to_line() if hasattr(rec, "to_line")
+                             else rec for rec in r.records]}
                 if r.records is not None else {})}
             for r in results],
     }
@@ -145,7 +157,8 @@ def handle_stream(loop, rfile, wfile) -> int:
                     tenant=str(doc.get("tenant", "default")),
                     priority=str(doc.get("priority", "interactive")),
                     deadline_s=doc.get("deadline_s"),
-                    want_records=bool(doc.get("records", False)))
+                    want_records=bool(doc.get("records", False)),
+                    cohort=bool(doc.get("cohort", False)))
             except (ValueError, KeyError, TypeError) as e:
                 # malformed line / PlanError-class rejection: answer,
                 # keep serving the stream (one bad client line must not

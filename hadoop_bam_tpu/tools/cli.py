@@ -685,6 +685,81 @@ def cmd_vcf_sort(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# cohort
+# ---------------------------------------------------------------------------
+
+def cmd_cohort(args) -> int:
+    """Cohort variant plane (cohort/): join the manifest's single-sample
+    VCF/BCF inputs on position into one [variants, samples] mesh tensor
+    and run the GWAS drivers (allele frequency, call rate, HWE; the
+    score test with --pheno).  --region restricts the report to one
+    slice; --tsv writes the full per-variant table."""
+    import numpy as np
+
+    from hadoop_bam_tpu.cohort import GWAS_COLUMNS, CohortDataset
+
+    _start_obs(args)
+    ds = CohortDataset(args.manifest)
+    pheno = None
+    if args.pheno:
+        # one float per manifest sample, in manifest order; 'nan' (or
+        # any non-float token) = missing phenotype
+        vals = []
+        with open(args.pheno) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    vals.append(float(line.split()[-1]))
+                except ValueError:
+                    vals.append(float("nan"))
+        pheno = np.asarray(vals, np.float32)
+    res = ds.gwas(phenotype=pheno)
+    mask = np.ones(res["n_variants"], bool)
+    if args.region:
+        from hadoop_bam_tpu.split.intervals import parse_interval
+        iv = parse_interval(args.region)
+        rid = ds.contig_index(iv.rname)
+        if rid < 0:
+            raise SystemExit(f"contig {iv.rname!r} is in no sample header")
+        mask = ((res["chrom"] == rid) & (res["pos"] >= iv.start)
+                & (res["pos"] <= iv.end))
+    n = int(mask.sum())
+    print(f"samples\t{ds.n_samples}")
+    print(f"variants\t{n}")
+    print(f"quarantined\t{len(res['quarantined'])}")
+    for sid in sorted(res["quarantined"]):
+        print(f"quarantined_sample\t{sid}", file=sys.stderr)
+    with np.errstate(invalid="ignore"):
+        for col in GWAS_COLUMNS:
+            v = res[col][mask]
+            if col == "score_chi2" and pheno is None:
+                continue
+            if v.size and not np.all(np.isnan(v)):
+                print(f"mean_{col}\t{np.nanmean(v):.6f}")
+            else:
+                print(f"mean_{col}\tnan")
+    if args.tsv:
+        cols = [c for c in GWAS_COLUMNS
+                if not (c == "score_chi2" and pheno is None)]
+        with open(args.tsv, "w") as f:
+            f.write("\t".join(["chrom", "pos", "n_allele"] + cols) + "\n")
+            rows = np.flatnonzero(mask)
+            for r in rows:
+                name = (ds.contigs[int(res["chrom"][r])]
+                        if 0 <= int(res["chrom"][r]) < len(ds.contigs)
+                        else str(int(res["chrom"][r])))
+                f.write("\t".join(
+                    [name, str(int(res["pos"][r])),
+                     str(int(res["n_allele"][r]))]
+                    + [f"{float(res[c][r]):.6g}" for c in cols]) + "\n")
+        print(f"wrote {args.tsv} ({n} variants)", file=sys.stderr)
+    _finish_obs(args)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # frontend
 # ---------------------------------------------------------------------------
 
@@ -878,6 +953,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="accept all current findings into the baseline")
     ln.add_argument("--show-suppressed", action="store_true")
     ln.set_defaults(fn=cmd_lint, uses_device=False)
+
+    ch = sub.add_parser(
+        "cohort",
+        help="join a cohort manifest of single-sample VCF/BCF files on "
+             "position and run the GWAS mesh drivers")
+    ch.add_argument("manifest",
+                    help='manifest JSON ({"samples": [{"id", "path"}, ...]}'
+                         " or a bare path list)")
+    ch.add_argument("--region", default=None,
+                    help="report one chr[:start-end] slice of the joined "
+                         "tensor instead of the whole cohort")
+    ch.add_argument("--pheno", default=None, metavar="FILE",
+                    help="phenotype file (one float per manifest sample, "
+                         "manifest order; nan = missing) — enables the "
+                         "score-test association column")
+    ch.add_argument("--tsv", default=None, metavar="FILE",
+                    help="write the per-variant stats table")
+    _add_obs_flags(ch)
+    ch.set_defaults(fn=cmd_cohort, uses_device=True)
 
     vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos) "
                                          "(external spill-merge)")

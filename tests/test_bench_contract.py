@@ -32,6 +32,7 @@ def _fill_state(bench, n_notes=6):
         ("region_serve_queries_per_sec", 200.3, "queries/s", 9.5),
         ("faulted_serve_queries_per_sec", 151.2, "queries/s", 0.81),
         ("obs_overhead_pct", 1.3, "%", None),
+        ("cohort_join_variants_per_sec", 48211.5, "variants/s", None),
         ("device_inflate_records_per_sec", 93211.4, "records/s", 0.42),
         ("fastq_reads_per_sec", 188001.0, "reads/s", 2.37),
         ("bam_write_records_per_sec", 301222.5, "records/s", 2.1),
@@ -87,6 +88,15 @@ def _fill_state(bench, n_notes=6):
                        byte_identical_to_serial=True)
         if m == "obs_overhead_pct":
             row.update(instrumented_s=0.1301, null_s=0.1284)
+        if m == "cohort_join_variants_per_sec":
+            # the r15 cohort-plane row: k-way join+pack rate, per-stage
+            # wall shares, warm vs cold cohort-slice serving — full row
+            # only; the compact line keeps the number
+            row.update(samples=64, variants=91234,
+                       stage_wall_shares={"join": 0.41, "feed": 0.22,
+                                          "dispatch": 0.09},
+                       cold_slice_p50_ms=310.2, warm_slice_p50_ms=3.1,
+                       warm_host_decode_share=0.0)
         if m == "device_inflate_records_per_sec":
             # r11: the decode-plane wall breakdown (tokenize vs on-mesh
             # resolve and their overlap) rides the FULL row only
@@ -210,6 +220,16 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     assert fs["warm_chaos_p50_ms"] > 0
     assert fs["ladder_heal_s"] > 0
     assert isinstance(fs["chaos_seed"], int)
+    # r15: the cohort-plane row pins the join's per-stage wall shares,
+    # the cold-vs-warm slice pair and the warm host-decode bypass —
+    # shape only (the rate is host-dependent), compact line keeps the
+    # number
+    cj = by_metric["cohort_join_variants_per_sec"]
+    assert cj["samples"] > 1 and cj["variants"] > 0
+    assert set(cj["stage_wall_shares"]) == {"join", "feed", "dispatch"}
+    assert all(0.0 <= v <= 1.0 for v in cj["stage_wall_shares"].values())
+    assert cj["cold_slice_p50_ms"] > cj["warm_slice_p50_ms"] > 0
+    assert cj["warm_host_decode_share"] < 0.1
     sw = by_metric["sort_write_mb_per_sec"]
     assert sw["serial_mb_per_sec"] > 0
     assert 0.0 <= sw["write_deflate_share"] <= 1.0
@@ -262,6 +282,29 @@ def test_scaling_rows_pin_feed_overlap_fields(bench):
         assert "pipeline.feed_wall" in row["flagstat_wall_seconds_per_run"]
     line = json.dumps(bench._compact_snapshot(full))
     assert len(line) <= bench.FINAL_LINE_BUDGET
+
+
+def test_stale_sidecars_healed_fresh_kept(bench, tmp_path):
+    """The stale-sidecar auto-heal (the recurring 'truncated BGZF
+    header' scaling failure): sidecars OLDER than their fixture are
+    removed, fresh ones are kept, and the purge flavor removes
+    everything."""
+    bam = tmp_path / "f.bam"
+    bam.write_bytes(b"x" * 10)
+    stale = tmp_path / "f.bam.bai"
+    stale.write_bytes(b"old")
+    os.utime(stale, ns=(1, 1))                 # older than the fixture
+    fresh = tmp_path / "f.bam.sbi"
+    fresh.write_bytes(b"new")
+    os.utime(fresh, ns=(2**62, 2**62))         # newer than the fixture
+    removed = bench._heal_stale_sidecars(str(bam))
+    assert removed == ["f.bam.bai"]
+    assert not stale.exists() and fresh.exists()
+    # idempotent + missing fixture is a no-op
+    assert bench._heal_stale_sidecars(str(bam)) == []
+    assert bench._heal_stale_sidecars(str(tmp_path / "absent.bam")) == []
+    assert bench._purge_sidecars(str(bam)) == ["f.bam.sbi"]
+    assert not fresh.exists()
 
 
 def test_snapshot_mutation_not_duplicated_by_compact(bench):

@@ -1,0 +1,144 @@
+"""split/kmerge.py — the extracted k-way streaming merge core.
+
+Pins the contracts the two consumers rely on: global heap order,
+stream-order tie-breaking (= ``heapq.merge`` stability, which is what
+keeps the mesh-sort spill merge byte-identical after the extraction),
+exhausted-stream handling, empty inputs, and the grouped flavor the
+cohort join builds sites from.
+"""
+import heapq
+import random
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.split.kmerge import kmerge, kmerge_grouped, kmerge_indexed
+
+pytestmark = pytest.mark.cohort
+
+
+def test_heap_order_randomized_matches_sorted_concat():
+    rng = random.Random(7)
+    for _ in range(25):
+        k = rng.randint(1, 8)
+        streams = [sorted(rng.randint(0, 40) for _ in range(rng.randint(0, 30)))
+                   for _ in range(k)]
+        out = list(kmerge([iter(s) for s in streams]))
+        assert out == sorted(x for s in streams for x in s)
+
+
+def test_key_function_and_heap_order():
+    a = [(1, "a0"), (3, "a1"), (3, "a2"), (9, "a3")]
+    b = [(2, "b0"), (3, "b1"), (8, "b2")]
+    out = list(kmerge([a, b], key=lambda t: t[0]))
+    assert [t[0] for t in out] == [1, 2, 3, 3, 3, 8, 9]
+
+
+def test_tie_breaking_is_stream_order():
+    # equal keys must yield stream 0's items first — heapq.merge
+    # stability, load-bearing for mesh-sort byte identity
+    a = [(5, "a0"), (5, "a1")]
+    b = [(5, "b0")]
+    c = [(5, "c0")]
+    out = list(kmerge([a, b, c], key=lambda t: t[0]))
+    assert out == [(5, "a0"), (5, "a1"), (5, "b0"), (5, "c0")]
+    # matches the stdlib's answer exactly
+    assert out == list(heapq.merge(a, b, c, key=lambda t: t[0]))
+
+
+def test_exhausted_streams_drop_out():
+    # wildly different lengths: short streams end without disturbing
+    # the rest, the long tail still arrives in order
+    a = [1]
+    b = [0, 2, 4, 6, 8, 10, 12]
+    c: list = []
+    d = [3, 5]
+    assert list(kmerge([a, b, c, d])) == [0, 1, 2, 3, 4, 5, 6, 8, 10, 12]
+
+
+def test_empty_inputs():
+    assert list(kmerge([])) == []
+    assert list(kmerge([[], [], []])) == []
+    assert list(kmerge_grouped([[], []], key=lambda x: x)) == []
+
+
+def test_indexed_carries_stream_identity():
+    out = list(kmerge_indexed([[1, 4], [2, 3]]))
+    assert out == [(0, 1), (1, 2), (1, 3), (0, 4)]
+
+
+def test_streaming_one_item_lookahead():
+    """Inputs are streamed, not materialized: after the first yield only
+    one item per stream has been pulled past it."""
+    pulled = []
+
+    def trace(si, items):
+        for x in items:
+            pulled.append((si, x))
+            yield x
+
+    g = kmerge([trace(0, [1, 3]), trace(1, [2, 4])])
+    assert next(g) == 1
+    # priming pulled exactly one item per stream and nothing more
+    assert pulled == [(0, 1), (1, 2)]
+    assert next(g) == 2
+    # advancing past 1 pulled only stream 0's successor
+    assert pulled == [(0, 1), (1, 2), (0, 3)]
+    g.close()
+
+
+def test_grouped_runs_of_equal_keys():
+    a = [(0, 10), (2, 11), (2, 12)]
+    b = [(0, 20), (3, 21)]
+    groups = list(kmerge_grouped([a, b], key=lambda t: t[0]))
+    assert [k for k, _ in groups] == [0, 2, 3]
+    assert groups[0][1] == [(0, (0, 10)), (1, (0, 20))]
+    # duplicates within one stream land in the SAME group, stream order
+    assert groups[1][1] == [(0, (2, 11)), (0, (2, 12))]
+    assert groups[2][1] == [(1, (3, 21))]
+
+
+def test_mesh_sort_spill_merge_repinned_on_kmerge():
+    """_merge_bucket_runs (now on kmerge) is byte-identical to the
+    heapq.merge oracle over synthetic framed runs."""
+    from hadoop_bam_tpu.parallel import mesh_sort as ms
+
+    rng = random.Random(13)
+
+    def frame(recs):
+        out = bytearray()
+        for hi, lo, gidx, payload in recs:
+            out += int(hi).to_bytes(4, "little")
+            out += int(lo).to_bytes(4, "little")
+            out += int(gidx).to_bytes(4, "little", signed=True)
+            out += len(payload).to_bytes(4, "little", signed=True)
+            out += payload
+        return bytes(out)
+
+    def rand_runs(tmpdir, n_runs):
+        paths = []
+        for r in range(n_runs):
+            recs = sorted(
+                ((rng.randint(0, 3), rng.randint(0, 50), rng.randint(0, 99),
+                  bytes(rng.randrange(256)
+                        for _ in range(rng.randint(0, 12))))
+                 for _ in range(rng.randint(0, 20))),
+                key=lambda t: t[:3])
+            p = str(tmpdir / f"run{r}.bin")
+            with open(p, "wb") as f:
+                f.write(frame(recs))
+            paths.append(p)
+        return paths
+
+    import tempfile
+    from pathlib import Path
+    with tempfile.TemporaryDirectory() as td:
+        paths = rand_runs(Path(td), 5)
+        payload, lens = ms._merge_bucket_runs(paths)
+        # oracle: stdlib heapq.merge over the same frame iterators
+        chunks = [p for _k, p in heapq.merge(
+            *(ms._iter_run_frames(p) for p in paths),
+            key=lambda kv: kv[0])]
+        assert payload == b"".join(chunks)
+        assert lens.tolist() == [len(c) for c in chunks]
+        assert lens.dtype == np.int64

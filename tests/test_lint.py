@@ -221,6 +221,49 @@ def parse(doc):
         assert lint_sources({mod: good}, only=["taxonomy"]) == []
 
 
+def test_et_scope_covers_cohort_boundaries():
+    """ISSUE 12 scope extension: the cohort plane's boundary modules —
+    a bare builtin there makes the per-input fault guard quarantine a
+    configuration error (or fail a build on data the policy should
+    have quarantined)."""
+    bad = '''
+def join(manifest):
+    if not manifest:
+        raise ValueError("empty manifest")
+'''
+    for mod in ("hadoop_bam_tpu/cohort/manifest.py",
+                "hadoop_bam_tpu/cohort/join.py",
+                "hadoop_bam_tpu/cohort/serving.py"):
+        findings = lint_sources({mod: bad}, only=["taxonomy"])
+        assert rules_of(findings) == {"ET301"}, mod
+    # non-boundary cohort code (the pure harmonizer, the device
+    # drivers) stays out of scope
+    for mod in ("hadoop_bam_tpu/cohort/harmonize.py",
+                "hadoop_bam_tpu/cohort/gwas.py",
+                "hadoop_bam_tpu/cohort/dataset.py"):
+        assert lint_sources({mod: bad}, only=["taxonomy"]) == [], mod
+
+
+def test_et_cohort_clean_twin_passes():
+    """The classified version of the same cohort boundary code is
+    clean: PlanError for configuration, CorruptDataError for bytes."""
+    good = '''
+from hadoop_bam_tpu.utils.errors import CorruptDataError, PlanError
+
+def load(doc):
+    if not isinstance(doc, dict):
+        raise PlanError("cohort manifest must be a JSON object")
+
+def stream(records):
+    for last, key in records:
+        if key < last:
+            raise CorruptDataError("records out of (contig, pos) order")
+'''
+    for mod in ("hadoop_bam_tpu/cohort/manifest.py",
+                "hadoop_bam_tpu/cohort/join.py"):
+        assert lint_sources({mod: good}, only=["taxonomy"]) == [], mod
+
+
 def test_et_classified_raises_pass():
     findings = lint_sources({"hadoop_bam_tpu/formats/bgzf.py": '''
 from hadoop_bam_tpu.utils.errors import CorruptDataError, PlanError
