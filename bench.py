@@ -1786,6 +1786,152 @@ def bench_sort_write(path: str):
                      "byte identity, not a ratio")}
 
 
+_RESUME_KILL_CHILD = """
+import os, signal, sys
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from hadoop_bam_tpu.jobs import JobJournal
+src, out, jp, rr = sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+orig = JobJournal.unit_done
+n = [0]
+def patched(self, kind, key, **kw):
+    orig(self, kind, key, **kw)
+    if kind == "round":
+        n[0] += 1
+        if n[0] >= 1:
+            os.kill(os.getpid(), signal.SIGKILL)
+JobJournal.unit_done = patched
+from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
+sort_bam_mesh(src, out, round_records=rr, journal_path=jp)
+"""
+
+_RESUME_RESUME_CHILD = """
+import json, os, sys, time
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
+from hadoop_bam_tpu.utils.metrics import MetricsContext
+src, out, jp, rr = sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+t0 = time.perf_counter()
+with MetricsContext() as m:
+    n = sort_bam_mesh(src, out, round_records=rr, journal_path=jp)
+snap = m.snapshot()
+print(json.dumps({
+    "records": n, "wall_s": time.perf_counter() - t0,
+    "spans_skipped": snap["counters"].get("jobs.spans_skipped", 0),
+    "rounds_skipped": snap["counters"].get("jobs.rounds_skipped", 0)}))
+"""
+
+
+def bench_resume(path: str):
+    """Crash-safe jobs row (jobs/): (1) journaling overhead — spill-mode
+    mesh sort with and without a journal, interleaved best-of, bar <3%
+    (the journal writes one fsync'd record per ROUND, not per record);
+    (2) a resume arm — a subprocess running the same journaled sort
+    SIGKILLs itself after its first committed round, a second process
+    resumes from the journal, and the row reports the fraction of span
+    decodes the journal let it skip plus byte identity vs the
+    journal-off output.  The kill/resume pair runs on the forced-CPU
+    8-device mesh in subprocesses so the round partitioning is
+    identical between the killed and resuming runs regardless of the
+    bench platform."""
+    import shutil
+    import tempfile
+
+    from hadoop_bam_tpu.jobs import JobJournal, journal_path_for
+    from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
+
+    n_slice = min(BENCH_RECORDS, int(os.environ.get("BENCH_SORT_RECORDS",
+                                                    "100000")))
+    src = os.path.join(BENCH_DIR, f"bench_sort_{n_slice}.bam")
+    if not os.path.exists(src):
+        bench_sort(path)                 # builds the shuffled fixture
+    import jax
+    rr = max(500, n_slice // max(1, 4 * jax.device_count()))
+    tmp = tempfile.mkdtemp(prefix="hbam_bench_resume_")
+    try:
+        plain_out = os.path.join(tmp, "plain.bam")
+        jr_out = os.path.join(tmp, "journaled.bam")
+        jr_jp = journal_path_for(jr_out)
+
+        def plain_run():
+            return sort_bam_mesh(src, plain_out, round_records=rr)
+
+        def journaled_run():
+            # fresh journal per rep: a done-job journal would turn the
+            # rep into a verified no-op and measure nothing
+            if os.path.exists(jr_jp):
+                os.unlink(jr_jp)
+            return sort_bam_mesh(src, jr_out, round_records=rr,
+                                 journal_path=jr_jp)
+
+        n, pdt = _median_time(plain_run)
+        jn, jdt = _median_time(journaled_run)
+        assert n == jn
+        identical = open(plain_out, "rb").read() == open(jr_out,
+                                                         "rb").read()
+        overhead_pct = (jdt - pdt) / max(pdt, 1e-9) * 100.0
+
+        # --- resume arm (subprocess kill + subprocess resume) ---
+        kill_out = os.path.join(tmp, "killed.bam")
+        kill_jp = journal_path_for(kill_out)
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count="
+                            + str(jax.device_count())).strip()
+        budget = min(150.0, max(30.0, _remaining() - 30))
+        r1 = subprocess.run(
+            [sys.executable, "-c", _RESUME_KILL_CHILD, src, kill_out,
+             kill_jp, str(rr)], env=env, capture_output=True, text=True,
+            timeout=budget)
+        resume = {}
+        if r1.returncode >= 0:
+            resume = {"error": f"kill child exited rc={r1.returncode} "
+                               f"instead of dying: "
+                               f"{(r1.stderr or '')[-200:]}"}
+        else:
+            r2 = subprocess.run(
+                [sys.executable, "-c", _RESUME_RESUME_CHILD, src,
+                 kill_out, kill_jp, str(rr)], env=env,
+                capture_output=True, text=True, timeout=budget)
+            try:
+                out = json.loads(r2.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                out = {"error": f"resume child rc={r2.returncode}: "
+                                f"{(r2.stderr or '')[-200:]}"}
+            if "error" not in out:
+                st = JobJournal.replay(kill_jp)
+                n_spans = int((st.last_event("plan") or {}).get(
+                    "n_spans", 0))
+                resume = {
+                    "resume_records": out["records"],
+                    "resume_wall_s": round(out["wall_s"], 3),
+                    "resume_rounds_skipped": out["rounds_skipped"],
+                    "resume_fraction_skipped": round(
+                        out["spans_skipped"] / max(1, n_spans), 4),
+                    "resume_byte_identical": bool(
+                        open(kill_out, "rb").read()
+                        == open(plain_out, "rb").read()),
+                }
+            else:
+                resume = out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {"metric": "resume_overhead_pct",
+            "value": round(overhead_pct, 2), "unit": "%",
+            "journaled_wall_s": round(jdt, 3),
+            "plain_wall_s": round(pdt, 3),
+            "round_records": rr, "records": int(n),
+            "byte_identical_to_plain": bool(identical),
+            **resume,
+            "note": ("journal-on vs journal-off spill mesh sort "
+                     "(bar <3%); resume arm SIGKILLs a child after "
+                     "round 1 and reports journal-verified skipped "
+                     "span fraction")}
+
+
 def bench_bam_write(path: str):
     """Write path: re-encode a decoded slice through BamWriter (native
     libdeflate BGZF) vs the same pipeline forced onto Python zlib —
@@ -2414,6 +2560,8 @@ def main() -> None:
                    "coverage_records_per_sec", est_s=35)
     _run_component(lambda: bench_sort(path), "sort_records_per_sec_mesh",
                    est_s=45)
+    _run_component(lambda: bench_resume(path), "resume_overhead_pct",
+                   est_s=75)
     _run_component(lambda: bench_sort_write(path), "sort_write_mb_per_sec",
                    est_s=40)
 

@@ -17,6 +17,10 @@ Five AST analyzers over correctness regimes generic linters cannot see:
 - ``devicesync``   (DV9xx) — per-iteration host syncs (``np.asarray``,
   ``jax.device_get``, ``.item()``) in loops inside the device decode
   plane (each one stalls the token-feed pipeline behind the link)
+- ``jobsafety``    (JS1xx) — crash-safe job discipline in ``write/`` +
+  the mesh sort: publication renames outside the blessed/journaled
+  commit helpers, non-idempotent (random/pid/time-derived) temp names
+  that resume can neither verify nor sweep
 
 Findings carry file:line, rule id and severity; ``analysis/baseline.json``
 suppresses accepted legacy findings so CI fails only on regressions.

@@ -1,0 +1,187 @@
+"""JS1xx — crash-safe job discipline: journaled publication, idempotent
+temp naming.
+
+The jobs layer (``hadoop_bam_tpu/jobs/``) makes every long pipeline
+resumable, but only as long as two invariants hold in the code that
+produces durable artifacts (``write/`` and the mesh sort):
+
+- **JS101 — publication routes through a commit helper.**  A resumable
+  unit is "artifact on disk + journal record that verifies it"; a bare
+  ``os.replace``/``os.rename`` sprinkled into pipeline code publishes
+  an artifact the journal never learns about — a resumed run can
+  neither skip it (no record to verify) nor sweep it (it looks final).
+  Renames are therefore confined to the blessed publication/commit
+  helpers — ``_publish`` (write/api.py's atomic data+sidecar
+  publication) and ``open_shard`` (write/sharded.py's part commit,
+  which appends the journal unit) — or to a function that itself
+  journals the commit (calls ``unit_done``/``job_done`` alongside the
+  rename, the co-location that makes a new commit helper legitimate).
+
+- **JS102 — temp names are deterministic (job-scoped), never random.**
+  Crash recovery sweeps stale temps and skips committed artifacts BY
+  NAME: ``part-00007.tmp`` from a dead run is recognizably the debris
+  of shard 7, and ``part-00007`` is verifiably shard 7's commit.  A
+  temp name derived from ``getpid()``/``uuid4()``/``time()``/
+  ``tempfile.mkstemp`` is different on every attempt — the crashed
+  run's files can never be matched to units, so they leak forever and
+  resume degenerates to hoping nothing collides.  Any write-mode
+  ``open``/rename whose path expression references a non-deterministic
+  source (or any ``tempfile`` API use) in scope is flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/write", "hadoop_bam_tpu/parallel/mesh_sort.py")
+
+_RENAME_CALLS = {"replace", "rename", "renames", "link", "symlink"}
+_BLESSED_FNS = {"_publish", "open_shard"}
+_JOURNAL_COMMIT_CALLS = {"unit_done", "job_done", "commit_unit"}
+_WRITE_MODES = ("w", "wb", "xb", "x", "wb+", "w+b", "ab", "a", "ab+")
+_NONDETERMINISTIC = {
+    "getpid", "gettid", "uuid1", "uuid4", "mktemp", "mkstemp",
+    "mkdtemp", "NamedTemporaryFile", "TemporaryFile",
+    "TemporaryDirectory", "token_hex", "token_bytes", "randint",
+    "random", "randbytes", "urandom", "time", "time_ns", "monotonic",
+    "perf_counter",
+}
+
+
+def _func_defs(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _is_rename(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Attribute) and fn.attr in _RENAME_CALLS
+            and isinstance(fn.value, ast.Name) and fn.value.id == "os")
+
+
+def _journals_commit(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _JOURNAL_COMMIT_CALLS:
+            return True
+    return False
+
+
+def _is_write_open(call: ast.Call) -> bool:
+    fn = call.func
+    if not (isinstance(fn, ast.Name) and fn.id == "open"):
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and mode in _WRITE_MODES
+
+
+def _nondeterministic_names(expr: ast.AST, tainted=frozenset()
+                            ) -> List[str]:
+    out = []
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+            if name in tainted:
+                out.append(name)
+                continue
+        if name in _NONDETERMINISTIC:
+            out.append(name)
+    return out
+
+
+def _tainted_locals(fn: ast.AST) -> frozenset:
+    """One-hop dataflow: local names assigned from an expression that
+    references a nondeterministic source (``path = f"run-{os.getpid()}"``
+    taints ``path``) — enough for the assign-then-open shape every real
+    violation takes, without building a dataflow engine."""
+    tainted = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None or not _nondeterministic_names(value,
+                                                        frozenset(
+                                                            tainted)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                tainted.add(t.id)
+    return frozenset(tainted)
+
+
+@register("jobsafety")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.select(SCOPE):
+        # tempfile anywhere in scope is JS102 on its own: every
+        # tempfile name is nondeterministic by construction
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                names = [a.name for a in node.names]
+                mod = getattr(node, "module", None)
+                if "tempfile" in names or mod == "tempfile":
+                    findings.append(Finding(
+                        rule="JS102", severity="error", path=m.path,
+                        line=node.lineno,
+                        message="tempfile import in crash-safe scope: "
+                                "its names are nondeterministic, so a "
+                                "resumed run can neither sweep nor "
+                                "verify the artifacts — build "
+                                "deterministic job-scoped temp names "
+                                "(e.g. <final>.tmp, part-NNNNN.tmp) "
+                                "instead"))
+        for fn in _func_defs(m.tree):
+            blessed = fn.name in _BLESSED_FNS or _journals_commit(fn)
+            tainted = _tainted_locals(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_rename(node) and not blessed:
+                    findings.append(Finding(
+                        rule="JS101", severity="error", path=m.path,
+                        line=node.lineno,
+                        message=f"side-effecting publication "
+                                f"(os.{node.func.attr}) in "
+                                f"{fn.name}() outside the blessed "
+                                f"commit helpers "
+                                f"({sorted(_BLESSED_FNS)}) and without "
+                                f"a journal commit alongside it — the "
+                                f"jobs layer can neither verify nor "
+                                f"sweep what it publishes; route "
+                                f"through write/api._publish / "
+                                f"ShardedFileWriter.open_shard, or "
+                                f"journal the unit in the same "
+                                f"function"))
+                path_args: List[ast.AST] = []
+                if _is_write_open(node) and node.args:
+                    path_args.append(node.args[0])
+                if _is_rename(node):
+                    path_args.extend(node.args)
+                for arg in path_args:
+                    bad = _nondeterministic_names(arg, tainted)
+                    if bad:
+                        findings.append(Finding(
+                            rule="JS102", severity="error", path=m.path,
+                            line=node.lineno,
+                            message=f"non-idempotent temp naming: path "
+                                    f"derives from {sorted(set(bad))} "
+                                    f"— a re-run cannot recognize (or "
+                                    f"sweep) the crashed attempt's "
+                                    f"file; use a deterministic "
+                                    f"job-scoped name"))
+    return findings

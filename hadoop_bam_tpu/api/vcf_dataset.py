@@ -205,7 +205,8 @@ class VcfDataset:
                 geometry)
 
         stream = _iter_windowed(pool, spans, decode,
-                                2 * decode_pool_size(self.config))
+                                2 * decode_pool_size(self.config),
+                                config=self.config)
         # variant_feed peeks the first span's dict for the schema (same
         # genericity as the old serial tiler); fixed_shape keeps the
         # historical contract that every variant tensor batch carries

@@ -44,7 +44,8 @@ class CohortDataset:
     """
 
     def __init__(self, source: Union[str, CohortManifest, List[str]],
-                 config: HBamConfig = DEFAULT_CONFIG):
+                 config: HBamConfig = DEFAULT_CONFIG,
+                 journal_path: Optional[str] = None):
         from hadoop_bam_tpu.api.vcf_dataset import VcfDataset
         from hadoop_bam_tpu.parallel.variant_pipeline import VariantGeometry
         from hadoop_bam_tpu.resilience import file_ident, registry
@@ -54,6 +55,8 @@ class CohortDataset:
         from hadoop_bam_tpu.utils.metrics import METRICS
 
         self.config = config
+        self.journal_path = journal_path
+        self._journal_live = False     # one journaled join at a time
         self.manifest = as_manifest(source)
         quarantine = bool(getattr(config, "cohort_quarantine_inputs",
                                   True))
@@ -104,7 +107,30 @@ class CohortDataset:
     def site_chunks(self) -> Iterator[Dict[str, np.ndarray]]:
         """Stream the joined cohort as host column chunks (up to
         ``config.cohort_chunk_sites`` rows each) — the input of both the
-        mesh feed below and the serve tier's tile builder."""
+        mesh feed below and the serve tier's tile builder.
+
+        With a ``journal_path`` the join is CRASH-SAFE (jobs/): every
+        produced chunk persists to ``<journal>.chunks/chunk-NNNNN.npz``
+        and commits a journaled unit (size+CRC+last site key); a
+        resumed join replays the verified chunks from disk — identical
+        bytes, zero re-join/re-harmonize work — then continues the live
+        merge from the last committed key.  Input records are still
+        re-streamed for the continuation (a k-way merge needs its
+        cursors), so the savings are the join/harmonize/pack work and,
+        on a finished job, the entire decode.  A quarantine that was
+        caused by a TRANSIENT fault may heal on resume: the journaled
+        chunks keep their sentinel columns, the live suffix carries
+        real data — recorded as ``quarantine`` events either way."""
+        if self.journal_path is not None and self._journal_live:
+            # the guard must fire BEFORE stream construction: merely
+            # building streams resets every sample's span cursor, which
+            # would corrupt the live iteration's reads even if the
+            # journal itself were protected further down
+            from hadoop_bam_tpu.utils.errors import PlanError
+            raise PlanError(
+                f"a journaled join over {self.journal_path} is already "
+                f"in progress on this dataset — close (exhaust) the "
+                f"prior site_chunks() iterator before starting another")
         state = _JoinState(
             self.manifest.n_samples,
             float(getattr(self.config, "cohort_max_quarantine_fraction",
@@ -126,8 +152,128 @@ class CohortDataset:
             streams.append(guarded_sites(
                 sites, sample.sample_id, sample.path, self.manifest,
                 state, self.config))
-        return iter_joined_chunks(self.manifest, streams,
-                                  self.geometry.samples_pad, self.config)
+        if self.journal_path is None:
+            return iter_joined_chunks(self.manifest, streams,
+                                      self.geometry.samples_pad,
+                                      self.config)
+        return self._journaled_chunks(streams)
+
+    def _journaled_chunks(self, streams) -> Iterator[Dict[str,
+                                                          np.ndarray]]:
+        """The journal-aware wrapper around ``iter_joined_chunks``
+        (``site_chunks`` docstring): replay verified chunks, sweep the
+        in-flight chunk's debris, continue past the last committed
+        key, commit each fresh chunk before handing it downstream."""
+        import os
+
+        from hadoop_bam_tpu.jobs import journal as jj
+        from hadoop_bam_tpu.jobs.runner import COHORT_FINGERPRINT_FIELDS
+        from hadoop_bam_tpu.utils.metrics import METRICS
+
+        # reentrancy is refused at the top of site_chunks (two live
+        # journaled iterations = two writers on one journal, the exact
+        # shape replay classifies as corruption; and the second
+        # resume's sweep could unlink chunks the first just committed)
+        chunks_dir = os.path.abspath(self.journal_path) + ".chunks"
+
+        def load(u):
+            with np.load(u["path"]) as z:
+                return {kk: z[kk] for kk in ("chrom", "pos", "n_allele",
+                                             "dosage", "qual")}
+
+        def gen():
+            # EVERYTHING — journal open, lock, replay — happens lazily
+            # at first next(): a generator that is created but never
+            # started runs no body, so eager setup would leave the
+            # dataset permanently locked with an open journal fd
+            if self._journal_live:
+                from hadoop_bam_tpu.utils.errors import PlanError
+                raise PlanError(
+                    f"a journaled join over {self.journal_path} is "
+                    f"already in progress on this dataset")
+            self._journal_live = True
+            jr = None
+            try:
+                anchor, _k, digest = self.manifest.identity()
+                jr, state = jj.JobJournal.resume(
+                    self.journal_path, kind="cohort_join",
+                    inputs=[(anchor or "<inline-manifest>", digest)],
+                    output=None,
+                    fingerprint=jj.config_fingerprint(
+                        self.config, COHORT_FINGERPRINT_FIELDS),
+                    config_values=jj.fingerprint_values(
+                        self.config, COHORT_FINGERPRINT_FIELDS),
+                    params={"manifest":
+                            (os.path.abspath(self.manifest.path)
+                             if self.manifest.path else None)},
+                    fsync=bool(getattr(self.config, "journal_fsync",
+                                       True)))
+                replayed = []
+                if state is not None:
+                    while True:
+                        u = state.unit("chunk", len(replayed))
+                        if u is None or not jj.verify_artifact(
+                                u.get("path", ""), u.get("size", -1),
+                                u.get("crc", "")):
+                            break
+                        replayed.append(u)
+                    jj.sweep_unrecorded(
+                        chunks_dir, [u["path"] for u in replayed],
+                        counter="jobs.stale_chunks_swept")
+                # finished job with every chunk intact: pure replay,
+                # the input streams are never touched (zero decode)
+                replay_only = (state is not None
+                               and state.done is not None
+                               and int(state.done.get("chunks", -1))
+                               == len(replayed))
+                last_key = None
+                for u in replayed:
+                    METRICS.count("jobs.chunks_replayed")
+                    last_key = (int(u.get("key_hi", 0)),
+                                int(u.get("key_lo", 0)))
+                    yield load(u)
+                if replay_only:
+                    METRICS.count("jobs.jobs_skipped")
+                    return
+                if replayed:
+                    METRICS.count("jobs.cohort_resumes")
+                os.makedirs(chunks_dir, exist_ok=True)
+                seen_q = set(self.manifest.quarantined)
+                i = len(replayed)
+                for chunk in iter_joined_chunks(
+                        self.manifest, streams,
+                        self.geometry.samples_pad, self.config,
+                        skip_through_key=last_key):
+                    for sid in sorted(set(self.manifest.quarantined)
+                                      - seen_q):
+                        # observability, not replayed state: a
+                        # deterministic fault re-fires on resume, a
+                        # transient one heals (docstring)
+                        jr.event("quarantine", sample=sid)
+                        seen_q.add(sid)
+                    # abspath (chunks_dir is absolute): the unit record
+                    # must verify from any cwd `hbam resume` runs in
+                    path = os.path.join(chunks_dir,
+                                        f"chunk-{i:05d}.npz")
+                    np.savez(path, **chunk)
+                    size, crc = jj.file_digest(path)
+                    jr.unit_done(
+                        "chunk", i, path=path, size=size, crc=crc,
+                        sites=int(chunk["pos"].shape[0]),
+                        # the continuation key: group keys strictly
+                        # increase, so the last row's (chrom, pos) IS
+                        # the chunk's high-water mark
+                        key_hi=int(chunk["chrom"][-1]),
+                        key_lo=int(chunk["pos"][-1]))
+                    i += 1
+                    yield chunk
+                jr.job_done(chunks=i)
+            finally:
+                self._journal_live = False
+                if jr is not None:
+                    jr.close()
+
+        return gen()
 
     # -- mesh feed -----------------------------------------------------------
 
@@ -176,7 +322,9 @@ class CohortDataset:
 
 
 def open_cohort(source: Union[str, CohortManifest, List[str]],
-                config: HBamConfig = DEFAULT_CONFIG) -> CohortDataset:
+                config: HBamConfig = DEFAULT_CONFIG,
+                journal_path: Optional[str] = None) -> CohortDataset:
     """Resolve a manifest (path / object / bare path list) into the
-    cohort dataset — the cohort analog of ``api.open_vcf``."""
-    return CohortDataset(source, config)
+    cohort dataset — the cohort analog of ``api.open_vcf``.
+    ``journal_path`` makes the join crash-safe (``site_chunks``)."""
+    return CohortDataset(source, config, journal_path=journal_path)

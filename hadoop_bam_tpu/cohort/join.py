@@ -146,12 +146,22 @@ def guarded_sites(site_iter: Iterator[SampleSite], sample_id: str,
 def iter_joined_chunks(manifest: CohortManifest,
                        streams: Sequence[Iterator[SampleSite]],
                        samples_pad: int,
-                       config: HBamConfig = DEFAULT_CONFIG
+                       config: HBamConfig = DEFAULT_CONFIG,
+                       skip_through_key: Optional[Tuple[int, int]] = None
                        ) -> Iterator[Dict[str, np.ndarray]]:
     """Merge + harmonize + pack: yields column-chunk dicts of up to
     ``config.cohort_chunk_sites`` joined sites.  ``streams`` are the
     (already guarded) per-sample ``SampleSite`` iterators, in manifest
-    order — their index IS the sample column index."""
+    order — their index IS the sample column index.
+
+    ``skip_through_key`` is the journal-resume continuation point
+    (jobs/): merged site GROUPS with key <= it are dropped before
+    harmonize/pack — they are already inside replayed chunks.  Group
+    keys strictly increase and every record of a key lands in one
+    group, so a chunk boundary is always a clean key boundary and the
+    continuation reproduces the uninterrupted chunk sequence exactly
+    (the streams are still consumed — record decode is not skipped,
+    only the join/harmonize work and the chunk assembly are)."""
     k = manifest.n_samples
     chunk_sites = max(1, int(getattr(config, "cohort_chunk_sites", 1024)))
 
@@ -183,6 +193,9 @@ def iter_joined_chunks(manifest: CohortManifest,
                 if nxt is None:
                     break
                 _key, group = nxt
+                if skip_through_key is not None \
+                        and tuple(_key) <= tuple(skip_through_key):
+                    continue       # already inside a replayed chunk
                 h = harmonize_site(group, k)
                 cols["chrom"][n] = h.chrom
                 cols["pos"][n] = min(h.pos, np.iinfo(np.int32).max)

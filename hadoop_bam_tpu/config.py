@@ -124,6 +124,15 @@ _HADOOP_KEY_MAP = {
     "hbam.serve-shed-retry-after-s": "serve_shed_retry_after_s",
     "hbam.serve-prefetch-pause-pressure": "serve_prefetch_pause_pressure",
     "hbam.chaos-seed": "chaos_seed",
+    # crash-safe job knobs (jobs/; the reference's analog was MapReduce
+    # task re-execution + speculative execution, configured via
+    # mapreduce.map.maxattempts / mapreduce.map.speculative)
+    "hbam.pool-task-timeout-s": "pool_task_timeout_s",
+    "hbam.speculative-decode": "speculative_decode",
+    "hbam.straggler-multiplier": "straggler_multiplier",
+    "hbam.straggler-min-s": "straggler_min_s",
+    "hbam.collective-timeout-s": "collective_timeout_s",
+    "hbam.journal-fsync": "journal_fsync",
     # cohort variant plane knobs (cohort/; no reference analog — Hadoop-BAM
     # never joined inputs, it only split them)
     "hbam.cohort-chunk-sites": "cohort_chunk_sites",
@@ -227,6 +236,47 @@ class HBamConfig:
     #                                  schedules (tests/bench/soak);
     #                                  None = chaos only via explicit
     #                                  install_chaos / fault_points_on
+
+    # --- crash-safe jobs (jobs/: durable journals, straggler defense;
+    # the MapReduce analogs were task re-execution + speculative
+    # execution) ---
+    pool_task_timeout_s: Optional[float] = None  # hard per-future decode
+    #                                  deadline on ACTIVE wait: queue
+    #                                  time on a backlogged-but-healthy
+    #                                  pool is excused up to an 8x grace
+    #                                  (so a deep queue never false-
+    #                                  fires, but a FULLY-wedged pool
+    #                                  where nothing dequeues still
+    #                                  surfaces); an overrunning task is
+    #                                  abandoned and re-submitted once
+    #                                  per span_retries budget, then
+    #                                  raises TransientIOError — a
+    #                                  wedged worker can no longer hang
+    #                                  the consumer forever.  None = off
+    speculative_decode: bool = True  # race a second copy of a span
+    #                                  decode that outlives the job's
+    #                                  soft deadline (first result wins,
+    #                                  loser discarded); needs >= 16
+    #                                  completed units before any
+    #                                  deadline exists, so tiny runs
+    #                                  never speculate
+    straggler_multiplier: float = 4.0  # soft deadline = p95 of the
+    #                                  decaying per-job unit-latency
+    #                                  histogram x this
+    straggler_min_s: float = 0.5     # soft-deadline floor: decode storms
+    #                                  of sub-ms units must not
+    #                                  speculate on scheduler jitter
+    collective_timeout_s: Optional[float] = None  # multi-host loss
+    #                                  detection: broadcast/allgather
+    #                                  barriers outliving this surface
+    #                                  TransientIOError (one dead host
+    #                                  fails the collective fast) instead
+    #                                  of blocking forever.  None = wait
+    journal_fsync: bool = True       # fsync the job journal after every
+    #                                  record (the durability the resume
+    #                                  contract is written against);
+    #                                  False trades crash-safety of the
+    #                                  LAST unit for test speed
 
     # --- cohort variant plane (cohort/: k-way position join of
     # single-sample VCF/BCF inputs into [variants, samples] mesh tiles) ---
@@ -370,7 +420,8 @@ def _coerce(kwargs: dict) -> dict:
               "use_splitting_index", "use_native", "use_fused_decode",
               "keep_paired_reads_together", "skip_bad_spans",
               "debug_keep_spill", "serve_prefetch", "adaptive_planes",
-              "cohort_quarantine_inputs"):
+              "cohort_quarantine_inputs", "speculative_decode",
+              "journal_fsync"):
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
     for k in ("max_bad_span_fraction", "retry_backoff_base_s",
@@ -379,7 +430,9 @@ def _coerce(kwargs: dict) -> dict:
               "breaker_window_s", "breaker_cooldown_s",
               "serve_shed_retry_after_s",
               "serve_prefetch_pause_pressure",
-              "cohort_max_quarantine_fraction"):
+              "cohort_max_quarantine_fraction", "pool_task_timeout_s",
+              "straggler_multiplier", "straggler_min_s",
+              "collective_timeout_s"):
         if k in out and isinstance(out[k], str):
             out[k] = float(out[k])
     for k in ("span_retries", "io_read_retries", "feed_ring_slots",

@@ -1,0 +1,31 @@
+"""Crash-safe jobs: durable journals, resumable pipelines, stragglers.
+
+The layer every long-running pipeline inherits restartability from
+(ISSUE 13): MapReduce gave the reference task re-execution over
+idempotent, atomically-committed splits for free — a lost worker cost
+one task.  This package rebuilds that contract for the mesh pipelines:
+
+- ``journal`` — the durable job journal (append-only fsync'd JSONL,
+  checksummed lines, torn-tail-tolerant replay) plus the identity /
+  fingerprint / artifact-digest helpers the resume contract verifies;
+- ``runner`` — job-kind policy: per-kind config fingerprints, the
+  ``hbam resume`` / ``hbam jobs`` drivers, job-grain idempotence;
+- ``speculate`` — straggler defense: the decaying per-job latency
+  histogram whose p95-derived soft deadlines trigger speculative
+  re-execution of slow span decodes (first result wins).
+
+Consumers: ``parallel/mesh_sort.py`` (round-grain spill resume),
+``write/sharded.py`` (shard-grain commit/skip), ``cohort/dataset.py``
+(chunk-grain join resume), ``parallel/pipeline._iter_windowed`` (the
+speculation + hard-timeout consumer).
+"""
+from hadoop_bam_tpu.jobs.journal import (     # noqa: F401
+    JOURNAL_SUFFIX, JobJournal, JournalState, config_fingerprint,
+    file_digest, file_identity_digest, journal_path_for, plan_digest,
+    sweep_unrecorded, verify_artifact,
+)
+from hadoop_bam_tpu.jobs.runner import (      # noqa: F401
+    COHORT_FINGERPRINT_FIELDS, JobInfo, SORT_FINGERPRINT_FIELDS,
+    job_status, list_jobs, resume_job, run_job_level, sort_job_params,
+)
+from hadoop_bam_tpu.jobs.speculate import UnitLatency  # noqa: F401

@@ -1,0 +1,77 @@
+"""Straggler defense: decaying latency tracking -> soft deadlines.
+
+MapReduce's speculative execution re-ran the slowest tasks on spare
+capacity and took whichever copy finished first; that is exactly the
+right medicine for this pipeline's span decodes too (a span decode is
+idempotent and side-effect-free, so racing two copies is always safe).
+The open question is WHEN a unit is "slow".  A fixed timeout is wrong in
+both directions — too tight for a cold page cache, uselessly loose for a
+warm one — so the deadline is derived from the job's OWN latency
+distribution: a decaying ``obs/hist.py`` histogram of completed unit
+durations, with the soft deadline at ``p95 * straggler_multiplier``
+(floored at ``straggler_min_s`` so sub-millisecond decode storms never
+speculate).
+
+Decay matters because a job's latency regime shifts mid-run (cache
+warms, a fault domain demotes the decode plane): every ``decay_every``
+observations the bucket counts halve, so the deadline tracks the recent
+regime instead of the whole-run average.  The histogram needs
+``min_samples`` completions before it issues any deadline at all — the
+first units of a job carry compile/warmup noise that must not trigger a
+speculation stampede.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from hadoop_bam_tpu.obs.hist import Histogram
+
+
+class UnitLatency:
+    """Thread-safe decaying latency histogram with a soft-deadline read.
+
+    One instance per job stage (each ``_iter_windowed`` drive creates
+    its own), matching the ISSUE's "per-job latency histogram": a sort's
+    span decodes must not inherit a cohort join's distribution."""
+
+    def __init__(self, *, multiplier: float = 4.0, min_s: float = 0.5,
+                 min_samples: int = 16, decay_every: int = 256):
+        self.multiplier = float(multiplier)
+        self.min_s = float(min_s)
+        self.min_samples = int(min_samples)
+        self.decay_every = max(2, int(decay_every))
+        self.hist = Histogram()
+        self._seen = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, config) -> "UnitLatency":
+        return cls(
+            multiplier=float(getattr(config, "straggler_multiplier", 4.0)),
+            min_s=float(getattr(config, "straggler_min_s", 0.5)))
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.hist.record(max(float(seconds), 0.0))
+            self._seen += 1
+            if self._seen % self.decay_every == 0:
+                self._decay()
+
+    def _decay(self) -> None:
+        # halve every bucket (dropping emptied ones) so the deadline
+        # follows the RECENT latency regime; min/max stay as observed
+        # extremes (they only clamp percentile reads)
+        h = self.hist
+        h.buckets = {i: n // 2 for i, n in h.buckets.items() if n // 2}
+        h.count = sum(h.buckets.values())
+        h.total /= 2.0
+
+    def soft_deadline_s(self) -> Optional[float]:
+        """Seconds a unit may run before it counts as a straggler; None
+        until enough completions have been observed."""
+        with self._lock:
+            if self._seen < self.min_samples or not self.hist.count:
+                return None
+            return max(self.min_s, self.hist.percentile(95)
+                       * self.multiplier)

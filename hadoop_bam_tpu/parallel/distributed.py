@@ -101,6 +101,7 @@ def _run_collective(fn: Callable[[], object], what: str,
     ``timeout_s=None`` transient failures fail fast (the pre-resilience
     behavior) and the retry budget is ignored."""
     import threading
+    import time as _time
 
     if timeout_s is None:
         retries = 0
@@ -118,8 +119,22 @@ def _run_collective(fn: Callable[[], object], what: str,
 
         t = threading.Thread(target=runner, daemon=True,
                              name=f"collective:{what}")
+        t0 = _time.perf_counter()
         t.start()
-        t.join(timeout_s)
+        # heartbeat-stamped wait: join in bounded slices, stamping a
+        # liveness counter each wake, so an operator watching the
+        # metrics stream can tell "still waiting on a peer" (heartbeats
+        # advancing) from "this process is itself wedged" (no stamps) —
+        # and the wait distribution lands in a mergeable histogram
+        deadline = t0 + timeout_s
+        while t.is_alive():
+            remaining = deadline - _time.perf_counter()
+            if remaining <= 0:
+                break
+            t.join(min(1.0, remaining))
+            METRICS.count("distributed.heartbeats")
+        METRICS.observe("distributed.collective_wait_s",
+                        _time.perf_counter() - t0)
         if t.is_alive():
             raise _CollectiveTimeout
         if "error" in box:
@@ -145,6 +160,32 @@ def _run_collective(fn: Callable[[], object], what: str,
                            retries + 1, d, e)
             policy.sleep(d)
     raise AssertionError("unreachable")  # loop always returns or raises
+
+
+def collective_timeout(config) -> Optional[float]:
+    """The config's multi-host loss-detection budget
+    (``collective_timeout_s``): how long any barrier/allgather may block
+    before a dead peer surfaces as classified ``TransientIOError``
+    instead of hanging the survivors forever.  None (the default) keeps
+    the pre-jobs unbounded-wait behavior."""
+    t = getattr(config, "collective_timeout_s", None) \
+        if config is not None else None
+    return float(t) if t else None
+
+
+def guarded_allgather(arr: np.ndarray, what: str,
+                      timeout_s: Optional[float] = None) -> np.ndarray:
+    """``process_allgather`` under the classified timeout/heartbeat
+    wrapper — the one helper every barrier-shaped collective in the
+    mesh pipelines routes through (mesh_sort's round/merge flags, the
+    spill-round geometry agreement), so one dead host fails the
+    collective fast everywhere instead of wherever someone remembered
+    to wrap it."""
+    from jax.experimental import multihost_utils
+
+    return _run_collective(
+        lambda: np.asarray(multihost_utils.process_allgather(arr)),
+        what, timeout_s=timeout_s)
 
 
 def broadcast_plan(spans: Optional[Sequence],
@@ -313,8 +354,8 @@ def assign_spans(spans: Sequence[FileVirtualSpan],
     return out
 
 
-def _multihost_reduce(plan_builder, local_reducer, payload_len: int
-                      ) -> np.ndarray:
+def _multihost_reduce(plan_builder, local_reducer, payload_len: int,
+                      timeout_s: Optional[float] = None) -> np.ndarray:
     """Shared scaffold of the multi-host stat drivers.
 
     The reference shape (SURVEY.md sections 2.9/3.2): client-side
@@ -328,9 +369,12 @@ def _multihost_reduce(plan_builder, local_reducer, payload_len: int
     reaches its collective and ships an ok/failed flag instead.
     Counters travel as float64 — exact up to 2^53, far beyond any
     record count here.  Returns the (n_hosts, payload_len) matrix.
-    """
-    from jax.experimental import multihost_utils
 
+    ``timeout_s`` (``config.collective_timeout_s`` at the drivers):
+    every flag/row allgather runs under the heartbeat-stamped timeout,
+    so one dead host fails the whole reduce with classified
+    ``TransientIOError`` instead of hanging the survivors.
+    """
     plan = None
     err = None
     if jax.process_index() == 0:
@@ -340,13 +384,14 @@ def _multihost_reduce(plan_builder, local_reducer, payload_len: int
         except Exception as e:  # noqa: BLE001 — must reach the collective
             err = e
     ok = np.asarray([0 if err is not None else 1], np.int32)
-    g_ok = np.asarray(multihost_utils.process_allgather(ok))
+    g_ok = guarded_allgather(ok, "distributed reduce: plan flag",
+                             timeout_s=timeout_s)
     if err is not None:
         raise err
     if int(g_ok.min()) == 0:
         raise RuntimeError("distributed reduce: span planning failed on "
                            "host 0")
-    mine = assign_spans(broadcast_plan(plan))
+    mine = assign_spans(broadcast_plan(plan, timeout_s=timeout_s))
     row = np.zeros(1 + payload_len, np.float64)
     try:
         row[1:] = local_reducer(mine)
@@ -354,7 +399,8 @@ def _multihost_reduce(plan_builder, local_reducer, payload_len: int
     except Exception as e:  # noqa: BLE001 — must reach the collective
         err = e
         row[:] = 0.0
-    g = np.asarray(multihost_utils.process_allgather(row))
+    g = guarded_allgather(row, "distributed reduce: result rows",
+                          timeout_s=timeout_s)
     if err is not None:
         raise err
     if (g[:, 0] < 1).any():
@@ -402,7 +448,9 @@ def distributed_flagstat(path: str, config=None, header=None):
                               quarantine=quarantine)
         return np.asarray([stats[k] for k in FLAGSTAT_FIELDS], np.float64)
 
-    tot = _multihost_reduce(plan, local, len(FLAGSTAT_FIELDS)).sum(axis=0)
+    tot = _multihost_reduce(plan, local, len(FLAGSTAT_FIELDS),
+                            timeout_s=collective_timeout(config)
+                            ).sum(axis=0)
     out = {k: int(v) for k, v in zip(FLAGSTAT_FIELDS, tot)}
     # reduce-side manifest merge: every host reports the same union of
     # skipped spans (runs as its own collective AFTER the stat reduce, in
@@ -441,8 +489,9 @@ def distributed_seq_stats(path: str, config=None, header=None,
             path, mesh=_local_mesh(), config=config, header=header,
             spans=mine, geometry=geometry, quarantine=quarantine))
 
-    out = _combine_seq_stats(
-        _multihost_reduce(plan, local, 3 + N_CODES))
+    out = _combine_seq_stats(_multihost_reduce(
+        plan, local, 3 + N_CODES,
+        timeout_s=collective_timeout(config)))
     from hadoop_bam_tpu.parallel.pipeline import _attach_quarantine
     return _attach_quarantine(out, merge_quarantine_manifests(quarantine))
 
@@ -489,8 +538,9 @@ def distributed_fastq_seq_stats(path: str, config=None, geometry=None):
             path, mesh=_local_mesh(), config=config, geometry=geometry,
             spans=mine))
 
-    return _combine_seq_stats(
-        _multihost_reduce(plan, local, 3 + N_CODES))
+    return _combine_seq_stats(_multihost_reduce(
+        plan, local, 3 + N_CODES,
+        timeout_s=collective_timeout(config)))
 
 
 def distributed_cram_seq_stats(path: str, config=None, geometry=None):
@@ -516,8 +566,9 @@ def distributed_cram_seq_stats(path: str, config=None, geometry=None):
             path, mesh=_local_mesh(), config=config, geometry=geometry,
             spans=mine))
 
-    return _combine_seq_stats(
-        _multihost_reduce(plan, local, 3 + N_CODES))
+    return _combine_seq_stats(_multihost_reduce(
+        plan, local, 3 + N_CODES,
+        timeout_s=collective_timeout(config)))
 
 
 def distributed_variant_stats(path: str, config=None, header=None):
@@ -551,7 +602,8 @@ def distributed_variant_stats(path: str, config=None, header=None):
              s["mean_af"] * s["n_af"]],
             np.asarray(s["sample_callrate"], np.float64) * nv])
 
-    g = _multihost_reduce(plan, local, 5 + n_samples).sum(axis=0)
+    g = _multihost_reduce(plan, local, 5 + n_samples,
+                          timeout_s=collective_timeout(config)).sum(axis=0)
     nv = int(g[0])
     return {"n_variants": nv, "n_snp": int(g[1]), "n_pass": int(g[2]),
             "mean_af": float(g[4] / max(g[3], 1.0)), "n_af": int(g[3]),
@@ -613,7 +665,8 @@ def distributed_coverage(path: str, region, config=None, header=None,
                               max_cigar=max_cigar)
         return np.asarray(depth, np.float64)
 
-    g = _multihost_reduce(plan, local, window).sum(axis=0)
+    g = _multihost_reduce(plan, local, window,
+                          timeout_s=collective_timeout(config)).sum(axis=0)
     return g.astype(np.int32)
 
 

@@ -1020,9 +1020,20 @@ def decode_with_retry(fn: Callable, span: FileVirtualSpan,
     raise last
 
 
+# how long a QUEUED candidate's hard-timeout anchor is held, as a
+# multiple of pool_task_timeout_s: long enough that a backlogged-but-
+# healthy pool (queue waits of a few task durations) never false-fires,
+# short enough that a fully-wedged pool — where re-submissions can
+# never dequeue — still exhausts the budget and surfaces as
+# TransientIOError instead of hanging forever
+_QUEUED_GRACE = 8.0
+
+
 def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
                    fn: Callable, window: int,
-                   cleanup: Optional[Callable] = None) -> Iterator:
+                   cleanup: Optional[Callable] = None,
+                   config: Optional[HBamConfig] = None,
+                   what: str = "span decode") -> Iterator:
     """Submit ``fn(item)`` to the pool with bounded in-flight futures and
     yield results in order.  Bounds host memory: at most ``window`` decoded
     spans exist at once (a plain list of futures would retain every span's
@@ -1034,18 +1045,55 @@ def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
     keep running to completion for nothing.  ``cleanup`` is called on
     results that already materialized but will never be yielded (the fused
     chunk streams hold live native jobs — closing them joins the workers
-    instead of leaving that to GC)."""
+    instead of leaving that to GC).
+
+    With a ``config``, the consumer grows the straggler + hang defense
+    (jobs/speculate.py):
+
+    - **speculation** (``config.speculative_decode``): a unit outliving
+      the job's soft deadline — p95 of a decaying per-job latency
+      histogram x ``straggler_multiplier`` — gets a second copy raced on
+      the pool; the FIRST result wins and the loser is cancelled or
+      reaped through ``cleanup`` (``jobs.speculative_launched`` /
+      ``jobs.speculative_won``).  Safe because ``fn`` is an idempotent,
+      side-effect-free span decode — the MapReduce speculative-execution
+      contract.
+    - **hard timeout** (``config.pool_task_timeout_s``): a future
+      outliving it is abandoned (a wedged worker thread cannot be
+      killed, only orphaned) and the item re-submitted, once per
+      ``span_retries``; exhaustion surfaces ``TransientIOError`` into
+      the caller's existing retry/breaker machinery instead of blocking
+      forever (``pool.task_timeouts`` / ``jobs.timeout_resubmits``).
+      The deadline covers ACTIVE wait on a runnable task — time spent
+      queued behind a backlogged-but-healthy pool, or running
+      overlapped before the consumer reached this entry, does not
+      count (see ``_await``'s two-clock note).
+
+    Without a config (or with both knobs off before any soft deadline
+    exists) the await path is the plain blocking ``Future.result()``.
+    """
     from collections import deque
 
     from hadoop_bam_tpu.utils.resilient import call_with_retry
 
     it = iter(items)
-    dq: "deque[cf.Future]" = deque()
+    dq: "deque[list]" = deque()        # entries: [item, fut, t0, spec'd]
     # transient SUBMISSION failures (a saturated executor, an injected
     # pool.submit chaos fault) retry briefly instead of killing the
     # whole driver run — the task itself has its own failure policy
     submit_policy = RetryPolicy(retries=3, backoff_base_s=0.01,
                                 backoff_max_s=0.1)
+
+    timeout_s = getattr(config, "pool_task_timeout_s", None) \
+        if config is not None else None
+    timeout_s = float(timeout_s) if timeout_s else None
+    max_resubmits = int(getattr(config, "span_retries", 2) or 0) \
+        if timeout_s is not None else 0
+    latency = None
+    if config is not None and bool(getattr(config, "speculative_decode",
+                                           True)):
+        from hadoop_bam_tpu.jobs.speculate import UnitLatency
+        latency = UnitLatency.from_config(config)
 
     def _submit(item) -> cf.Future:
         # pools.submit, not pool.submit: the task carries the caller's
@@ -1055,32 +1103,144 @@ def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
                                submit_policy, what="decode pool submit",
                                counter="pool.submit_retries")
 
+    def _reap(f: cf.Future) -> None:
+        # done-callback: covers futures already finished AND ones
+        # still running at teardown (fires on the worker thread when
+        # they complete) without blocking this thread on .result()
+        if f.cancelled():
+            return
+        try:
+            cleanup(f.result())
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+
+    def _abandon(f: cf.Future) -> None:
+        if not f.cancel() and cleanup is not None:
+            f.add_done_callback(_reap)
+
+    def _await(entry) -> object:
+        """Resolve one entry under the defense policy (docstring)."""
+        if timeout_s is None and latency is None:
+            return entry[1].result()           # undefended fast path
+        # candidates: [future, deadline anchor, is_speculative, submit
+        # stamp]; the primary plus at most one speculative twin plus
+        # timeout re-submissions.  Two clocks on purpose:
+        # - the DEADLINE anchor starts when this await begins (a decode
+        #   that ran overlapped while earlier entries were consumed is
+        #   not "stuck") and is refreshed while the future is still
+        #   queued — otherwise a healthy-but-backlogged pool would burn
+        #   the hard-timeout budget on queue wait (re-submissions land
+        #   at the back of the same queue) and the soft deadline would
+        #   speculate on tasks that never started (a twin queued behind
+        #   the original can only lose);
+        # - the SUBMIT stamp feeds the latency histogram: turnaround,
+        #   which can only over-estimate, keeps the p95-derived soft
+        #   deadline conservative.
+        now = time.perf_counter()
+        # fields: [future, deadline anchor, is_spec, submit stamp,
+        # first-observed-queued stamp (None until seen pending)]
+        cands = [[entry[1], now, False, entry[2], None]]
+        resubmits = 0
+        while True:
+            for c in list(cands):
+                if not c[0].done():
+                    continue
+                try:
+                    out = c[0].result()
+                except Exception:  # noqa: BLE001 — policy boundary
+                    # one copy failing while another runs must not kill
+                    # the race — keep waiting on the survivor; but when
+                    # the last candidate FAILS (vs times out), raise:
+                    # the decode genuinely ran and failed, its own
+                    # retry policy is spent, and burning the timeout
+                    # re-submission budget on a known-failing span
+                    # would just duplicate the failure
+                    cands.remove(c)
+                    if not cands:
+                        raise
+                    continue
+                if latency is not None:
+                    latency.observe(time.perf_counter() - c[3])
+                if c[2]:
+                    METRICS.count("jobs.speculative_won")
+                for o in cands:
+                    if o is not c:
+                        _abandon(o[0])
+                return out
+            now = time.perf_counter()
+            for c in cands:
+                if not c[0].running() and not c[0].done():
+                    if c[4] is None:
+                        c[4] = now
+                    # still queued: hold the deadline anchor — but only
+                    # within a bounded grace.  Unbounded holding would
+                    # make a FULLY-wedged pool (every worker stuck, so
+                    # re-submissions never dequeue) immortal — the
+                    # exact forever-hang this knob exists to end; a
+                    # merely-backlogged pool drains within the grace
+                    if timeout_s is None or \
+                            now - c[4] <= timeout_s * _QUEUED_GRACE:
+                        c[1] = now
+            if timeout_s is not None:
+                for c in list(cands):
+                    if now - c[1] > timeout_s:
+                        METRICS.count("pool.task_timeouts")
+                        _abandon(c[0])
+                        cands.remove(c)
+            if not cands:
+                if resubmits >= max_resubmits:
+                    from hadoop_bam_tpu.utils.errors import (
+                        TransientIOError,
+                    )
+                    raise TransientIOError(
+                        f"{what} exceeded the {timeout_s:g}s "
+                        f"pool_task_timeout_s deadline "
+                        f"{resubmits + 1} time(s) — worker(s) presumed "
+                        f"wedged") from None
+                resubmits += 1
+                METRICS.count("jobs.timeout_resubmits")
+                t = time.perf_counter()
+                cands.append([_submit(entry[0]), t, False, t, None])
+                now = time.perf_counter()
+            soft = latency.soft_deadline_s() if latency is not None \
+                else None
+            if soft is not None and not entry[3] and len(cands) == 1 \
+                    and now - cands[0][1] > soft:
+                entry[3] = True
+                METRICS.count("jobs.speculative_launched")
+                t = time.perf_counter()
+                cands.append([_submit(entry[0]), t, True, t, None])
+            # sleep until the nearest deadline (or a coarse slice that
+            # keeps the undeadlined wait cheap), woken early by any
+            # candidate completing
+            waits = [0.25]
+            if timeout_s is not None:
+                waits += [c[1] + timeout_s - now for c in cands]
+            if soft is not None and not entry[3]:
+                waits += [cands[0][1] + soft - now]
+            elif latency is not None and soft is None:
+                waits += [float(latency.min_s)]
+            cf.wait([c[0] for c in cands],
+                    timeout=max(0.005, min(waits)),
+                    return_when=cf.FIRST_COMPLETED)
+
     try:
+        # entries: [item, future, submit stamp, speculated?] — the stamp
+        # feeds latency.observe in _await (the straggler histogram)
         for item in it:
-            dq.append(_submit(item))
+            dq.append([item, _submit(item), time.perf_counter(), False])
             if len(dq) >= window:
                 break
         while dq:
-            fut = dq.popleft()
+            entry = dq.popleft()
             for item in it:
-                dq.append(_submit(item))
+                dq.append([item, _submit(item), time.perf_counter(),
+                           False])
                 break
-            yield fut.result()
+            yield _await(entry)
     finally:
-        def _reap(f: cf.Future) -> None:
-            # done-callback: covers futures already finished AND ones
-            # still running at teardown (fires on the worker thread when
-            # they complete) without blocking this thread on .result()
-            if f.cancelled():
-                return
-            try:
-                cleanup(f.result())
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
-
-        for fut in dq:
-            if not fut.cancel() and cleanup is not None:
-                fut.add_done_callback(_reap)
+        for entry in dq:
+            _abandon(entry[1])
 
 
 def _iter_prefix_tiles(row_arrays, cap: int, row_bytes: int = PREFIX
@@ -1275,7 +1435,7 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
 
     stream = _flatten_span_stream(
         _iter_windowed(pool, spans, decode, window,
-                       cleanup=_close_stream))
+                       cleanup=_close_stream, config=config))
     # balance=True only for psum'd stats consumers (seq_stats_file);
     # tensor_batches keeps the serial row placement, so public batches
     # stay byte-stable across releases
@@ -1449,7 +1609,7 @@ def stream_read_tensor_batches(spans, read_span_fn, config: HBamConfig,
             np.empty((0,), np.int32))
 
     stream = _iter_windowed(pool, spans, decode,
-                            2 * decode_pool_size(config))
+                            2 * decode_pool_size(config), config=config)
     specs = (geometry.seq_stride, geometry.qual_stride, (None, np.int32))
     fp = FeedPipeline(n_dev, cap, specs, block_n=geometry.block_n,
                       fixed_shape=geometry.fixed_shape, config=config,
@@ -1640,7 +1800,7 @@ def fastq_seq_stats_file(path: str, mesh: Optional[Mesh] = None,
             np.empty((0, geometry.qual_stride), np.uint8),
             np.empty((0,), np.int32))
 
-    stream = _iter_windowed(pool, spans, decode, window)
+    stream = _iter_windowed(pool, spans, decode, window, config=config)
     # the shared feed: in-place ring packing replaces the old per-group
     # np.stack of freshly zero-padded shards, and each device only pays
     # copy work for its own rows (the per-device bucket-cap behavior the
@@ -2024,7 +2184,8 @@ def _flagstat_device_plane(path: str, mesh: Mesh, config: HBamConfig,
 
     group: List[_TokenChunk] = []
     try:
-        for chunk in _iter_windowed(pool, spans, decode, window):
+        for chunk in _iter_windowed(pool, spans, decode, window,
+                                    config=config):
             if chunk is None:
                 continue
             group.append(chunk)
@@ -2274,7 +2435,7 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     def row_stream():
         return _flatten_span_stream(
             _iter_windowed(pool, spans, decode, window,
-                           cleanup=_close_stream))
+                           cleanup=_close_stream, config=config))
     # Ring-staged groups + NO blocking between dispatches: the packer
     # thread writes rows straight into a leased [n_dev, cap, row] slot
     # (no per-group allocation, no np.stack, no pad memset) while THIS
@@ -2509,7 +2670,8 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
                                                     np.uint8)
 
     stream = _iter_windowed(pool, spans, decode,
-                            max(1, prefetch) * decode_pool_size(config))
+                            max(1, prefetch) * decode_pool_size(config),
+                            config=config)
     # full-width ring tiles; dispatch slices each group down to its real
     # pow2-bucketed op width before it crosses the link (fixed_shape:
     # the HEIGHT never shrinks — the step is cached per (window, mc))

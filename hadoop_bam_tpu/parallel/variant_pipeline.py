@@ -582,7 +582,7 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
             return out
         return pack_variant_tiles(VariantBatch([], header), geometry)
 
-    stream = _iter_windowed(pool, spans, decode, window)
+    stream = _iter_windowed(pool, spans, decode, window, config=config)
     # ring-fed groups (variant_feed peeks the schema): rows write in
     # place, a skewed device no longer makes the other seven copy its
     # padding, and the balanced FINAL group spreads over all shards and

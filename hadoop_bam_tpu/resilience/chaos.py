@@ -13,6 +13,9 @@ discipline from ``utils/seekable.py``):
 point                     instrumented at
 ========================  =================================================
 ``pool.submit``           ``utils/pools.submit`` (task submission)
+``pool.task``             ``utils/pools._timed_task`` (the WORKER
+                          thread, before the task body — a "delay"
+                          fault here wedges a worker mid-task)
 ``decode.native``         the ladder-aware span decode closures
                           (``parallel/pipeline.py``), native rung only
 ``device.step``           ``_flagstat_device_plane`` dispatch (the
@@ -45,8 +48,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 from hadoop_bam_tpu.utils.errors import CorruptDataError, TransientIOError
 from hadoop_bam_tpu.utils.metrics import METRICS
 
-KNOWN_POINTS = ("pool.submit", "decode.native", "device.step",
-                "write.deflate", "serve.transport")
+KNOWN_POINTS = ("pool.submit", "pool.task", "decode.native",
+                "device.step", "write.deflate", "serve.transport")
 
 FAULT_KINDS = ("transient", "corrupt", "disconnect", "delay")
 

@@ -453,10 +453,27 @@ def _write_config(args):
         if overrides else DEFAULT_CONFIG
 
 
+def _journal_arg(args, default_path: str) -> Optional[str]:
+    """Resolve a ``--journal [PATH]`` flag: absent -> None, bare flag ->
+    the job's default sibling journal, explicit value -> that path."""
+    j = getattr(args, "journal", None)
+    if j is None:
+        return None
+    return default_path if j == "" else j
+
+
 def cmd_sort(args) -> int:
     if args.run_records is not None and args.run_records <= 0:
         raise SystemExit("--run-records must be positive")
     cfg = _write_config(args)
+    journal = None
+    if getattr(args, "journal", None) is not None:
+        if not args.mesh:
+            raise SystemExit("--journal requires --mesh (the spill-merge "
+                             "sort is not journaled; its runs are "
+                             "process-local temps)")
+        from hadoop_bam_tpu.jobs import journal_path_for
+        journal = _journal_arg(args, journal_path_for(args.output))
     if args.mesh:
         if args.by_name:
             raise SystemExit(
@@ -468,9 +485,12 @@ def cmd_sort(args) -> int:
         # device per round (the MR shuffle's spill).  Output rides the
         # write/ subsystem: pooled deflate + co-written index sidecars
         n = sort_bam_mesh(args.input, args.output, exchange=args.exchange,
-                          round_records=args.run_records, config=cfg)
+                          round_records=args.run_records, config=cfg,
+                          journal_path=journal)
         mode = "mesh spill" if args.run_records is not None else "mesh"
-        print(f"wrote {args.output} ({n} records, coordinate, {mode})")
+        extra = f", journal {journal}" if journal else ""
+        print(f"wrote {args.output} ({n} records, coordinate, {mode}"
+              f"{extra})")
         return 0
     if args.exchange is not None:
         raise SystemExit("--exchange only applies to --mesh")
@@ -699,7 +719,11 @@ def cmd_cohort(args) -> int:
     from hadoop_bam_tpu.cohort import GWAS_COLUMNS, CohortDataset
 
     _start_obs(args)
-    ds = CohortDataset(args.manifest)
+    journal = None
+    if getattr(args, "journal", None) is not None:
+        from hadoop_bam_tpu.jobs import JOURNAL_SUFFIX
+        journal = _journal_arg(args, args.manifest + JOURNAL_SUFFIX)
+    ds = CohortDataset(args.manifest, journal_path=journal)
     pheno = None
     if args.pheno:
         # one float per manifest sample, in manifest order; 'nan' (or
@@ -756,6 +780,53 @@ def cmd_cohort(args) -> int:
                     + [f"{float(res[c][r]):.6g}" for c in cols]) + "\n")
         print(f"wrote {args.tsv} ({n} variants)", file=sys.stderr)
     _finish_obs(args)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# resume / jobs (crash-safe job layer, jobs/)
+# ---------------------------------------------------------------------------
+
+def cmd_resume(args) -> int:
+    """Resume (or verify) the job a journal describes: re-invokes the
+    journaled pipeline, which replays the journal, verifies every
+    recorded artifact, skips the completed units, and re-runs only the
+    remainder.  Identity/fingerprint/plan mismatches refuse loudly
+    (PlanError) rather than publish a silently-wrong output."""
+    from hadoop_bam_tpu.jobs import resume_job
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
+    _start_obs(args)
+    out = resume_job(args.journal)
+    for k in sorted(out):
+        v = out[k]
+        if v is not None:
+            print(f"{k}\t{v}")
+    for c in ("jobs.rounds_skipped", "jobs.spans_skipped",
+              "jobs.shards_skipped", "jobs.chunks_replayed",
+              "jobs.jobs_skipped", "jobs.stale_runs_swept",
+              "jobs.stale_chunks_swept", "write.stale_temps_swept"):
+        n = METRICS.counters.get(c, 0)
+        if n:
+            print(f"{c}\t{n}")
+    _finish_obs(args)
+    return 0
+
+
+def cmd_jobs(args) -> int:
+    """List job journals in a directory: kind, status (done / resumable
+    / fresh / corrupt), committed units, output."""
+    from hadoop_bam_tpu.jobs import job_status, list_jobs
+
+    infos = [job_status(p) for p in args.journals] if args.journals \
+        else list_jobs(args.dir)
+    if not infos:
+        print(f"no *.hbam-journal files in {args.dir}")
+        return 0
+    for i in infos:
+        detail = f"\t[{i.detail}]" if i.detail else ""
+        print(f"{i.path}\t{i.kind}\t{i.status}\tunits={i.units}"
+              f"\t{i.output or '-'}{detail}")
     return 0
 
 
@@ -849,6 +920,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip the BAI + splitting-index sidecars the "
                          "write path co-writes with coordinate-sorted "
                          "output (-n output is never indexed)")
+    so.add_argument("--journal", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="crash-safe run (--mesh only): record job "
+                         "identity + per-round spill commits to an "
+                         "fsync'd journal (default PATH: "
+                         "<output>.hbam-journal) so a killed run "
+                         "resumes via `hbam resume` — spill mode "
+                         "(--run-records) resumes at round grain, "
+                         "resident modes at job grain")
     so.set_defaults(fn=cmd_sort, uses_device=False)
 
     cov = sub.add_parser("coverage",
@@ -970,8 +1050,32 @@ def build_parser() -> argparse.ArgumentParser:
                          "score-test association column")
     ch.add_argument("--tsv", default=None, metavar="FILE",
                     help="write the per-variant stats table")
+    ch.add_argument("--journal", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="crash-safe join: persist every joined chunk + "
+                         "an fsync'd journal (default PATH: "
+                         "<manifest>.hbam-journal); a killed join "
+                         "resumes via `hbam resume`, replaying the "
+                         "committed chunks instead of re-joining them")
     _add_obs_flags(ch)
     ch.set_defaults(fn=cmd_cohort, uses_device=True)
+
+    rs = sub.add_parser(
+        "resume",
+        help="resume (or verify) a journaled job after a crash")
+    rs.add_argument("journal", help="the job's .hbam-journal file")
+    _add_obs_flags(rs)
+    rs.set_defaults(fn=cmd_resume, uses_device=True)
+
+    jb = sub.add_parser(
+        "jobs", help="list job journals (kind, status, committed units)")
+    jb.add_argument("dir", nargs="?", default=".",
+                    help="directory to scan for *.hbam-journal files")
+    jb.add_argument("--journal", dest="journals", action="append",
+                    default=None, metavar="PATH",
+                    help="inspect specific journal file(s) instead of "
+                         "scanning a directory")
+    jb.set_defaults(fn=cmd_jobs, uses_device=False)
 
     vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos) "
                                          "(external spill-merge)")

@@ -69,10 +69,44 @@ def _timed_task(fn, t_submit: float, args, kwargs):
     # signal (a deep wait distribution means the pool, not the device,
     # is the bottleneck) — a flat timer cannot show that
     m.observe("pool.task_wait_s", t0 - t_submit)
+    # chaos point ON THE WORKER thread (pool.submit fires on the
+    # submitter's): a "delay" fault here wedges a worker mid-task —
+    # the exact hang shape the per-future timeout exists to surface
+    chaos.fire("pool.task")
     try:
         return fn(*args, **kwargs)
     finally:
         m.observe("pool.task_run_s", time.perf_counter() - t0)
+
+
+def result_with_timeout(fut: cf.Future, timeout_s: Optional[float],
+                        what: str = "pool task"):
+    """``fut.result()`` with a hard deadline, classified.
+
+    A worker that never returns — an injected ``pool.task`` wedge, a
+    kernel pread stuck on a dead NFS server — used to hang the consumer
+    forever; the timeout converts it into ``TransientIOError`` so the
+    caller's retry/breaker machinery (re-submit, quarantine, abort) gets
+    to decide instead of the job just freezing.  The wedged THREAD is
+    not recoverable (Python cannot kill it) — the caller abandons the
+    future and the thread rejoins the pool if/when it unwedges.
+
+    This is the standalone single-future primitive; the windowed span
+    consumer (``parallel/pipeline._iter_windowed``) implements the same
+    policy inline because it races speculative twins and re-submits —
+    the ``pool.task_timeouts`` counter and TRANSIENT classification
+    must stay in sync between the two."""
+    try:
+        return fut.result(timeout=timeout_s)
+    except cf.TimeoutError:
+        from hadoop_bam_tpu.utils.errors import TransientIOError
+        from hadoop_bam_tpu.utils.metrics import METRICS
+        METRICS.count("pool.task_timeouts")
+        fut.cancel()
+        raise TransientIOError(
+            f"{what} exceeded the {timeout_s:g}s pool_task_timeout_s "
+            f"deadline — worker presumed wedged, abandoning the "
+            f"future") from None
 
 
 def submit(pool: cf.ThreadPoolExecutor, fn, *args,

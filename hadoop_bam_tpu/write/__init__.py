@@ -23,4 +23,6 @@ from hadoop_bam_tpu.write.indexing import (       # noqa: F401
 from hadoop_bam_tpu.write.parallel_bgzf import (  # noqa: F401
     ParallelBGZFWriter,
 )
-from hadoop_bam_tpu.write.sharded import ShardedFileWriter  # noqa: F401
+from hadoop_bam_tpu.write.sharded import (        # noqa: F401
+    ShardedFileWriter, write_shards_journaled,
+)

@@ -18,38 +18,101 @@ both enforced here so no caller can get them wrong:
 The shard directory lives next to the final path (``<final><suffix>``)
 — on a shared filesystem that is exactly the property multi-host needs
 (every host writes into the same directory host 0 reads).
+
+Crash recovery (jobs/): the deterministic ``part-NNNNN`` names are what
+make shard writes resumable — a journal (``jobs/journal.py``) records
+each committed part's size + CRC, ``shard_committed`` verifies a part
+against that record so a resumed run skips rewriting it, and
+``sweep_stale_temps`` removes the ``*.tmp`` orphans of the write that
+was in flight when the previous run died (they would otherwise leak
+forever; a colliding name is harmless — ``open`` truncates — but a
+crashed run's temps squatting in the directory are exactly the
+plausible-looking garbage the ``.tmp`` discipline exists to fence off).
 """
 from __future__ import annotations
 
 import contextlib
 import os
 import shutil
-from typing import Callable, Iterator, List, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence
+
+from hadoop_bam_tpu.utils.metrics import METRICS
 
 
 class ShardedFileWriter:
-    """Per-shard temp files + ordered concatenation (module docstring)."""
+    """Per-shard temp files + ordered concatenation (module docstring).
+
+    ``journal`` (a ``jobs.journal.JobJournal``) makes commits durable:
+    every renamed part appends a verified ``("shard", k)`` unit;
+    ``resume_state`` (the replayed ``JournalState`` of a prior attempt)
+    lets ``shard_committed`` skip parts that prior attempt finished."""
 
     def __init__(self, final_path: str, n_shards: int, *,
-                 dir_suffix: str = ".hbam-shards"):
+                 dir_suffix: str = ".hbam-shards",
+                 journal=None, resume_state=None):
         self.final_path = final_path
         self.n_shards = int(n_shards)
         self.shard_dir = final_path + dir_suffix
+        self.journal = journal
+        self.resume_state = resume_state
 
     # -- shard side (every host) --------------------------------------------
 
     def prepare(self) -> None:
-        """Remove stale parts from an earlier failed run.  Call on ONE
-        host, BEFORE the barrier that precedes any shard write."""
+        """Remove stale parts from an earlier failed run (sweeping —
+        and counting — its orphaned temps first).  Call on ONE host,
+        BEFORE the barrier that precedes any shard write."""
+        self.sweep_stale_temps()
         shutil.rmtree(self.shard_dir, ignore_errors=True)
+
+    def sweep_stale_temps(self) -> int:
+        """Unlink ``*.tmp`` orphans a crashed previous run left in the
+        shard directory; returns the count (also reported via the
+        ``write.stale_temps_swept`` counter).  Resume paths call this
+        INSTEAD of ``prepare`` — committed parts must survive, only the
+        in-flight write's debris goes."""
+        swept = 0
+        try:
+            names = os.listdir(self.shard_dir)
+        except OSError:
+            return 0
+        for name in names:
+            if not name.endswith(".tmp"):
+                continue
+            with contextlib.suppress(OSError):
+                os.unlink(os.path.join(self.shard_dir, name))
+                swept += 1
+        if swept:
+            METRICS.count("write.stale_temps_swept", swept)
+        return swept
 
     def shard_path(self, k: int) -> str:
         return os.path.join(self.shard_dir, f"part-{k:05d}")
 
+    def shard_committed(self, k: int) -> bool:
+        """True iff a prior attempt's journal committed shard ``k`` AND
+        the part file on disk still matches the recorded size + CRC —
+        verification, not trust: a part the crash corrupted (or a
+        filesystem that lost the rename) re-writes."""
+        if self.resume_state is None:
+            return False
+        from hadoop_bam_tpu.jobs.journal import verify_artifact
+
+        unit = self.resume_state.unit("shard", k)
+        if unit is None:
+            return False
+        ok = verify_artifact(self.shard_path(k), unit.get("size", -1),
+                             unit.get("crc", ""))
+        if ok:
+            METRICS.count("jobs.shards_skipped")
+        return ok
+
     @contextlib.contextmanager
     def open_shard(self, k: int) -> Iterator:
         """Open shard ``k`` for writing; the part becomes visible under
-        its deterministic name only when the block exits cleanly."""
+        its deterministic name only when the block exits cleanly (and,
+        with a journal, is recorded as committed only after the
+        rename)."""
         os.makedirs(self.shard_dir, exist_ok=True)
         part = self.shard_path(k)
         tmp_part = part + ".tmp"
@@ -63,6 +126,15 @@ class ShardedFileWriter:
             raise
         f.close()
         os.replace(tmp_part, part)
+        if self.journal is not None:
+            from hadoop_bam_tpu.jobs.journal import file_digest
+
+            size, crc = file_digest(part)
+            # abspath: the unit must verify from whatever cwd the
+            # resuming process runs in
+            self.journal.unit_done("shard", k,
+                                   path=os.path.abspath(part),
+                                   size=size, crc=crc)
 
     # -- merge side (host 0) -------------------------------------------------
 
@@ -99,3 +171,27 @@ class ShardedFileWriter:
 
     def cleanup(self) -> None:
         shutil.rmtree(self.shard_dir, ignore_errors=True)
+
+
+def write_shards_journaled(sw: ShardedFileWriter,
+                           payloads: Sequence[bytes],
+                           write_one: Optional[Callable] = None) -> int:
+    """Write every not-yet-committed shard of ``payloads`` through
+    ``sw`` — the journal-aware producer loop for resumable sharded
+    jobs (pinned by the kill-and-resume tests): committed shards are
+    verified and skipped, everything else is (re)written.  Returns the
+    number of shards actually written this attempt.  The mesh sort's
+    multi-host shard writes will route through this once journaling
+    grows a per-host resume barrier protocol (today journaling is
+    single-process; see ``sort_bam_mesh``)."""
+    wrote = 0
+    for k, payload in enumerate(payloads):
+        if sw.shard_committed(k):
+            continue
+        with sw.open_shard(k) as f:
+            if write_one is not None:
+                write_one(f, k, payload)
+            else:
+                f.write(payload)
+        wrote += 1
+    return wrote

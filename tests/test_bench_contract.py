@@ -39,6 +39,7 @@ def _fill_state(bench, n_notes=6):
         ("deflate_tokenize_gbps", 0.41, "GB/s", 0.8),
         ("coverage_records_per_sec", 375000.2, "records/s", 1.25),
         ("sort_records_per_sec_mesh", 47368.1, "records/s", 6.6),
+        ("resume_overhead_pct", 1.4, "%", None),
         ("sort_write_mb_per_sec", 38.52, "MB/s", 0.97),
         ("seq_pallas_kernel_bases_per_sec", 1.9e9, "bases/s", 12.2),
         ("cigar_pileup_kernel_records_per_sec", 8.1e6, "records/s", None),
@@ -88,6 +89,18 @@ def _fill_state(bench, n_notes=6):
                        byte_identical_to_serial=True)
         if m == "obs_overhead_pct":
             row.update(instrumented_s=0.1301, null_s=0.1284)
+        if m == "resume_overhead_pct":
+            # the r16 crash-safe jobs row: journal-on vs journal-off
+            # walls, and the SIGKILL-resume arm's journal-verified
+            # skipped-work fraction + byte identity — full row only;
+            # the compact line keeps the overhead number
+            row.update(journaled_wall_s=2.113, plain_wall_s=2.084,
+                       round_records=3125, records=100000,
+                       byte_identical_to_plain=True,
+                       resume_records=100000, resume_wall_s=1.61,
+                       resume_rounds_skipped=1,
+                       resume_fraction_skipped=0.25,
+                       resume_byte_identical=True)
         if m == "cohort_join_variants_per_sec":
             # the r15 cohort-plane row: k-way join+pack rate, per-stage
             # wall shares, warm vs cold cohort-slice serving — full row
@@ -282,6 +295,26 @@ def test_scaling_rows_pin_feed_overlap_fields(bench):
         assert "pipeline.feed_wall" in row["flagstat_wall_seconds_per_run"]
     line = json.dumps(bench._compact_snapshot(full))
     assert len(line) <= bench.FINAL_LINE_BUDGET
+
+
+def test_resume_row_shape_pinned(bench):
+    """The r16 crash-safe jobs row: the full row carries both arms
+    (journal-on/off walls, the resume arm's fraction-of-work-skipped
+    and byte identity); the compact final line keeps only the overhead
+    number and still fits the budget."""
+    _fill_state(bench)
+    full = bench._snapshot("ok")
+    row = next(c for c in full["components"]
+               if c["metric"] == "resume_overhead_pct")
+    assert row["unit"] == "%"
+    assert row["journaled_wall_s"] > 0 and row["plain_wall_s"] > 0
+    assert row["byte_identical_to_plain"] is True
+    assert row["resume_byte_identical"] is True
+    assert 0.0 < row["resume_fraction_skipped"] < 1.0
+    assert row["resume_rounds_skipped"] >= 1
+    out = bench._compact_snapshot(full)
+    assert out["components"]["resume_overhead_pct"] == 1.4
+    assert len(json.dumps(out)) <= bench.FINAL_LINE_BUDGET
 
 
 def test_stale_sidecars_healed_fresh_kept(bench, tmp_path):

@@ -876,6 +876,91 @@ def load(final_path):
 
 
 # ---------------------------------------------------------------------------
+# crash-safe job discipline (JS1xx)
+# ---------------------------------------------------------------------------
+
+_JS_BAD = '''
+import os
+import tempfile                            # JS102: tempfile import
+
+def publish_bucket(payload, final_path):
+    staging = final_path + ".new"
+    with open(staging, "wb") as f:
+        f.write(payload)
+    os.replace(staging, final_path)        # JS101: unjournaled rename
+
+def spill_round(payload, out_dir):
+    # JS102: pid-derived temp name — resume can never sweep/verify it
+    path = os.path.join(out_dir, f"run-{os.getpid()}.tmp")
+    with open(path, "wb") as f:
+        f.write(payload)
+    return path
+'''
+
+_JS_CLEAN = '''
+import os
+
+def _publish(tmp_path, path):
+    os.replace(tmp_path, path)             # blessed publication helper
+
+def open_shard(part, payload):
+    tmp_part = part + ".tmp"               # deterministic job-scoped
+    with open(tmp_part, "wb") as f:
+        f.write(payload)
+    os.replace(tmp_part, part)
+
+def commit_round(journal, t, path, payload):
+    with open(path + ".tmp", "wb") as f:
+        f.write(payload)
+    os.rename(path + ".tmp", path)         # journaled alongside:
+    journal.unit_done("round", t, path=path)
+'''
+
+
+def test_js_seeded_violations_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/write/bad_jobs.py": _JS_BAD},
+        only=["jobsafety"])
+    assert rules_of(findings) == {"JS101", "JS102"}
+    assert all(f.severity == "error" for f in findings)
+    assert sum(f.rule == "JS102" for f in findings) == 2  # import + pid
+    assert any("journal" in f.message for f in findings)
+
+
+def test_js_clean_idioms_pass():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/write/good_jobs.py": _JS_CLEAN,
+         "hadoop_bam_tpu/parallel/mesh_sort.py": _JS_CLEAN},
+        only=["jobsafety"])
+    assert findings == []
+
+
+def test_js_scope_is_write_and_mesh_sort_only():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/mesh_sort.py": _JS_BAD,
+         "hadoop_bam_tpu/parallel/pipeline.py": _JS_BAD,
+         "hadoop_bam_tpu/utils/elsewhere.py": _JS_BAD,
+         "hadoop_bam_tpu/query/engine.py": _JS_BAD},
+        only=["jobsafety"])
+    assert {f.path for f in findings} == \
+        {"hadoop_bam_tpu/parallel/mesh_sort.py"}
+
+
+def test_js_rename_args_checked_for_nondeterminism():
+    findings = lint_sources({"hadoop_bam_tpu/write/renamer.py": '''
+import os
+import time
+
+def open_shard(part, payload):
+    tmp = part + "." + str(time.time_ns()) + ".tmp"   # JS102 even in a
+    with open(tmp, "wb") as f:                        # blessed helper
+        f.write(payload)
+    os.replace(tmp, part)
+'''}, only=["jobsafety"])
+    assert rules_of(findings) == {"JS102"}
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip / suppression
 # ---------------------------------------------------------------------------
 
