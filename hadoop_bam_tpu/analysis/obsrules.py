@@ -24,6 +24,17 @@ Scope: ``parallel/``, ``query/``, ``ops/`` (the pipeline hot paths).
   alongside — pool tasks overlap, so the timer's thread-sum misreports
   the stage; use ``wall_timer``/``span`` (keeping a paired ``timer``
   for work-seconds is fine, alone it is not).
+
+- OB603: an ENTRY-POINT function (a ``cmd_*`` CLI verb, or
+  ``submit`` / ``handle_stream`` / ``run_job_level`` / ``resume_job``
+  in ``serve//jobs/``) that starts work without minting or joining a
+  ``TraceContext`` (``obs/context.py``).  Work started without a trace
+  produces spans, journal lines and flight-ring entries that answer
+  "what ran" but never "for WHOM" — the causal tree breaks at exactly
+  the seam it exists to cross.  Mint with ``trace_context`` /
+  ``ensure_trace`` in the function, or (CLI verbs only) centrally in
+  the module's ``main`` frontend.  Scope: ``serve/``, ``jobs/``,
+  ``tools/cli.py``.
 """
 from __future__ import annotations
 
@@ -34,6 +45,17 @@ from hadoop_bam_tpu.analysis.core import Finding, Project, register
 
 SCOPE = ("hadoop_bam_tpu/parallel", "hadoop_bam_tpu/query",
          "hadoop_bam_tpu/ops")
+
+# OB603 scope: the entry-point layers where TraceContexts are minted
+ENTRY_SCOPE = ("hadoop_bam_tpu/serve", "hadoop_bam_tpu/jobs",
+               "hadoop_bam_tpu/tools/cli.py")
+# function names that ARE entry points (plus any cmd_* CLI verb)
+_ENTRY_NAMES = {"submit", "handle_stream", "run_job_level",
+                "resume_job"}
+# identifiers that count as minting/joining a TraceContext
+_TRACE_MINTERS = {"trace_context", "ensure_trace", "current_trace",
+                  "current_trace_id", "new_trace_id", "TraceContext",
+                  "begin_span"}
 
 _CLOCK_CALLS = {"perf_counter", "time"}
 # identifiers that mark a function as feeding the metrics layer
@@ -127,9 +149,53 @@ def _pooled_callee_names(fn: ast.AST) -> Set[str]:
     return names
 
 
+def _references_trace(fn: ast.AST) -> bool:
+    return any(i in _TRACE_MINTERS for i in _identifiers(fn))
+
+
+def _is_entry_point(fn: ast.AST) -> bool:
+    name = getattr(fn, "name", "")
+    return name in _ENTRY_NAMES or name.startswith("cmd_")
+
+
+def _module_main_mints(tree: ast.Module) -> bool:
+    """True when the module has a top-level ``main`` that mints a trace
+    — the CLI-frontend idiom: one mint in ``main`` covers every
+    ``cmd_*`` verb it dispatches to."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "main":
+            return _references_trace(node)
+    return False
+
+
 @register("obs")
 def analyze(project: Project) -> List[Finding]:
     findings: List[Finding] = []
+
+    # OB603: un-traced entry points in the serve/jobs/CLI layers
+    for m in project.select(ENTRY_SCOPE):
+        main_mints = _module_main_mints(m.tree)
+        for fn in _func_defs(m.tree):
+            if not _is_entry_point(fn):
+                continue
+            if not any(True for _ in _direct_children_calls(fn)):
+                continue                  # starts no work
+            if _references_trace(fn):
+                continue
+            if fn.name.startswith("cmd_") and main_mints:
+                continue                  # minted centrally in main()
+            findings.append(Finding(
+                rule="OB603", severity="error", path=m.path,
+                line=fn.lineno,
+                message=f"entry point {fn.name}() starts work without "
+                        "minting or joining a TraceContext — spans, "
+                        "journal lines and flight-ring entries it "
+                        "produces cannot be attributed to a request; "
+                        "wrap the work in obs.context.trace_context/"
+                        "ensure_trace (CLI verbs may mint once in the "
+                        "module's main())"))
+
     for m in project.select(SCOPE):
         # OB601: raw clock stage timing that never reaches Metrics
         for fn in _func_defs(m.tree):

@@ -139,6 +139,16 @@ _HADOOP_KEY_MAP = {
     "hbam.cohort-quarantine-inputs": "cohort_quarantine_inputs",
     "hbam.cohort-max-quarantine-fraction": "cohort_max_quarantine_fraction",
     "hbam.serve-cohort-manifests": "serve_cohort_manifests",
+    # live-ops plane knobs (obs/flight.py, obs/slo.py; no reference
+    # analog — Hadoop counters died with the job and nothing watched
+    # them while it ran)
+    "hbam.flight-dump-dir": "flight_dump_dir",
+    "hbam.flight-dump-cap": "flight_dump_cap",
+    "hbam.slo-latency-s": "slo_latency_s",
+    "hbam.slo-target": "slo_target",
+    "hbam.slo-tick-s": "slo_tick_s",
+    "hbam.slo-min-events": "slo_min_events",
+    "hbam.slo-shed-batch": "slo_shed_batch",
 }
 
 
@@ -299,6 +309,29 @@ class HBamConfig:
     serve_cohort_manifests: int = 8  # cohort manifests kept resident in
     #                                  the serve tier before LRU eviction
 
+    # --- live ops plane (obs/flight.py flight recorder + obs/slo.py
+    # SLO burn accounting; `hbam top` reads both off the serve
+    # transport) ---
+    flight_dump_dir: Optional[str] = None  # where breaker-trip /
+    #                                  demotion / deadline-miss /
+    #                                  serve-error flight snapshots land
+    #                                  (redacted JSON); None = the
+    #                                  always-on ring stays memory-only
+    #                                  (still served via {"op":"health"})
+    flight_dump_cap: int = 16        # rotation cap on dump files kept
+    slo_latency_s: float = 1.0       # per-tenant latency objective: a
+    #                                  request slower than this spends
+    #                                  error budget
+    slo_target: float = 0.99         # promised good fraction
+    slo_tick_s: float = 10.0         # burn-window snapshot cadence
+    slo_min_events: int = 64         # window events below which burn
+    #                                  reads 0 (a cold tenant's first
+    #                                  slow request must not page)
+    slo_shed_batch: bool = True      # shed batch-priority admissions
+    #                                  for a tenant whose FAST burn
+    #                                  window is alight (interactive
+    #                                  traffic keeps flowing)
+
     # --- debug ---
     debug_keep_spill: bool = False   # keep mesh-sort .mesh-spill run dirs
     #                                  for post-mortem instead of removing
@@ -421,7 +454,7 @@ def _coerce(kwargs: dict) -> dict:
               "keep_paired_reads_together", "skip_bad_spans",
               "debug_keep_spill", "serve_prefetch", "adaptive_planes",
               "cohort_quarantine_inputs", "speculative_decode",
-              "journal_fsync"):
+              "journal_fsync", "slo_shed_batch"):
         if k in out and isinstance(out[k], str):
             out[k] = out[k].lower() in ("1", "true", "yes")
     for k in ("max_bad_span_fraction", "retry_backoff_base_s",
@@ -432,7 +465,8 @@ def _coerce(kwargs: dict) -> dict:
               "serve_prefetch_pause_pressure",
               "cohort_max_quarantine_fraction", "pool_task_timeout_s",
               "straggler_multiplier", "straggler_min_s",
-              "collective_timeout_s"):
+              "collective_timeout_s", "slo_latency_s", "slo_target",
+              "slo_tick_s"):
         if k in out and isinstance(out[k], str):
             out[k] = float(out[k])
     for k in ("span_retries", "io_read_retries", "feed_ring_slots",
@@ -447,7 +481,8 @@ def _coerce(kwargs: dict) -> dict:
               "serve_tenant_max_in_flight", "serve_tenant_queue_depth",
               "serve_max_tenants", "serve_ring_slots",
               "breaker_half_open_probes", "chaos_seed",
-              "cohort_chunk_sites", "serve_cohort_manifests"):
+              "cohort_chunk_sites", "serve_cohort_manifests",
+              "flight_dump_cap", "slo_min_events"):
         if k in out and isinstance(out[k], str):
             out[k] = int(out[k])
     return out

@@ -25,7 +25,8 @@ from hadoop_bam_tpu.jobs.journal import (     # noqa: F401
     sweep_unrecorded, verify_artifact,
 )
 from hadoop_bam_tpu.jobs.runner import (      # noqa: F401
-    COHORT_FINGERPRINT_FIELDS, JobInfo, SORT_FINGERPRINT_FIELDS,
-    job_status, list_jobs, resume_job, run_job_level, sort_job_params,
+    COHORT_FINGERPRINT_FIELDS, JobInfo, RESUME_GRAINS,
+    SORT_FINGERPRINT_FIELDS, job_info_doc, job_status, list_jobs,
+    resume_grain, resume_job, run_job_level, sort_job_params,
 )
 from hadoop_bam_tpu.jobs.speculate import UnitLatency  # noqa: F401

@@ -209,6 +209,16 @@ class JobJournal:
     def append(self, rec: Dict) -> None:
         rec = dict(rec)
         rec["seq"] = self._seq
+        # every journal line carries the active trace id (obs/context):
+        # `hbam jobs --json` reports which invocation wrote the journal,
+        # and a resumed job's lines are attributable to the RESUMING
+        # trace, not the original one
+        if "trace" not in rec:
+            from hadoop_bam_tpu.obs.context import current_trace_id
+
+            tid = current_trace_id()
+            if tid is not None:
+                rec["trace"] = tid
         rec["c"] = _line_crc(rec)
         line = json.dumps(rec, sort_keys=True,
                           separators=(",", ":")) + "\n"

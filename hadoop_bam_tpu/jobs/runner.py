@@ -14,6 +14,7 @@ import os
 from typing import Callable, Dict, List, Optional, Sequence
 
 from hadoop_bam_tpu.jobs import journal as jj
+from hadoop_bam_tpu.obs.context import ensure_trace
 from hadoop_bam_tpu.utils.errors import PlanError
 from hadoop_bam_tpu.utils.metrics import METRICS
 
@@ -65,26 +66,30 @@ def run_job_level(journal_path: str, *, kind: str, config,
     ``run()`` and commits the result.  Mismatched identity/fingerprint/
     params refuse inside ``JobJournal.resume``."""
     output = os.path.abspath(output)
-    jr, state = jj.JobJournal.resume(
-        journal_path, kind=kind,
-        inputs=[(os.path.abspath(p), jj.file_identity_digest(p))
-                for p in inputs],
-        output=output,
-        fingerprint=jj.config_fingerprint(config, fingerprint_fields),
-        config_values=jj.fingerprint_values(config, fingerprint_fields),
-        params=params,
-        fsync=bool(getattr(config, "journal_fsync", True)))
-    with jr:
-        if state is not None and state.done is not None:
-            d = state.done
-            if jj.verify_artifact(output, d.get("size", -1),
-                                  d.get("crc", "")):
-                METRICS.count("jobs.jobs_skipped")
-                return int(d.get("records", 0))
-        n = int(run())
-        size, crc = jj.file_digest(output)
-        jr.job_done(records=n, size=size, crc=crc)
-        return n
+    # job start is an entry point: the minted (or joined) trace id is
+    # stamped onto every journal line this run writes
+    with ensure_trace(op=f"job.{kind}"):
+        jr, state = jj.JobJournal.resume(
+            journal_path, kind=kind,
+            inputs=[(os.path.abspath(p), jj.file_identity_digest(p))
+                    for p in inputs],
+            output=output,
+            fingerprint=jj.config_fingerprint(config, fingerprint_fields),
+            config_values=jj.fingerprint_values(config,
+                                                fingerprint_fields),
+            params=params,
+            fsync=bool(getattr(config, "journal_fsync", True)))
+        with jr:
+            if state is not None and state.done is not None:
+                d = state.done
+                if jj.verify_artifact(output, d.get("size", -1),
+                                      d.get("crc", "")):
+                    METRICS.count("jobs.jobs_skipped")
+                    return int(d.get("records", 0))
+            n = int(run())
+            size, crc = jj.file_digest(output)
+            jr.job_done(records=n, size=size, crc=crc)
+            return n
 
 
 # ---------------------------------------------------------------------------
@@ -107,6 +112,11 @@ def resume_job(journal_path: str, config=None) -> Dict:
     config = DEFAULT_CONFIG if config is None else config
     state = jj.JobJournal.replay(journal_path)
     kind = state.kind
+    with ensure_trace(op=f"job.resume.{kind}"):
+        return _resume_replayed(journal_path, config, state, kind)
+
+
+def _resume_replayed(journal_path: str, config, state, kind: str) -> Dict:
     params = dict(state.header.get("params", {}))
     # the header records the fingerprinted field VALUES: reconstruct the
     # job's output-affecting config on top of the caller's, so a job
@@ -166,6 +176,44 @@ class JobInfo:
     units: int
     output: Optional[str]
     detail: str = ""
+    # machine-readable extras (`hbam jobs --json` / `hbam top`):
+    trace_id: Optional[str] = None      # trace that wrote the header
+    units_skipped: int = 0              # units a resume verified+skipped
+    resumes: int = 0                    # resume events recorded
+
+
+# the grain a resumed job skips completed work at, per journal kind —
+# the `hbam jobs --json` / `hbam top` vocabulary (README crash-recovery
+# table is the human-readable twin)
+RESUME_GRAINS = {
+    "mesh_sort_spill": "round",
+    "mesh_sort": "job",
+    "cohort_join": "chunk",
+    "shard_write": "part",
+}
+
+
+def resume_grain(kind: str) -> str:
+    return RESUME_GRAINS.get(kind, "job")
+
+
+def job_info_doc(info: JobInfo) -> Dict:
+    """THE machine-readable job row — the one parser ``hbam jobs
+    --json``, ``hbam top`` and external schedulers share.  Keys are a
+    stable contract: path/kind/status/output, the journal-writing
+    trace_id, the resume grain, and units committed/skipped."""
+    return {
+        "path": info.path,
+        "kind": info.kind,
+        "status": info.status,
+        "output": info.output,
+        "detail": info.detail or None,
+        "trace_id": info.trace_id,
+        "resume_grain": resume_grain(info.kind),
+        "units_total": info.units,
+        "units_skipped": info.units_skipped,
+        "resumes": info.resumes,
+    }
 
 
 def job_status(journal_path: str) -> JobInfo:
@@ -177,6 +225,12 @@ def job_status(journal_path: str) -> JobInfo:
         return JobInfo(path=journal_path, kind="?", status="corrupt",
                        units=0, output=None,
                        detail=f"{type(e).__name__}: {e}")
+    trace_id = state.header.get("trace")
+    resumes = [e for e in state.events if e.get("name") == "resume"]
+    # units the LAST resume found committed = what that resume verified
+    # and skipped instead of re-running
+    units_skipped = int(resumes[-1].get("prior_units", 0)) \
+        if resumes else 0
     if state.done is not None:
         output = state.header.get("output")
         if output is None:
@@ -190,13 +244,17 @@ def job_status(journal_path: str) -> JobInfo:
             detail = "output missing/changed since job_done"
         return JobInfo(
             path=journal_path, kind=state.kind, status="done",
-            units=len(state.units), output=output, detail=detail)
+            units=len(state.units), output=output, detail=detail,
+            trace_id=trace_id, units_skipped=units_skipped,
+            resumes=len(resumes))
     status = "resumable" if state.units else "fresh"
     detail = "torn tail (expected after a crash)" if state.torn_tail \
         else ""
     return JobInfo(path=journal_path, kind=state.kind, status=status,
                    units=len(state.units),
-                   output=state.header.get("output"), detail=detail)
+                   output=state.header.get("output"), detail=detail,
+                   trace_id=trace_id, units_skipped=units_skipped,
+                   resumes=len(resumes))
 
 
 def list_jobs(directory: str = ".") -> List[JobInfo]:

@@ -14,6 +14,20 @@ Three pieces, threaded through every pipeline stage via
 - ``export.py``  Prometheus text exposition + snapshot JSON files —
   the ``hbam metrics`` CLI surface.
 
+The causal/ops additions (PR 14):
+
+- ``context.py`` ``TraceContext`` — a request/job identity minted at
+  every entry point and propagated across the pool, packer, dispatcher
+  and prefetch seams via contextvars; spans and journal lines carry
+  its trace_id;
+- ``flight.py``  always-on bounded flight recorder — recent span
+  completions + breaker/ladder transitions, auto-dumped (redacted,
+  rotation-capped) on breaker trips, demotions, deadline misses and
+  unhandled serve errors;
+- ``slo.py``     declarative latency/error-rate SLOs with multi-window
+  burn-rate accounting fed from the log-bucketed histograms, exported
+  as Prometheus gauges and consulted by serve admission.
+
 Run-scoped isolation lives in ``utils.metrics.MetricsContext`` (the
 contextvar-scoped instance the ``METRICS`` proxy resolves to).
 """
@@ -25,3 +39,11 @@ from hadoop_bam_tpu.obs.trace import (  # noqa: F401
 from hadoop_bam_tpu.obs.export import (  # noqa: F401
     load_metrics_json, prometheus_text, render_metrics, save_metrics_json,
 )
+from hadoop_bam_tpu.obs.context import (  # noqa: F401
+    TraceContext, current_trace, current_trace_id, ensure_trace,
+    new_trace_id, trace_context,
+)
+from hadoop_bam_tpu.obs.slo import (  # noqa: F401
+    BurnWindow, SloEngine, SloObjective,
+)
+from hadoop_bam_tpu.obs import flight  # noqa: F401

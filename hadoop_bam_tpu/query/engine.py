@@ -643,10 +643,16 @@ class QueryEngine:
         requests = [r if isinstance(r, QueryRequest) else QueryRequest(*r)
                     for r in requests]
         import time
+
+        from hadoop_bam_tpu.obs.context import ensure_trace
         t0 = time.perf_counter()
         deadline = None
         try:
-            with self.scheduler.admit(deadline_s) as deadline:
+            # one trace per query batch (joined when the CLI / serve
+            # tier already minted one): every span below — resolve,
+            # pool-side chunk decode, staging dispatch — shares its id
+            with ensure_trace(op="query.batch", deadline_s=deadline_s), \
+                    self.scheduler.admit(deadline_s) as deadline:
                 tuples, _refs, _counts, _ivs = self._prepare(requests,
                                                              deadline)
                 yield from self._stream_groups(tuples, deadline)
@@ -670,10 +676,13 @@ class QueryEngine:
         requests = [r if isinstance(r, QueryRequest) else QueryRequest(*r)
                     for r in requests]
         import time
+
+        from hadoop_bam_tpu.obs.context import ensure_trace
         t_start = time.perf_counter()
         batch_deadline = None
         try:
-            with self.scheduler.admit(deadline_s) as deadline:
+            with ensure_trace(op="query.batch", deadline_s=deadline_s), \
+                    self.scheduler.admit(deadline_s) as deadline:
                 batch_deadline = deadline
                 tuples, refs, cand_counts, _ivs = self._prepare(requests,
                                                                 deadline)

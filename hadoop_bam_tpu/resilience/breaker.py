@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from hadoop_bam_tpu.obs import flight
 from hadoop_bam_tpu.utils.metrics import METRICS
 
 CLOSED = "closed"
@@ -111,6 +112,14 @@ class CircuitBreaker:
         with METRICS.span("resilience.breaker_state",
                           breaker=self.name, state=state):
             pass
+        # the flight recorder sees every flip; an OPEN additionally
+        # snapshots the ring to disk (when a dump dir is configured) —
+        # the trip, the tripping request's trace id, and the prior span
+        # completions land in one incident document
+        rec = flight.recorder()
+        rec.record_transition("breaker", self.name, state)
+        if state == OPEN:
+            rec.dump(f"breaker_open:{self.name or 'unnamed'}")
 
     def _maybe_half_open(self) -> None:
         if self._state == OPEN and \

@@ -31,6 +31,7 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, Optional, Tuple
 
+from hadoop_bam_tpu.obs import flight
 from hadoop_bam_tpu.resilience.breaker import CircuitBreaker, OPEN
 from hadoop_bam_tpu.utils.errors import (
     CircuitBreakerError, PLAN, classify_error,
@@ -245,6 +246,13 @@ class DemotionLadder:
     def confirm_failure(self, plane: str, exc: BaseException) -> None:
         METRICS.count("resilience.demotions")
         METRICS.count(f"resilience.demoted_from_{plane}")
+        # a demotion is an incident-grade event even before the plane's
+        # breaker opens: record + dump so the first oracle-confirmed
+        # plane fault already leaves a flight snapshot behind
+        rec = flight.recorder()
+        rec.record_transition("demotion", f"{self.subsystem}/{plane}",
+                              "demoted")
+        rec.dump(f"plane_demotion:{plane}", error=str(exc))
         self._domain(plane).record_failure(exc)
 
     def record_success(self, plane: str) -> None:

@@ -38,19 +38,27 @@ import heapq
 import itertools
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.obs import flight
+from hadoop_bam_tpu.obs.context import ensure_trace
+from hadoop_bam_tpu.obs.slo import SloEngine
 from hadoop_bam_tpu.query.engine import QueryEngine, _I32_MAX
 from hadoop_bam_tpu.serve.prefetch import Prefetcher
 from hadoop_bam_tpu.serve.tenancy import TenantQuotas, priority_rank
 from hadoop_bam_tpu.serve.tiles import (
     DeviceTileCache, TileBuilder, make_tile_filter_step, tile_key,
 )
-from hadoop_bam_tpu.utils.errors import PlanError, TransientIOError
-from hadoop_bam_tpu.utils.metrics import METRICS
+from hadoop_bam_tpu.utils.errors import (
+    PLAN, PlanError, TransientIOError, classify_error,
+)
+from hadoop_bam_tpu.utils.metrics import (
+    METRICS, base_metrics, current_metrics,
+)
 
 
 @dataclasses.dataclass
@@ -101,6 +109,33 @@ class ServeLoop:
             int(getattr(config, "serve_tile_cache_bytes", 512 << 20)))
         self.tenants = TenantQuotas(config)
         self.prefetcher = Prefetcher(self.engine, config)
+        # SLO burn accounting (obs/slo.py): per-tenant latency
+        # objectives over the server's PROCESS-GLOBAL metrics — client
+        # MetricsContexts isolate per-request numbers, so the serving
+        # path mirrors its latency observations into base_metrics()
+        # where the engine (and the metrics transport op) read them
+        self.slo = SloEngine(
+            tick_s=float(getattr(config, "slo_tick_s", 10.0)),
+            min_events=int(getattr(config, "slo_min_events", 64)))
+        self.slo_metrics = base_metrics()
+        self.slo_latency_s = float(getattr(config, "slo_latency_s", 1.0))
+        self.slo_target = float(getattr(config, "slo_target", 0.99))
+        self.slo.ensure_latency("latency/_all", "serve.latency_s",
+                                self.slo_latency_s, self.slo_target)
+        self.tenants.slo_engine = self.slo
+        # tenants with mirrored per-tenant series, LRU-bounded: tenant
+        # strings are CLIENT input, and without eviction every distinct
+        # string would grow the process-global metrics forever (the
+        # SV801 discipline; the quota LRU bounds gates, not metric keys)
+        self._slo_tenants: "OrderedDict[str, bool]" = OrderedDict()
+        # flight-recorder disk dumps: configured from this loop's config
+        # when set (unset leaves the process-wide recorder as-is, so a
+        # directory installed by the CLI or a test is not clobbered)
+        fdir = getattr(config, "flight_dump_dir", None)
+        if fdir:
+            flight.recorder().configure(
+                dump_dir=fdir,
+                dump_cap=int(getattr(config, "flight_dump_cap", 16)))
         self.tile_cap = int(getattr(config, "serve_tile_records", 4096))
         self._builder: Optional[TileBuilder] = None
         self._cohort = None          # lazy cohort/serving.CohortServer
@@ -177,16 +212,25 @@ class ServeLoop:
                                        retry_after_s=1.0)
         if self._thread is None:
             self.start()
-        # entered HERE (client thread: admission wait + shed happen to
-        # the submitter); exited by the dispatcher when the job finishes
-        admission = self.tenants.admit(tenant, deadline_s)
-        deadline = admission.__enter__()
-        job = _Job(rank=rank, seq=next(self._seq), tenant=tenant,
-                   path=path, regions=list(regions),
-                   want_records=bool(want_records), deadline=deadline,
-                   admission=admission, future=cf.Future(),
-                   ctx=contextvars.copy_context(),
-                   t_enqueue=time.perf_counter(), cohort=bool(cohort))
+        # request identity: join the transport/CLI trace when one is
+        # active, mint one for direct library callers — the contextvars
+        # snapshot below carries it to the dispatcher, the decode pool
+        # and the staging packer, so every span of this request shares
+        # one trace_id end to end
+        with ensure_trace(op="serve.submit", tenant=tenant,
+                          deadline_s=deadline_s):
+            # entered HERE (client thread: admission wait + shed happen
+            # to the submitter); exited by the dispatcher when the job
+            # finishes
+            admission = self.tenants.admit(tenant, deadline_s,
+                                           priority=priority)
+            deadline = admission.__enter__()
+            job = _Job(rank=rank, seq=next(self._seq), tenant=tenant,
+                       path=path, regions=list(regions),
+                       want_records=bool(want_records), deadline=deadline,
+                       admission=admission, future=cf.Future(),
+                       ctx=contextvars.copy_context(),
+                       t_enqueue=time.perf_counter(), cohort=bool(cohort))
         with self._cond:
             if self._stopping:
                 self._finish_admission(job)
@@ -222,6 +266,8 @@ class ServeLoop:
         with self._cond:
             stopping = self._stopping
             queued = len(self._heap)
+        from hadoop_bam_tpu.utils import pools
+
         return {
             "status": "stopping" if stopping else "serving",
             "queued": queued,
@@ -231,6 +277,12 @@ class ServeLoop:
             "tenant_breakers": self.tenants.breaker_states(),
             "prefetch": self.prefetcher.stats(),
             "tiles": self.tiles.stats(),
+            # the live-ops additions: recent flight-recorder state (the
+            # ring a breaker trip would dump), SLO burn rates, and pool
+            # occupancy — the surfaces `hbam top` renders
+            "flight": flight.recorder().stats(),
+            "slo": self.slo.summary(self.slo_metrics),
+            "pool": pools.pool_stats(),
         }
 
     # -- dispatcher ----------------------------------------------------------
@@ -279,13 +331,52 @@ class ServeLoop:
             # a cooled-down probe succeeds (PLAN-class rejections are
             # the client's problem and never count)
             self.tenants.record_outcome(job.tenant, e)
+            # an unhandled (non-PLAN) serving error is incident-grade:
+            # snapshot the flight ring while the request's trace is
+            # still the active context
+            if classify_error(e) != PLAN:
+                flight.recorder().dump("serve_error", error=str(e))
             job.future.set_exception(e)
         finally:
-            METRICS.observe("serve.latency_s",
-                            time.perf_counter() - job.t_enqueue)
+            lat = time.perf_counter() - job.t_enqueue
+            METRICS.observe("serve.latency_s", lat)
+            # mirror into the process-global metrics the SLO engine and
+            # the metrics transport op read (a client MetricsContext
+            # isolates the per-request view; the server still needs its
+            # own aggregate), plus the per-tenant series hbam top and
+            # the per-tenant SLO objectives consume.  Tenant cardinality
+            # is bounded by the TenantQuotas LRU upstream of here.
+            m = self.slo_metrics
+            if current_metrics() is not m:
+                # not already recorded there by the METRICS proxy above
+                m.observe("serve.latency_s", lat)
+            self._note_slo_tenant(job.tenant)
+            m.observe(f"serve.latency_s.{job.tenant}", lat)
+            m.count(f"serve.requests.{job.tenant}")
+            self.slo.ensure_latency(
+                f"latency/{job.tenant}",
+                f"serve.latency_s.{job.tenant}",
+                self.slo_latency_s, self.slo_target)
+            self.slo.tick(m)
             if job.deadline is not None and job.deadline.expired:
                 job.deadline.book_miss()
             self._finish_admission(job)
+
+    def _note_slo_tenant(self, tenant: str) -> None:
+        """Track (and LRU-bound) the tenants with mirrored per-tenant
+        series; evicting one discards its metric keys so arbitrary
+        client tenant strings cannot grow the process-global Metrics
+        without bound.  Dispatcher-thread only."""
+        lru = self._slo_tenants
+        if tenant in lru:
+            lru.move_to_end(tenant)
+            return
+        lru[tenant] = True
+        cap = max(1, int(getattr(self.config, "serve_max_tenants", 64)))
+        while len(lru) > cap:
+            old, _ = lru.popitem(last=False)
+            self.slo_metrics.discard_series(
+                f"serve.latency_s.{old}", f"serve.requests.{old}")
 
     def _builder_or_make(self) -> TileBuilder:
         if self._builder is None:

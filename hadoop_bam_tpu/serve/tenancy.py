@@ -67,6 +67,14 @@ class TenantQuotas:
         self.max_tenants = int(getattr(config, "serve_max_tenants", 64))
         self.default_deadline_s: Optional[float] = getattr(
             config, "query_deadline_s", None)
+        # SLO shed pressure (obs/slo.py): when ServeLoop installs its
+        # engine here, a tenant whose FAST burn window is alight sheds
+        # its batch-priority admissions — backfill is the load that can
+        # wait while the budget recovers; interactive traffic still
+        # admits (and still feeds the breaker on real failures)
+        self.slo_engine = None
+        self.slo_shed_batch = bool(getattr(config, "slo_shed_batch",
+                                           True))
         self._clock = clock
         self._config = config
         self._lock = threading.Lock()
@@ -143,8 +151,27 @@ class TenantQuotas:
             return
         br.record_failure()
 
+    def slo_shed_check(self, tenant: str, priority: str) -> None:
+        """Shed batch-priority work for a tenant whose fast SLO burn
+        window is alight (``obs/slo.py``); interactive work admits."""
+        if (self.slo_engine is None or not self.slo_shed_batch
+                or priority != "batch"):
+            return
+        window = self.slo_engine.burning(f"latency/{tenant}")
+        if window != "fast":
+            return
+        METRICS.count("slo.batch_shed")
+        retry = float(getattr(self._config, "serve_shed_retry_after_s",
+                              0.1))
+        raise TransientIOError(
+            f"tenant {tenant!r} is burning its latency SLO budget "
+            f"({window} window) — batch work shed so interactive "
+            f"traffic recovers; retry in {retry:g}s",
+            retry_after_s=retry)
+
     @contextlib.contextmanager
-    def admit(self, tenant: str, deadline_s: Optional[float] = None):
+    def admit(self, tenant: str, deadline_s: Optional[float] = None,
+              priority: str = "interactive"):
         """The tenant's ``QueryScheduler.admit`` — blocking bounded
         admission on the CALLER's thread, yielding the enqueue-anchored
         ``Deadline``.  Guards the handout window: if the idle-LRU
@@ -166,6 +193,7 @@ class TenantQuotas:
                 f"tenant {tenant!r} circuit is {br.state} after repeated "
                 f"serving failures — retry in {br.retry_after_s():.3g}s",
                 retry_after_s=br.retry_after_s() or None)
+        self.slo_shed_check(tenant, priority)
         while True:
             sched = self.scheduler(tenant)
             with sched.admit(deadline_s) as deadline:

@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Dict, List
 
+from hadoop_bam_tpu.obs.context import trace_context
 from hadoop_bam_tpu.resilience import chaos
 from hadoop_bam_tpu.utils.errors import (
     CircuitBreakerError, CorruptDataError, HBamError, PlanError,
@@ -69,21 +70,28 @@ def error_kind(exc: BaseException) -> str:
     return "error"
 
 
-def error_doc(req_id, exc: BaseException, kind: "str | None" = None) -> Dict:
+def error_doc(req_id, exc: BaseException, kind: "str | None" = None,
+              trace: "str | None" = None) -> Dict:
     """The wire shape of one failed request: taxonomy kind + the
-    server's ``retry_after_s`` backoff hint when the shed carries one."""
+    server's ``retry_after_s`` backoff hint when the shed carries one.
+    ``trace`` echoes the request's trace_id so a client can hand the
+    operator the exact id a flight dump / Chrome trace will show."""
     doc = {"id": req_id, "error": str(exc),
            "kind": kind if kind is not None else error_kind(exc)}
+    if trace is not None:
+        doc["trace"] = trace
     ra = getattr(exc, "retry_after_s", None)
     if ra is not None:
         doc["retry_after_s"] = round(float(ra), 4)
     return doc
 
 
-def _result_doc(req_id, tenant: str, results, t_enqueue: float) -> Dict:
+def _result_doc(req_id, tenant: str, results, t_enqueue: float,
+                trace: "str | None" = None) -> Dict:
     return {
         "id": req_id,
         "tenant": tenant,
+        **({"trace": trace} if trace is not None else {}),
         "latency_ms": round((time.perf_counter() - t_enqueue) * 1e3, 3),
         "results": [
             {"region": r.region, "count": r.count,
@@ -99,6 +107,42 @@ def _result_doc(req_id, tenant: str, results, t_enqueue: float) -> Dict:
                 if r.records is not None else {})}
             for r in results],
     }
+
+
+def _client_trace(v) -> "str | None":
+    """A client-supplied trace id, adopted only when it is sane: a
+    short token of [alnum_-] characters.  Anything else (wrong type,
+    oversized, control characters) is ignored and a fresh id is minted
+    — the id is stamped on every ring entry and incident dump, so an
+    attacker-sized string must not ride it."""
+    if isinstance(v, str) and 0 < len(v) <= 64 \
+            and all(c.isalnum() or c in "-_" for c in v):
+        return v
+    return None
+
+
+def _metrics_doc(loop, req: Dict) -> Dict:
+    """The ``{"op": "metrics"}`` answer: the server's process-global
+    metrics snapshot (mergeable ``to_dict`` form) plus SLO burn rates;
+    ``"format": "prometheus"`` returns the text exposition with the
+    ``hbam_slo_burn_rate`` gauge series appended instead."""
+    from hadoop_bam_tpu.obs.export import prometheus_text
+    from hadoop_bam_tpu.utils.metrics import base_metrics
+
+    metrics = getattr(loop, "slo_metrics", None) or base_metrics()
+    slo = getattr(loop, "slo", None)
+    d = metrics.to_dict()
+    if str(req.get("format", "")) == "prometheus":
+        text = prometheus_text(d)
+        if slo is not None:
+            lines = slo.prometheus_lines(d)
+            if lines:
+                text += "\n".join(lines) + "\n"
+        return {"prometheus": text}
+    out: Dict = {"metrics": d}
+    if slo is not None:
+        out["slo"] = slo.burn_rates(d)
+    return out
 
 
 def handle_stream(loop, rfile, wfile) -> int:
@@ -135,6 +179,7 @@ def handle_stream(loop, rfile, wfile) -> int:
             n += 1
             req_id: object = n
             t_enqueue = time.perf_counter()
+            trace_id: "str | None" = None
             try:
                 doc = json.loads(line)
                 if not isinstance(doc, dict):
@@ -146,47 +191,71 @@ def handle_stream(loop, rfile, wfile) -> int:
                     # heap, so it works even when every tenant sheds)
                     write({"id": req_id, "health": loop.health()})
                     continue
+                if doc.get("op") == "metrics":
+                    # live metrics surface (`hbam top`'s poll target):
+                    # the server's process-global snapshot + SLO burn
+                    # rates, also answered inline on the reader thread
+                    write({"id": req_id, **_metrics_doc(loop, doc)})
+                    continue
                 regions = doc.get("regions")
                 if regions is None:
                     regions = [doc["region"]] if "region" in doc else None
                 if not regions or "path" not in doc:
                     raise PlanError(
                         'request needs "path" and "regions" (or "region")')
-                fut = loop.submit(
-                    doc["path"], regions,
-                    tenant=str(doc.get("tenant", "default")),
-                    priority=str(doc.get("priority", "interactive")),
-                    deadline_s=doc.get("deadline_s"),
-                    want_records=bool(doc.get("records", False)),
-                    cohort=bool(doc.get("cohort", False)))
+                # ONE trace per request line, minted here at the wire —
+                # loop.submit's contextvars snapshot carries it through
+                # the dispatcher, the decode pool and the staging
+                # packer, and the response line echoes it back; a
+                # client-supplied "trace" is adopted so ids can span
+                # systems
+                with trace_context(
+                        op="serve.request",
+                        tenant=str(doc.get("tenant", "default")),
+                        deadline_s=doc.get("deadline_s"),
+                        trace_id=_client_trace(doc.get("trace"))) as tctx:
+                    trace_id = tctx.trace_id
+                    fut = loop.submit(
+                        doc["path"], regions,
+                        tenant=str(doc.get("tenant", "default")),
+                        priority=str(doc.get("priority", "interactive")),
+                        deadline_s=doc.get("deadline_s"),
+                        want_records=bool(doc.get("records", False)),
+                        cohort=bool(doc.get("cohort", False)))
             except (ValueError, KeyError, TypeError) as e:
                 # malformed line / PlanError-class rejection: answer,
                 # keep serving the stream (one bad client line must not
                 # kill the connection)
                 write(error_doc(req_id, e,
                                 kind=None if isinstance(e, HBamError)
-                                else "plan"))
+                                else "plan", trace=trace_id))
                 continue
             except (TransientIOError, CircuitBreakerError, OSError) as e:
                 # admission / tenant-breaker / quarantine-circuit shed:
                 # a classified answer with the backoff hint, never a
                 # hang and never a dropped connection (a bare
                 # RuntimeError is a bug and must propagate, not serve)
-                write(error_doc(req_id, e))
+                write(error_doc(req_id, e, trace=trace_id))
                 continue
 
             ev = threading.Event()
 
             def _done(f: cf.Future, req_id=req_id,
                       tenant=str(doc.get("tenant", "default")),
-                      t_enqueue=t_enqueue, ev=ev) -> None:
+                      t_enqueue=t_enqueue, ev=ev,
+                      trace_id=trace_id) -> None:
                 try:
                     exc = f.exception()
                     if exc is not None:
-                        write(error_doc(req_id, exc))
+                        write(error_doc(req_id, exc, trace=trace_id))
                     else:
-                        write(_result_doc(req_id, tenant, f.result(),
-                                          t_enqueue))
+                        # the response write runs on the dispatcher
+                        # thread inside the job's context — this span
+                        # is the tail of the request's causal tree
+                        with METRICS.span("serve.response_wall"):
+                            write(_result_doc(req_id, tenant,
+                                              f.result(), t_enqueue,
+                                              trace=trace_id))
                 finally:
                     ev.set()
 

@@ -595,6 +595,8 @@ def cmd_serve(args) -> int:
         overrides["serve_prefetch"] = False
     if getattr(args, "breaker_cooldown", None) is not None:
         overrides["breaker_cooldown_s"] = args.breaker_cooldown
+    if getattr(args, "flight_dir", None):
+        overrides["flight_dump_dir"] = args.flight_dir
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     _start_obs(args)
@@ -815,11 +817,21 @@ def cmd_resume(args) -> int:
 
 def cmd_jobs(args) -> int:
     """List job journals in a directory: kind, status (done / resumable
-    / fresh / corrupt), committed units, output."""
-    from hadoop_bam_tpu.jobs import job_status, list_jobs
+    / fresh / corrupt), committed units, output.  ``--json`` emits one
+    machine-readable object per journal (trace_id, resume grain, units
+    skipped/total) — the SAME document ``hbam top`` renders, so
+    external schedulers and the live view share one parser
+    (``jobs.runner.job_info_doc``)."""
+    import json as _json
+
+    from hadoop_bam_tpu.jobs import job_info_doc, job_status, list_jobs
 
     infos = [job_status(p) for p in args.journals] if args.journals \
         else list_jobs(args.dir)
+    if getattr(args, "json", False):
+        for i in infos:
+            print(_json.dumps(job_info_doc(i), sort_keys=True))
+        return 0
     if not infos:
         print(f"no *.hbam-journal files in {args.dir}")
         return 0
@@ -828,6 +840,158 @@ def cmd_jobs(args) -> int:
         print(f"{i.path}\t{i.kind}\t{i.status}\tunits={i.units}"
               f"\t{i.output or '-'}{detail}")
     return 0
+
+
+# ---------------------------------------------------------------------------
+# top (live ops view over a running `hbam serve`)
+# ---------------------------------------------------------------------------
+
+def _top_fetch(host: str, port: int, timeout: float = 10.0):
+    """One poll of a live serve process: the health document and the
+    metrics/SLO snapshot, over the JSONL TCP transport."""
+    import json as _json
+    import socket
+
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        f = s.makefile("rw", encoding="utf-8", newline="\n")
+        f.write(_json.dumps({"op": "health", "id": 1}) + "\n")
+        f.write(_json.dumps({"op": "metrics", "id": 2}) + "\n")
+        f.flush()
+        docs = {}
+        for _ in range(2):
+            line = f.readline()
+            if not line:
+                break
+            d = _json.loads(line)
+            docs[d.get("id")] = d
+    return (docs.get(1, {}).get("health", {}), docs.get(2, {}))
+
+
+def _hist_summary(hists: dict, key: str) -> Optional[dict]:
+    from hadoop_bam_tpu.obs import Histogram
+    h = hists.get(key)
+    if not isinstance(h, dict) or "buckets" not in h:
+        return None
+    return Histogram.from_dict(h).summary()
+
+
+def _render_top(health: dict, mdoc: dict, prev_counters: Optional[dict],
+                interval: float, jobs_dir: Optional[str]) -> str:
+    """One `hbam top` frame as text: per-tenant q/s + latency
+    percentiles, cache hit rates, pool occupancy, breaker/SLO state,
+    and active-job resume progress."""
+    metrics = mdoc.get("metrics", {}) or {}
+    counters = {k: int(v)
+                for k, v in dict(metrics.get("counters", {})).items()}
+    hists = dict(metrics.get("histograms", {}))
+    lines: List[str] = []
+    tiles = health.get("tiles", {}) or {}
+    pool = health.get("pool", {}) or {}
+    lines.append(
+        f"status={health.get('status', '?')} "
+        f"queued={health.get('queued', '?')} "
+        f"fault_pressure={health.get('fault_pressure', 0)} "
+        f"open_breakers={health.get('open_breakers', 0)}")
+    lines.append(
+        f"pool: workers={pool.get('workers', '?')} "
+        f"live={pool.get('threads_live', '?')} "
+        f"queued={pool.get('queued_tasks', 0)} "
+        f"bg={pool.get('bg_running', 0)}/{pool.get('bg_queued', 0)}")
+    th = int(tiles.get("hits", 0))
+    tm = int(tiles.get("misses", 0))
+    ch = counters.get("query.cache_hits", 0)
+    cm = counters.get("query.cache_misses", 0)
+    lines.append(
+        f"caches: tile_hit_rate="
+        f"{th / (th + tm):.2f}" if (th + tm) else
+        "caches: tile_hit_rate=-")
+    lines[-1] += (f" chunk_hit_rate={ch / (ch + cm):.2f}"
+                  if (ch + cm) else " chunk_hit_rate=-")
+    for name, s in sorted((mdoc.get("slo") or {}).items()):
+        burn = " ".join(f"{w}={v}" for w, v in sorted(s.items()))
+        lines.append(f"slo {name}: {burn}")
+    fl = health.get("flight", {}) or {}
+    if fl:
+        lines.append(f"flight: dumps={fl.get('dumps_written', 0)} "
+                     f"last={fl.get('last_dump') or '-'}")
+    # per-tenant table from the serve.requests.<tenant> counters and
+    # serve.latency_s.<tenant> histograms the serve loop mirrors into
+    # its process-global metrics
+    _prefix = "serve.requests."
+    tenants = sorted(k[len(_prefix):] for k in counters
+                     if k.startswith(_prefix))
+    tbreak = health.get("tenant_breakers", {}) or {}
+    if tenants:
+        lines.append(f"{'tenant':<16}{'q/s':>8}{'p50ms':>9}{'p99ms':>9}"
+                     f"{'reqs':>8}  breaker")
+        for t in tenants:
+            reqs = counters.get(f"serve.requests.{t}", 0)
+            if prev_counters is not None and interval > 0:
+                d = reqs - prev_counters.get(f"serve.requests.{t}", 0)
+                qps = f"{d / interval:.1f}"
+            else:
+                qps = "-"
+            s = _hist_summary(hists, f"serve.latency_s.{t}")
+            p50 = f"{s['p50'] * 1e3:.1f}" if s else "-"
+            p99 = f"{s['p99'] * 1e3:.1f}" if s else "-"
+            br = (tbreak.get(t) or {}).get("state", "closed")
+            lines.append(f"{t:<16}{qps:>8}{p50:>9}{p99:>9}"
+                         f"{reqs:>8}  {br}")
+    else:
+        lines.append("tenants: (no requests served yet)")
+    if jobs_dir:
+        from hadoop_bam_tpu.jobs import job_info_doc, list_jobs
+        rows = [job_info_doc(i) for i in list_jobs(jobs_dir)]
+        active = [r for r in rows if r["status"] != "done"]
+        lines.append(f"jobs in {jobs_dir}: {len(rows)} journal(s), "
+                     f"{len(active)} not done")
+        for r in rows:
+            lines.append(
+                f"  {r['path']} {r['kind']} {r['status']} "
+                f"grain={r['resume_grain']} "
+                f"units={r['units_skipped']}/{r['units_total']} "
+                f"trace={r['trace_id'] or '-'}")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live introspection of a running ``hbam serve --port`` process:
+    polls the ``{"op": "health"}`` / ``{"op": "metrics"}`` transport
+    surfaces and renders per-tenant q/s, latency percentiles, cache hit
+    rates, pool occupancy, breaker + SLO burn state, and (with
+    ``--jobs-dir``) journaled-job resume progress."""
+    import time as _time
+
+    iterations = 1 if args.once else int(args.iterations)
+    prev_counters = None
+    i = 0
+    try:
+        while True:
+            i += 1
+            try:
+                health, mdoc = _top_fetch(args.host, args.port,
+                                          timeout=args.timeout)
+            except (OSError, ValueError) as e:
+                print(f"error: cannot poll {args.host}:{args.port}: "
+                      f"{e}", file=sys.stderr)
+                return 1
+            frame = _render_top(health, mdoc, prev_counters,
+                                float(args.interval), args.jobs_dir)
+            hdr = (f"-- hbam top {args.host}:{args.port} "
+                   f"(poll {i}"
+                   f"{'' if not iterations else f'/{iterations}'}) --")
+            print(hdr)
+            print(frame, flush=True)
+            prev_counters = {
+                k: int(v) for k, v in dict(
+                    (mdoc.get("metrics", {}) or {})
+                    .get("counters", {})).items()}
+            if iterations and i >= iterations:
+                return 0
+            _time.sleep(max(0.1, float(args.interval)))
+    except KeyboardInterrupt:
+        # ^C is the documented way out of the default forever loop
+        return 0
 
 
 # ---------------------------------------------------------------------------
@@ -998,6 +1162,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "plane / quarantine) waits before its "
                          "half-open re-probe (default "
                          "config.breaker_cooldown_s)")
+    sv.add_argument("--flight-dir", metavar="DIR", default=None,
+                    help="write flight-recorder incident dumps "
+                         "(breaker trips, plane demotions, deadline "
+                         "misses, serve errors) as redacted JSON here, "
+                         "rotation-capped (config.flight_dump_cap); "
+                         "without it the always-on ring is memory-only "
+                         "and still served via {\"op\": \"health\"}")
     _add_obs_flags(sv)
     sv.set_defaults(fn=cmd_serve, uses_device=True)
 
@@ -1075,7 +1246,33 @@ def build_parser() -> argparse.ArgumentParser:
                     default=None, metavar="PATH",
                     help="inspect specific journal file(s) instead of "
                          "scanning a directory")
+    jb.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON object per journal "
+                         "(trace_id, resume_grain, units skipped/total) "
+                         "— the parser `hbam top` and external "
+                         "schedulers share")
     jb.set_defaults(fn=cmd_jobs, uses_device=False)
+
+    tp = sub.add_parser(
+        "top",
+        help="live ops view of a running `hbam serve --port` process: "
+             "per-tenant q/s + p50/p99, cache hit rates, pool "
+             "occupancy, breaker + SLO burn state, job resume progress")
+    tp.add_argument("--host", default="127.0.0.1")
+    tp.add_argument("--port", type=int, required=True,
+                    help="the serve process's TCP port")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls (default 2)")
+    tp.add_argument("--iterations", type=int, default=0,
+                    help="stop after N polls (0 = until ^C)")
+    tp.add_argument("--once", action="store_true",
+                    help="poll exactly once and exit (scripting shape)")
+    tp.add_argument("--timeout", type=float, default=10.0,
+                    help="per-poll socket timeout")
+    tp.add_argument("--jobs-dir", default=None, metavar="DIR",
+                    help="also render *.hbam-journal resume progress "
+                         "from DIR (the `hbam jobs --json` document)")
+    tp.set_defaults(fn=cmd_top, uses_device=False)
 
     vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos) "
                                          "(external spill-merge)")
@@ -1128,13 +1325,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     # init (or grab the accelerator) at startup
     if getattr(args, "uses_device", False) or getattr(args, "mesh", False):
         _resilient_backend()
-    try:
-        return args.fn(args)
-    except (ValueError, OSError) as e:
-        # covers the classified taxonomy too: PlanError is a ValueError,
-        # TransientIOError (shed load / blown deadline) an OSError
-        print(f"error: {e}", file=sys.stderr)
-        return 1
+    # one TraceContext per CLI invocation: the verb is an entry point,
+    # and every span / journal line / flight-ring entry the verb
+    # produces carries this trace id (obs/context.py)
+    from hadoop_bam_tpu.obs.context import trace_context
+    with trace_context(op=f"cli.{getattr(args, 'verb', '?')}"):
+        try:
+            return args.fn(args)
+        except (ValueError, OSError) as e:
+            # covers the classified taxonomy too: PlanError is a
+            # ValueError, TransientIOError (shed load / blown deadline)
+            # an OSError
+            from hadoop_bam_tpu.obs import flight
+            flight.recorder().dump(
+                f"cli_error:{getattr(args, 'verb', '?')}", error=str(e))
+            print(f"error: {e}", file=sys.stderr)
+            return 1
 
 
 if __name__ == "__main__":

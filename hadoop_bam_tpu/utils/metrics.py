@@ -36,8 +36,40 @@ import time
 from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
+from hadoop_bam_tpu.obs import context as trace_ctx
+from hadoop_bam_tpu.obs import flight as _flight
 from hadoop_bam_tpu.obs.hist import Histogram
 from hadoop_bam_tpu.obs.trace import active_recorder
+
+# span-args size guard: a pathological path/region/repr string passed as
+# a span attr must not bloat the trace ring or the flight recorder —
+# values are truncated and the key set is capped before any recording
+_SPAN_ARG_MAX_CHARS = 120
+_SPAN_ARG_MAX_KEYS = 8
+
+
+def trim_span_args(args: Dict[str, object]) -> Dict[str, object]:
+    """Bound one span's attr payload: at most ``_SPAN_ARG_MAX_KEYS``
+    keys (insertion order wins; a ``dropped_args`` count marks the cut),
+    scalar values pass through, everything else is stringified and
+    truncated to ``_SPAN_ARG_MAX_CHARS`` with the elided length noted."""
+    out: Dict[str, object] = {}
+    dropped = 0
+    for k, v in args.items():
+        if len(out) >= _SPAN_ARG_MAX_KEYS:
+            dropped += 1
+            continue
+        if isinstance(v, (int, float, bool)) or v is None:
+            out[k] = v
+            continue
+        s = v if isinstance(v, str) else repr(v)
+        if len(s) > _SPAN_ARG_MAX_CHARS:
+            s = (s[:_SPAN_ARG_MAX_CHARS]
+                 + f"...(+{len(s) - _SPAN_ARG_MAX_CHARS})")
+        out[k] = s
+    if dropped:
+        out["dropped_args"] = dropped
+    return out
 
 
 class Metrics:
@@ -80,6 +112,28 @@ class Metrics:
         with self._lock:
             h = self.histograms.get(name)
             return h.summary() if h is not None else {}
+
+    def hist_dict(self, name: str) -> Dict[str, object]:
+        """One histogram's full mergeable state ({} when absent) — the
+        targeted read the SLO engine's admission-path burn check uses
+        instead of serializing the whole instance with ``to_dict``."""
+        with self._lock:
+            h = self.histograms.get(name)
+            return h.to_dict() if h is not None else {}
+
+    def discard_series(self, *names: str) -> None:
+        """Remove the named series (counter/timer/wall/histogram entries
+        of exactly these names) — the eviction hook for bounded
+        per-tenant series in a long-lived server.  Unknown names are
+        ignored."""
+        with self._lock:
+            for n in names:
+                self.counters.pop(n, None)
+                self.timers.pop(n, None)
+                self.timer_calls.pop(n, None)
+                self.wall_timers.pop(n, None)
+                self.wall_calls.pop(n, None)
+                self.histograms.pop(n, None)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         """Consistent copy of all counters/timers (one lock acquisition) —
@@ -143,30 +197,46 @@ class Metrics:
         """Record an externally-measured wall span (the FeedPipeline's
         packer/dispatch accounting measures its own intervals).  When
         tracing is enabled and the caller passes its ``perf_counter``
-        start ``t0``, the interval also lands in the trace ring."""
+        start ``t0``, the interval also lands in the trace ring — with
+        the active trace id and parent span, so externally-measured
+        intervals join the request's causal tree.  Every add_wall also
+        feeds the always-on flight recorder."""
+        if args:
+            args = trim_span_args(args)
         with self._lock:
             self.wall_timers[name] += seconds
             self.wall_calls[name] += 1
         if t0 is not None:
             rec = active_recorder()
             if rec is not None:
-                rec.complete(name, t0, seconds, args)
+                ev_args = dict(args) if args else {}
+                ctx = trace_ctx.current_trace()
+                if ctx is not None:
+                    ev_args["trace"] = ctx.trace_id
+                    ev_args["psid"] = ctx.span_id
+                rec.complete(name, t0, seconds, ev_args or None)
+        _flight.recorder().record_span(name, seconds, args or None)
 
     @contextlib.contextmanager
     def span(self, name: str, **args) -> Iterator[None]:
         """A STAGE SPAN: ``wall_timer`` aggregation plus, when tracing is
         enabled (``obs.trace.enable_tracing``), one trace-ring event per
-        occurrence — name, thread, duration, and the keyword ``args``
-        (byte counts, record counts) — and a ``jax.profiler``
-        TraceAnnotation when jax is active.  Tracing disabled, this IS
-        ``wall_timer`` plus one module-global read (the bench's
-        ``obs_overhead_pct`` row pins the cost <2%)."""
+        occurrence — name, thread, duration, the keyword ``args``
+        (byte counts, record counts; size-guarded by ``trim_span_args``)
+        and the active ``TraceContext``'s (trace, sid, psid) causal ids
+        — and a ``jax.profiler`` TraceAnnotation when jax is active.
+        Every completion ALSO lands in the always-on flight recorder
+        ring (one deque append).  Tracing disabled, this is
+        ``wall_timer`` plus the flight append (the bench's
+        ``obs_overhead_pct`` row pins the whole cost <2%)."""
         rec = active_recorder()
-        if rec is None:
-            with self.wall_timer(name):
-                yield
-            return
-        ann = rec.annotation(name)
+        if args:
+            args = trim_span_args(args)
+        # child-span bookkeeping only while tracing (the causal ids are
+        # for the exported tree; the flight ring needs just the trace id,
+        # which it reads from the contextvar itself)
+        ids = trace_ctx.begin_span() if rec is not None else None
+        ann = rec.annotation(name) if rec is not None else None
         t0 = time.perf_counter()
         try:
             if ann is not None:
@@ -176,7 +246,20 @@ class Metrics:
                 with self.wall_timer(name):
                     yield
         finally:
-            rec.complete(name, t0, time.perf_counter() - t0, args or None)
+            dur = time.perf_counter() - t0
+            if rec is not None:
+                ev_args = dict(args) if args else {}
+                if ids is not None:
+                    tok, tid, sid, psid = ids
+                    ev_args["trace"] = tid
+                    ev_args["sid"] = sid
+                    ev_args["psid"] = psid
+                    try:
+                        trace_ctx.end_span(tok)
+                    except ValueError:
+                        pass   # closed from another context: ids stand
+                rec.complete(name, t0, dur, ev_args or None)
+            _flight.recorder().record_span(name, dur, args or None)
 
     @contextlib.contextmanager
     def trace(self, name: str) -> Iterator[None]:

@@ -229,6 +229,24 @@ def cancel_background() -> int:
     return cancelled
 
 
+def pool_stats() -> dict:
+    """Occupancy snapshot of the shared decode pool for the health/
+    `hbam top` surfaces: worker count, how many pool threads exist (a
+    lazy executor only spawns them under load), and the background
+    gate's running/queued depths.  Never materializes the pool."""
+    with _LOCK:
+        pool, size = _POOL, _POOL_SIZE
+    with _BG_LOCK:
+        bg_running, bg_queued = _BG_RUNNING[0], len(_BG_QUEUE)
+    out = {"workers": size, "threads_live": 0,
+           "bg_running": bg_running, "bg_queued": bg_queued}
+    if pool is not None:
+        out["threads_live"] = len(getattr(pool, "_threads", ()) or ())
+        out["queued_tasks"] = getattr(pool, "_work_queue").qsize() \
+            if hasattr(pool, "_work_queue") else 0
+    return out
+
+
 def set_decode_pool(pool: Optional[cf.ThreadPoolExecutor],
                     size: Optional[int] = None
                     ) -> Tuple[Optional[cf.ThreadPoolExecutor], int]:

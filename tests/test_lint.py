@@ -591,6 +591,102 @@ def test_ob_outside_hot_paths_not_scoped():
 
 
 # ---------------------------------------------------------------------------
+# OB603: entry points must mint/propagate a TraceContext
+# ---------------------------------------------------------------------------
+
+_OB_UNTRACED_ENTRY = '''
+def handle_stream(loop, rfile, wfile):
+    for line in rfile:                    # OB603: starts work with no
+        fut = loop.submit(line)           # TraceContext minted
+        fut.result()
+'''
+
+_OB_TRACED_ENTRY = '''
+from hadoop_bam_tpu.obs.context import trace_context
+
+def handle_stream(loop, rfile, wfile):
+    for line in rfile:
+        with trace_context(op="serve.request"):
+            fut = loop.submit(line)
+            fut.result()
+'''
+
+_OB_CLI_MAIN_MINTS = '''
+from hadoop_bam_tpu.obs.context import trace_context
+
+def cmd_sort(args):
+    return run_sort(args.input)
+
+def main(argv=None):
+    args = parse(argv)
+    with trace_context(op=f"cli.{args.verb}"):
+        return args.fn(args)
+'''
+
+_OB_CLI_NO_MAIN_MINT = '''
+def cmd_sort(args):
+    return run_sort(args.input)
+
+def main(argv=None):
+    args = parse(argv)
+    return args.fn(args)
+'''
+
+
+def test_ob603_untraced_entry_point_fires():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/serve/bad_entry.py": _OB_UNTRACED_ENTRY},
+        only=["obs"])
+    assert rules_of(findings) == {"OB603"}
+    assert "TraceContext" in findings[0].message
+
+
+def test_ob603_traced_entry_point_passes():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/serve/good_entry.py": _OB_TRACED_ENTRY},
+        only=["obs"])
+    assert findings == []
+
+
+def test_ob603_cli_verbs_covered_by_main_mint():
+    # the CLI-frontend idiom: one trace_context in main() covers every
+    # cmd_* verb it dispatches to
+    findings = lint_sources(
+        {"hadoop_bam_tpu/tools/cli.py": _OB_CLI_MAIN_MINTS},
+        only=["obs"])
+    assert findings == []
+    # ...but a main() that does NOT mint leaves the verbs flagged
+    findings = lint_sources(
+        {"hadoop_bam_tpu/tools/cli.py": _OB_CLI_NO_MAIN_MINT},
+        only=["obs"])
+    assert rules_of(findings) == {"OB603"}
+
+
+def test_ob603_jobs_entry_and_scope():
+    # run_job_level in jobs/ is an entry point...
+    findings = lint_sources({"hadoop_bam_tpu/jobs/bad_runner.py": '''
+def run_job_level(journal_path, kind, run):
+    return run()
+'''}, only=["obs"])
+    assert rules_of(findings) == {"OB603"}
+    # ...the same code outside the entry scope is not in scope
+    findings = lint_sources({"hadoop_bam_tpu/split/elsewhere.py": '''
+def run_job_level(journal_path, kind, run):
+    return run()
+'''}, only=["obs"])
+    assert findings == []
+
+
+def test_ob603_entry_point_with_no_work_passes():
+    # an entry-point NAME that starts no work (pure accessor) is fine
+    findings = lint_sources({"hadoop_bam_tpu/serve/idle.py": '''
+def submit(self):
+    return self._queue
+'''}, only=["obs"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # decode-path copy discipline (DP7xx)
 # ---------------------------------------------------------------------------
 

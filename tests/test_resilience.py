@@ -164,6 +164,55 @@ def test_breaker_probe_budget_multiple():
     assert b.allow() and b.allow() and not b.allow()
 
 
+def test_breaker_trip_writes_flight_dump(tmp_path):
+    """THE flight-recorder acceptance pin: a breaker trip auto-dumps a
+    redacted snapshot containing the trip transition, the triggering
+    request's trace_id, and the prior span completions — and the dump
+    directory honors the rotation cap."""
+    from hadoop_bam_tpu.obs import flight
+    from hadoop_bam_tpu.obs.context import trace_context
+
+    fr = flight.reset()
+    fr.configure(dump_dir=str(tmp_path), dump_cap=2)
+    try:
+        clk = FakeClock()
+        br = CircuitBreaker(failure_threshold=3.0, window_s=30.0,
+                            cooldown_s=5.0, clock=clk,
+                            name="tenant/web")
+        with trace_context(op="serve.request", tenant="web") as ctx:
+            # the request does some work (span completions land in the
+            # always-on ring), then its failures trip the breaker
+            for i in range(4):
+                with METRICS.span("bam.fetch_wall", chunk=i):
+                    pass
+                br.record_failure()
+        files = sorted(f for f in os.listdir(tmp_path)
+                       if f.endswith(".json"))
+        assert len(files) == 1          # exactly one trip, one dump
+        doc = json.load(open(os.path.join(str(tmp_path), files[0])))
+        assert doc["reason"] == "breaker_open:tenant/web"
+        # the triggering request's trace id, at dump time and on the
+        # recorded transition
+        assert doc["trace"] == ctx.trace_id
+        trips = [t for t in doc["transitions"]
+                 if t["kind"] == "breaker" and t["state"] == "open"]
+        assert trips and trips[-1]["name"] == "tenant/web"
+        assert trips[-1]["trace"] == ctx.trace_id
+        # the prior N span completions, attributed to the same trace
+        prior = [s for s in doc["spans"] if s["name"] == "bam.fetch_wall"]
+        assert len(prior) >= 3
+        assert all(s["trace"] == ctx.trace_id for s in prior)
+        # rotation cap: five more incidents leave at most cap files
+        for k in range(5):
+            CircuitBreaker(failure_threshold=1.0, clock=clk,
+                           name=f"tenant/t{k}").record_failure()
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+        assert len(files) == 2
+        assert fr.dumps_written == 6
+    finally:
+        flight.reset()
+
+
 # ---------------------------------------------------------------------------
 # demotion ladder: flagstat demotes then heals, byte-identical throughout
 # ---------------------------------------------------------------------------
@@ -440,6 +489,10 @@ def test_transport_error_lines_carry_retry_after():
     handle_stream(loop, io.StringIO(
         '{"id": 7, "path": "x.bam", "region": "chr1:1-10"}\n'), out)
     doc = json.loads(out.getvalue().strip())
+    # the PR-14 request-id contract: every response line echoes the
+    # request's trace id (16 hex chars)
+    trace = doc.pop("trace")
+    assert isinstance(trace, str) and len(trace) == 16
     assert doc == {"id": 7, "error": "shed", "kind": "transient",
                    "retry_after_s": 0.25}
 

@@ -1,0 +1,632 @@
+"""Causal tracing & live ops plane tests (``pytest -m obs``): the
+end-to-end TraceContext contract (one serve request = ONE Chrome-trace
+tree under a single trace_id, across the transport, dispatcher, decode
+pool and tile-build threads), the span-attrs size guard, the flight
+recorder's ring/redaction/rotation, SLO multi-window burn accounting
+(a synthetic latency regression flips the fast window before the slow
+one), merge_metrics classification over the post-PR-6 counter
+families, ``hbam jobs --json``, and the ``hbam top`` CLI e2e against a
+live TCP serve process.
+"""
+import dataclasses
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from hadoop_bam_tpu.obs import (
+    disable_tracing, enable_tracing, flight,
+)
+from hadoop_bam_tpu.obs.context import (
+    current_trace, current_trace_id, ensure_trace, trace_context,
+)
+from hadoop_bam_tpu.obs.slo import BurnWindow, SloEngine, SloObjective
+from hadoop_bam_tpu.utils.metrics import (
+    METRICS, Metrics, MetricsContext, trim_span_args,
+)
+
+from fixtures import make_header, make_records
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Tracing off and a pristine (memory-only) flight recorder around
+    every test — the recorder is process-global."""
+    disable_tracing()
+    flight.reset()
+    yield
+    disable_tracing()
+    flight.reset()
+
+
+# ---------------------------------------------------------------------------
+# TraceContext basics
+# ---------------------------------------------------------------------------
+
+def test_trace_context_mints_and_restores():
+    assert current_trace() is None
+    with trace_context(op="cli.test", tenant="t") as ctx:
+        assert current_trace() is ctx
+        assert len(ctx.trace_id) == 16 and ctx.span_id == 0
+        assert ctx.op == "cli.test" and ctx.tenant == "t"
+        with trace_context(op="inner") as inner:
+            assert inner.trace_id != ctx.trace_id
+        assert current_trace() is ctx
+    assert current_trace() is None
+
+
+def test_ensure_trace_joins_active_and_mints_when_absent():
+    with ensure_trace(op="lib.call") as minted:
+        assert current_trace_id() == minted.trace_id
+        with ensure_trace(op="nested") as joined:
+            assert joined is minted        # joined, not re-minted
+    assert current_trace() is None
+
+
+def test_trace_rides_the_decode_pool():
+    import concurrent.futures as cf
+
+    from hadoop_bam_tpu.utils import pools
+
+    pool = cf.ThreadPoolExecutor(max_workers=2)
+    try:
+        with trace_context(op="t") as ctx:
+            fut = pools.submit(pool, current_trace_id)
+            assert fut.result() == ctx.trace_id
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# span attrs size guard (satellite)
+# ---------------------------------------------------------------------------
+
+def test_trim_span_args_truncates_and_caps():
+    big = "x" * 10_000
+    out = trim_span_args({"path": big, "n": 7, "f": 1.5, "flag": True})
+    assert len(out["path"]) < 200 and out["path"].endswith("(+9880)")
+    assert out["n"] == 7 and out["f"] == 1.5 and out["flag"] is True
+    # non-string values stringify + truncate
+    out = trim_span_args({"region": list(range(5000))})
+    assert isinstance(out["region"], str) and len(out["region"]) < 200
+    # key cap: first 8 kept, the cut is marked
+    many = {f"k{i:02d}": i for i in range(12)}
+    out = trim_span_args(many)
+    assert len(out) == 9 and out["dropped_args"] == 4
+    assert "k00" in out and "k11" not in out
+
+
+def test_span_with_pathological_args_stays_bounded_in_ring():
+    rec = enable_tracing(256)
+    m = Metrics()
+    with m.span("x.guard_wall", path="p" * 50_000, region="r" * 9000):
+        pass
+    ev = [e for e in rec.events() if e[0] == "x.guard_wall"][-1]
+    args = ev[5]
+    assert len(args["path"]) < 200 and len(args["region"]) < 200
+    # and the flight ring got the same bounded payload
+    fe = [e for e in flight.recorder()._spans
+          if e[1] == "x.guard_wall"][-1]
+    assert len(fe[5]["path"]) < 200
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: one serve request = ONE trace tree
+# ---------------------------------------------------------------------------
+
+def _write_indexed_bam(path, n=2000, seed=7):
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.split.bai import write_bai
+
+    header = make_header(2)
+
+    def key(r):
+        rid = (header.ref_names.index(r.rname) if r.rname != "*"
+               else 1 << 30)
+        return (rid, r.pos)
+
+    recs = sorted(make_records(header, n, seed=seed), key=key)
+    with BamWriter(path, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    write_bai(path)
+    return header
+
+
+@pytest.fixture(scope="module")
+def traced_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("traceops") / "t.bam")
+    _write_indexed_bam(path)
+    return path
+
+
+def test_serve_request_exports_one_trace_tree(traced_bam):
+    from hadoop_bam_tpu.serve import ServeLoop, handle_stream
+
+    rec = enable_tracing(1 << 15)
+    out = io.StringIO()
+    req = {"id": 1, "path": traced_bam,
+           "regions": ["chr1:1000-200000", "chr2:1-5000"],
+           "tenant": "web"}
+    with ServeLoop() as loop:
+        handle_stream(loop, io.StringIO(json.dumps(req) + "\n"), out)
+    resp = json.loads(out.getvalue().strip())
+    assert "results" in resp, resp
+    trace_id = resp["trace"]
+    assert isinstance(trace_id, str) and len(trace_id) == 16
+
+    evs = [e for e in rec.events()
+           if e[5] and e[5].get("trace") == trace_id]
+    names = {e[0] for e in evs}
+    # the causal chain: dispatcher request span, pool-side chunk
+    # decode, staging-ring tile build (the device dispatch), the mesh
+    # filter, and the response write — all under ONE trace id
+    assert {"serve.request_wall", "query.decode_wall",
+            "serve.tile_build_wall", "serve.filter_wall",
+            "serve.response_wall"} <= names
+    # across more than one thread (dispatcher + decode pool)
+    assert len({e[4] for e in evs}) >= 2
+    # well-formed tree: every parent id is the trace root (0) or
+    # another event of the SAME trace
+    sids = {e[5]["sid"] for e in evs}
+    assert all(e[5]["psid"] == 0 or e[5]["psid"] in sids for e in evs)
+    # nothing else in the ring claims this trace id, and the serve
+    # request produced no orphan spans under other trace ids from
+    # this request's threads
+    assert len(evs) >= 5
+
+    # the Chrome export carries the same causal args verbatim
+    doc = rec.chrome_trace()
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"
+          and e.get("args", {}).get("trace") == trace_id]
+    assert {e["name"] for e in xs} == names
+    json.dumps(doc)
+
+
+def test_two_requests_get_two_disjoint_traces(traced_bam):
+    from hadoop_bam_tpu.serve import ServeLoop, handle_stream
+
+    rec = enable_tracing(1 << 15)
+    out = io.StringIO()
+    lines = "".join(json.dumps(
+        {"id": i, "path": traced_bam, "region": "chr1:1000-100000"})
+        + "\n" for i in (1, 2))
+    with ServeLoop() as loop:
+        handle_stream(loop, io.StringIO(lines), out)
+    docs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    traces = {d["trace"] for d in docs}
+    assert len(traces) == 2
+    by_trace = {t: {e[0] for e in rec.events()
+                    if e[5] and e[5].get("trace") == t}
+                for t in traces}
+    for t in traces:
+        assert "serve.request_wall" in by_trace[t]
+
+
+def test_client_supplied_trace_id_is_adopted(traced_bam):
+    from hadoop_bam_tpu.serve import ServeLoop, handle_stream
+
+    out = io.StringIO()
+    req = {"id": 9, "path": traced_bam, "region": "chr2:1-5000",
+           "trace": "feedc0dedeadbeef"}
+    with ServeLoop() as loop:
+        handle_stream(loop, io.StringIO(json.dumps(req) + "\n"), out)
+    resp = json.loads(out.getvalue().strip())
+    assert resp["trace"] == "feedc0dedeadbeef"
+
+
+def test_hostile_client_trace_id_is_replaced(traced_bam):
+    # an oversized / non-token "trace" must NOT ride into the rings and
+    # dumps: the server mints a fresh id instead
+    from hadoop_bam_tpu.serve import ServeLoop, handle_stream
+
+    for bad in ("x" * 100_000, "has spaces\n", 7, ""):
+        out = io.StringIO()
+        req = {"id": 1, "path": traced_bam, "region": "chr2:1-5000",
+               "trace": bad}
+        with ServeLoop() as loop:
+            handle_stream(loop, io.StringIO(json.dumps(req) + "\n"),
+                          out)
+        resp = json.loads(out.getvalue().strip())
+        assert resp["trace"] != bad and len(resp["trace"]) == 16
+
+
+def test_per_tenant_series_are_lru_bounded(traced_bam):
+    from hadoop_bam_tpu.serve import ServeLoop
+
+    cfg = dataclasses.replace(
+        __import__("hadoop_bam_tpu.config",
+                   fromlist=["DEFAULT_CONFIG"]).DEFAULT_CONFIG,
+        serve_max_tenants=3)
+    with ServeLoop(config=cfg) as loop:
+        for i in range(6):
+            loop.query(traced_bam, ["chr2:1-5000"], tenant=f"lru-{i}")
+        m = loop.slo_metrics
+        live = [t for t in (f"lru-{i}" for i in range(6))
+                if m.get(f"serve.requests.{t}")]
+        # only the newest serve_max_tenants tenants keep series; the
+        # evicted ones' keys were discarded from the process-global
+        # metrics (arbitrary tenant strings cannot grow it forever)
+        assert live == ["lru-3", "lru-4", "lru-5"]
+        assert m.hist_summary("serve.latency_s.lru-0") == {}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_is_bounded_and_always_on():
+    fr = flight.reset(capacity=32)
+    m = Metrics()
+    for i in range(100):
+        with m.span(f"f.s{i}_wall"):
+            pass
+    assert len(fr._spans) == 32          # bounded, tracing DISABLED
+    snap = fr.snapshot(reason="test")
+    assert len(snap["spans"]) == 32
+    assert snap["spans"][-1]["name"] == "f.s99_wall"
+
+
+def test_flight_snapshot_redacts_and_carries_trace():
+    fr = flight.recorder()
+    with trace_context(op="t") as ctx:
+        METRICS.add_wall("f.redact_wall", 0.001, t0=time.perf_counter(),
+                         args={"auth_token": "hunter2", "path": "ok"})
+        snap = fr.snapshot(reason="r")
+        assert snap["trace"] == ctx.trace_id
+    ev = [s for s in snap["spans"] if s["name"] == "f.redact_wall"][-1]
+    assert ev["args"]["auth_token"] == "[redacted]"
+    assert ev["args"]["path"] == "ok"
+    assert ev["trace"] == ctx.trace_id
+
+
+def test_flight_dump_rotation_cap(tmp_path):
+    fr = flight.recorder()
+    fr.configure(dump_dir=str(tmp_path), dump_cap=3)
+    for i in range(7):
+        assert fr.dump(f"reason_{i}") is not None
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 3
+    # newest survive (sortable timestamped names)
+    assert all(f.startswith("flight-") and f.endswith(".json")
+               for f in files)
+    doc = json.load(open(tmp_path / files[-1]))
+    assert doc["reason"] == "reason_6"
+
+
+def test_flight_dump_disabled_without_dir():
+    fr = flight.recorder()
+    assert fr.dump_dir is None
+    assert fr.dump("no_dir") is None
+    assert fr.dumps_written == 0
+
+
+def test_deadline_miss_records_flight_transition():
+    from hadoop_bam_tpu.query.scheduler import Deadline
+
+    fr = flight.recorder()
+    fake = [0.0]
+    d = Deadline(0.01, clock=lambda: fake[0])
+    fake[0] = 1.0
+    assert d.expired
+    d.book_miss()
+    kinds = [(t[1], t[3]) for t in fr._transitions]
+    assert ("deadline", "missed") in kinds
+
+
+# ---------------------------------------------------------------------------
+# SLO burn accounting
+# ---------------------------------------------------------------------------
+
+def _slo_engine(clock):
+    eng = SloEngine(windows=(BurnWindow("fast", 300.0, 14.4),
+                             BurnWindow("slow", 3600.0, 3.0)),
+                    clock=clock, tick_s=0.0, min_events=32)
+    eng.add(SloObjective(name="latency/web", source="svc.latency_s",
+                         threshold_s=0.05, target=0.99))
+    return eng
+
+
+def test_slo_regression_flips_fast_window_before_slow():
+    now = [0.0]
+    m = Metrics()
+    eng = _slo_engine(lambda: now[0])
+    # an hour of healthy traffic: 100 good requests per minute
+    for t in range(0, 3601, 60):
+        now[0] = float(t)
+        m.observe("svc.latency_s", 0.01, n=100)
+        eng.tick(m, force=True)
+    healthy = eng.burn_rates(m)["latency/web"]
+    assert healthy["fast"] == 0.0 and healthy["slow"] == 0.0
+    assert eng.burning("latency/web", m) is None
+    # synthetic latency regression: 150 slow requests right now
+    m.observe("svc.latency_s", 1.0, n=150)
+    rates = eng.burn_rates(m)["latency/web"]
+    # the fast window is dominated by the regression...
+    assert rates["fast"] >= 14.4
+    # ...while the slow window still amortizes it over the healthy hour
+    assert rates["slow"] < 3.0
+    assert eng.burning("latency/web", m) == "fast"
+    # sustained regression eventually flips the slow window too —
+    # fast-before-slow is an ORDER, not an exemption
+    for t in range(3660, 7261, 60):
+        now[0] = float(t)
+        m.observe("svc.latency_s", 1.0, n=100)
+        eng.tick(m, force=True)
+    rates = eng.burn_rates(m)["latency/web"]
+    assert rates["slow"] >= 3.0
+
+
+def test_slo_min_events_suppresses_cold_tenants():
+    now = [0.0]
+    eng = _slo_engine(lambda: now[0])
+    m = Metrics()
+    m.observe("svc.latency_s", 9.0, n=5)     # 5 terrible requests
+    eng.tick(m, force=True)
+    now[0] = 60.0
+    # below min_events: burn reads 0, nothing pages
+    assert eng.burn_rates(m)["latency/web"]["fast"] == 0.0
+
+
+def test_slo_prometheus_series_shape():
+    now = [0.0]
+    eng = _slo_engine(lambda: now[0])
+    m = Metrics()
+    m.observe("svc.latency_s", 1.0, n=100)
+    eng.tick(m, force=True)
+    now[0] = 10.0
+    lines = eng.prometheus_lines(m)
+    assert lines[0] == "# TYPE hbam_slo_burn_rate gauge"
+    assert any(ln.startswith(
+        'hbam_slo_burn_rate{slo="latency/web",window="fast"} ')
+        for ln in lines)
+    assert any('window="slow"' in ln for ln in lines)
+
+
+def test_slo_error_rate_objective_reads_counters():
+    now = [0.0]
+    eng = SloEngine(windows=(BurnWindow("fast", 300.0, 10.0),),
+                    clock=lambda: now[0], tick_s=0.0, min_events=10)
+    eng.add(SloObjective(name="errors/api", source="api.requests",
+                         bad_source="api.errors", kind="errors",
+                         target=0.999))
+    m = Metrics()
+    m.count("api.requests", 1000)
+    eng.tick(m, force=True)
+    now[0] = 100.0
+    m.count("api.requests", 100)
+    m.count("api.errors", 10)
+    rates = eng.burn_rates(m)["errors/api"]
+    assert rates["fast"] == pytest.approx((10 / 100) / 0.001, rel=0.01)
+
+
+def test_slo_batch_shed_pressure_feeds_tenancy():
+    from hadoop_bam_tpu.serve.tenancy import TenantQuotas
+    from hadoop_bam_tpu.utils.errors import TransientIOError
+
+    quotas = TenantQuotas()
+
+    class Burning:
+        def burning(self, name, *a, **k):
+            return "fast" if name == "latency/bulk" else None
+
+    quotas.slo_engine = Burning()
+    # burning tenant: batch sheds with a classified, hinted error...
+    with pytest.raises(TransientIOError) as ei:
+        with quotas.admit("bulk", priority="batch"):
+            pass
+    assert ei.value.retry_after_s is not None
+    assert METRICS.get("slo.batch_shed") >= 1
+    # ...interactive for the same tenant still admits
+    with quotas.admit("bulk", priority="interactive") as d:
+        assert d is not None
+    # ...and a healthy tenant's batch admits
+    with quotas.admit("calm", priority="batch") as d:
+        assert d is not None
+
+
+def test_serve_loop_installs_per_tenant_objectives(traced_bam):
+    from hadoop_bam_tpu.serve import ServeLoop
+
+    with ServeLoop() as loop:
+        loop.query(traced_bam, ["chr2:1-5000"], tenant="acct-7")
+        names = {o.name for o in loop.slo.objectives()}
+        assert {"latency/_all", "latency/acct-7"} <= names
+        # the mirrored per-tenant series exist in the server's
+        # process-global metrics (what `hbam top` polls)
+        assert loop.slo_metrics.get("serve.requests.acct-7") == 1
+        assert loop.slo_metrics.hist_summary(
+            "serve.latency_s.acct-7")["count"] == 1
+
+
+def test_transport_metrics_op_json_and_prometheus(traced_bam):
+    from hadoop_bam_tpu.serve import ServeLoop, handle_stream
+
+    out = io.StringIO()
+    with ServeLoop() as loop:
+        # serve a request to completion first (handle_stream waits for
+        # every response), THEN poll the metrics ops on a second stream
+        # — the ops are answered inline on the reader thread
+        handle_stream(loop, io.StringIO(json.dumps(
+            {"id": 1, "path": traced_bam, "region": "chr2:1-5000",
+             "tenant": "mop"}) + "\n"), out)
+        handle_stream(loop, io.StringIO(
+            json.dumps({"id": 2, "op": "metrics"}) + "\n"
+            + json.dumps({"id": 3, "op": "metrics",
+                          "format": "prometheus"}) + "\n"), out)
+    docs = {d["id"]: d for d in
+            (json.loads(ln) for ln in out.getvalue().splitlines())}
+    snap = docs[2]["metrics"]
+    assert snap["counters"].get("serve.requests.mop") == 1
+    assert "slo" in docs[2] and "latency/_all" in docs[2]["slo"]
+    # SLO burn-rate series in the Prometheus exposition (acceptance)
+    text = docs[3]["prometheus"]
+    assert "# TYPE hbam_slo_burn_rate gauge" in text
+    assert 'hbam_slo_burn_rate{slo="latency/_all",window="fast"}' in text
+    assert 'hbam_slo_burn_rate{slo="latency/mop",window="slow"}' in text
+    assert "hbam_serve_requests_mop_total" in text
+
+
+# ---------------------------------------------------------------------------
+# merge_metrics over the post-PR-6 counter families (satellite)
+# ---------------------------------------------------------------------------
+
+_FAMILY_COUNTERS = (
+    "serve.requests", "serve.tile_hits", "serve.prefetch_issued",
+    "cohort.samples_quarantined", "cohort.duplicate_sites",
+    "jobs.rounds_skipped", "jobs.journal_records",
+    "write.bytes_out", "write.records", "obs.flight_dumps",
+)
+_FAMILY_WALLS = (
+    "serve.request_wall", "serve.tile_build_wall", "cohort.join_wall",
+    "write.deflate_wall", "write.commit_wall", "bam.fused_decode_wall",
+)
+
+
+def _family_host(seed):
+    m = Metrics()
+    for i, k in enumerate(_FAMILY_COUNTERS):
+        m.count(k, (seed + 1) * (i + 1))
+    for i, k in enumerate(_FAMILY_WALLS):
+        m.add_wall(k, 0.5 * (seed + 1) + 0.1 * i)
+    m.observe("serve.latency_s", 0.01 * (seed + 1), n=50)
+    m.observe("pool.task_run_s", 0.001 * (seed + 1), n=20)
+    return m
+
+
+def test_merge_metrics_families_sum_counters_max_walls():
+    hosts = [_family_host(s) for s in range(3)]
+    merged = Metrics()
+    for h in hosts:
+        merged.merge_dict(h.to_dict())
+    for i, k in enumerate(_FAMILY_COUNTERS):
+        # counters SUM across hosts (work adds) — pinned per family
+        assert merged.get(k) == (1 + 2 + 3) * (i + 1), k
+    for i, k in enumerate(_FAMILY_WALLS):
+        # wall spans take the MAX (hosts run concurrently; the mesh
+        # wall is the slowest host's union, never the sum)
+        assert merged.wall_timers[k] == pytest.approx(
+            0.5 * 3 + 0.1 * i), k
+    assert merged.hist_summary("serve.latency_s")["count"] == 150
+
+
+def test_merge_metrics_families_fold_order_invariant():
+    hosts = [_family_host(s) for s in range(4)]
+    ab = Metrics()
+    for h in hosts:
+        ab.merge_dict(h.to_dict())
+    ba = Metrics()
+    for h in reversed(hosts):
+        ba.merge_dict(h.to_dict())
+    a, b = ab.to_dict(), ba.to_dict()
+    assert a["counters"] == b["counters"]
+    assert a["wall_timers"] == b["wall_timers"]
+    assert a["histograms"]["serve.latency_s"]["buckets"] == \
+        b["histograms"]["serve.latency_s"]["buckets"]
+
+
+# ---------------------------------------------------------------------------
+# journal trace stamping + hbam jobs --json (satellite)
+# ---------------------------------------------------------------------------
+
+def _make_journal(tmp_path, resumed=True):
+    from hadoop_bam_tpu.jobs import JobJournal
+
+    jp = str(tmp_path / "job.hbam-journal")
+    with trace_context(op="job.test") as ctx:
+        first_trace = ctx.trace_id
+        jr, st = JobJournal.resume(jp, kind="mesh_sort_spill",
+                                   inputs=[], output=None,
+                                   fingerprint="fp",
+                                   params={"round_records": 10})
+        assert st is None
+        jr.unit_done("round", 0, run="r0.bin", size=1, crc="ab")
+        jr.close()
+    if resumed:
+        with trace_context(op="job.resume"):
+            jr2, st2 = JobJournal.resume(jp, kind="mesh_sort_spill",
+                                         inputs=[], output=None,
+                                         fingerprint="fp",
+                                         params={"round_records": 10})
+            assert st2 is not None and len(st2.units) == 1
+            jr2.unit_done("round", 1, run="r1.bin", size=1, crc="cd")
+            jr2.close()
+    return jp, first_trace
+
+
+def test_journal_lines_carry_trace_id(tmp_path):
+    jp, first_trace = _make_journal(tmp_path, resumed=False)
+    lines = [json.loads(ln) for ln in
+             open(jp, "rb").read().decode().splitlines()]
+    assert all(ln.get("trace") == first_trace for ln in lines)
+
+
+def test_jobs_json_shares_one_parser(tmp_path, capsys):
+    from hadoop_bam_tpu.jobs import job_info_doc, job_status
+    from hadoop_bam_tpu.tools import cli
+
+    jp, first_trace = _make_journal(tmp_path)
+    rc = cli.main(["jobs", str(tmp_path), "--json"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    (doc,) = [json.loads(ln) for ln in out]
+    # the CLI emits exactly job_info_doc's contract
+    assert doc == job_info_doc(job_status(jp))
+    assert doc["kind"] == "mesh_sort_spill"
+    assert doc["resume_grain"] == "round"
+    assert doc["status"] == "resumable"
+    assert doc["units_total"] == 2          # rounds 0 + 1 committed
+    assert doc["units_skipped"] == 1        # the resume skipped round 0
+    assert doc["resumes"] == 1
+    assert doc["trace_id"] == first_trace   # the MINTING invocation
+
+
+# ---------------------------------------------------------------------------
+# hbam top against a live serve process (acceptance e2e)
+# ---------------------------------------------------------------------------
+
+def test_hbam_top_renders_live_serve(traced_bam, tmp_path, capsys):
+    from hadoop_bam_tpu.serve import ServeLoop, make_tcp_server
+    from hadoop_bam_tpu.tools import cli
+
+    _make_journal(tmp_path)
+    with ServeLoop() as loop:
+        # live traffic so the per-tenant series exist
+        loop.query(traced_bam, ["chr1:1000-200000"], tenant="webtop")
+        loop.query(traced_bam, ["chr2:1-5000"], tenant="webtop")
+        server = make_tcp_server(loop, port=0)
+        _host, port = server.server_address[:2]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            rc = cli.main(["top", "--port", str(port), "--once",
+                           "--jobs-dir", str(tmp_path)])
+        finally:
+            server.shutdown()
+            server.server_close()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "hbam top" in out and "status=serving" in out
+    assert "pool: workers=" in out
+    assert "slo latency/_all:" in out
+    # the per-tenant table row with its request count and breaker state
+    assert "webtop" in out
+    line = next(ln for ln in out.splitlines() if ln.startswith("webtop"))
+    assert "closed" in line
+    # p50/p99 render as numbers for a tenant with traffic
+    assert line.split()[2] != "-" and line.split()[3] != "-"
+    # job resume progress from the shared `hbam jobs --json` document
+    assert "grain=round" in out and "units=1/2" in out
+
+
+def test_hbam_top_unreachable_port_errors_cleanly(capsys):
+    from hadoop_bam_tpu.tools import cli
+
+    rc = cli.main(["top", "--port", "1", "--once", "--timeout", "0.5"])
+    assert rc == 1
+    assert "cannot poll" in capsys.readouterr().err
