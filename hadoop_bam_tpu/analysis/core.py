@@ -159,8 +159,8 @@ def analyzers() -> Dict[str, Analyzer]:
     # import for registration side effects
     from hadoop_bam_tpu.analysis import (  # noqa: F401
         decodepath, devicesync, feedpath, jobsafety, layout, lockstep,
-        obsrules, querycache, servebounds, taxonomy, trace_safety,
-        writepath,
+        obsrules, planroute, querycache, servebounds, taxonomy,
+        trace_safety, writepath,
     )
     return dict(_REGISTRY)
 
@@ -260,7 +260,8 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                     "identity (QE5xx), observability discipline (OB6xx), "
                     "decode-path copy discipline (DP7xx), serving-tier "
                     "cache bounds (SV8xx), write-path atomicity/"
-                    "parallelism (WR10x)")
+                    "parallelism (WR10x), plane-routing discipline "
+                    "(PL101)")
     p.add_argument("--root", default=None,
                    help="package directory to analyze (default: the "
                         "installed hadoop_bam_tpu package)")

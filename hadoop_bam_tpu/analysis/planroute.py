@@ -1,0 +1,129 @@
+"""PL1xx — plane-routing discipline: decode-plane gating lives in
+``plan/executor.select_plane``, nowhere else.
+
+The plan/execute refactor exists because the routing matrix (3 decode
+planes x 5 driver families x {batch, query, serve, sort, write}) had
+its gating conditions — ``use_fused_decode``, ``inflate_backend``,
+``skip_bad_spans``, intervals — re-implemented per path; adding any
+plane or workload meant touching all of them, and the copies drifted.
+``select_plane`` is now the single predicate table; this analyzer keeps
+it that way:
+
+- PL101: a conditional test (``if``/``elif``, ternary, ``while``, or a
+  bare boolean ``and``/``or`` expression such as a returned gate) that
+  READS the plane-gating config knobs ``use_fused_decode`` or
+  ``inflate_backend`` (attribute or ``getattr(cfg, "...")`` form)
+  outside ``hadoop_bam_tpu/plan/``; ``skip_bad_spans`` fires only when
+  combined with another gate term in the same expression — a solo
+  ``if config.skip_bad_spans:`` is failure POLICY (quarantine vs
+  raise, ``decode_with_retry``'s legitimate read), not plane routing.
+
+Out of scope: the ``plan/`` package itself (the gates' one home),
+``config.py`` (which defines the knobs and resolves "auto"), and this
+``analysis/`` package.  Assignments and keyword arguments are never
+findings — ``dataclasses.replace(cfg, use_fused_decode=False)`` and
+``backend = resolve_inflate_backend(cfg)`` are how non-plan code is
+SUPPOSED to interact with the knobs.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from hadoop_bam_tpu.analysis.astutil import last_segment
+from hadoop_bam_tpu.analysis.core import Finding, Module, Project, register
+
+# knobs whose read in a conditional is a finding on its own
+SOLO_KNOBS = ("use_fused_decode", "inflate_backend")
+# knob that is failure policy alone but a gate when combined
+COMBO_KNOB = "skip_bad_spans"
+# identifier fragments that mark "another gate term" for the combo rule
+GATE_HINTS = ("fused", "backend", "plane", "intervals")
+
+EXCLUDE = (
+    "hadoop_bam_tpu/plan/",      # the gates' one home
+    "hadoop_bam_tpu/config.py",  # defines the knobs, resolves "auto"
+    "hadoop_bam_tpu/analysis/",  # this suite
+)
+
+
+def _knob_reads(expr: ast.AST) -> List[Tuple[str, int]]:
+    """(knob, line) for every attribute/getattr read of a gate knob."""
+    reads: List[Tuple[str, int]] = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in SOLO_KNOBS + (COMBO_KNOB,):
+            reads.append((node.attr, node.lineno))
+        elif isinstance(node, ast.Call) \
+                and last_segment(node.func) == "getattr" \
+                and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Constant) \
+                and node.args[1].value in SOLO_KNOBS + (COMBO_KNOB,):
+            reads.append((str(node.args[1].value), node.lineno))
+    return reads
+
+
+def _has_gate_hint(expr: ast.AST) -> bool:
+    """Does the expression reference another gate term (an identifier
+    mentioning fused/backend/plane/intervals) besides the knob reads
+    themselves?"""
+    for node in ast.walk(expr):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident and ident not in (COMBO_KNOB,) \
+                and any(h in ident.lower() for h in GATE_HINTS):
+            return True
+    return False
+
+
+def _candidate_tests(tree: ast.Module) -> Iterator[ast.AST]:
+    """Conditional-test expressions: if/elif/ternary/while tests, plus
+    bare BoolOps (returned or assigned gate expressions).  BoolOps
+    nested inside an already-yielded test are not re-yielded — the
+    per-(knob, line) dedup in ``analyze`` covers stragglers."""
+    tests: List[ast.AST] = []
+    covered: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.IfExp, ast.While)):
+            tests.append(node.test)
+            covered.update(id(n) for n in ast.walk(node.test))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BoolOp) and id(node) not in covered:
+            tests.append(node)
+            covered.update(id(n) for n in ast.walk(node))
+    return iter(tests)
+
+
+@register("planroute")
+def analyze(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in project.modules:
+        if any(m.path == p.rstrip("/") or m.path.startswith(p)
+               for p in EXCLUDE):
+            continue
+        seen: Set[Tuple[str, int]] = set()
+        for test in _candidate_tests(m.tree):
+            reads = _knob_reads(test)
+            if not reads:
+                continue
+            solo = [r for r in reads if r[0] in SOLO_KNOBS]
+            combo_ok = solo or _has_gate_hint(test)
+            for knob, line in reads:
+                if knob == COMBO_KNOB and not combo_ok:
+                    continue          # solo skip_bad_spans: policy, fine
+                if (knob, line) in seen:
+                    continue
+                seen.add((knob, line))
+                findings.append(Finding(
+                    rule="PL101", severity="error", path=m.path,
+                    line=line,
+                    message=f"plane-gating conditional reads "
+                            f"'{knob}' outside hadoop_bam_tpu/plan/ — "
+                            f"the decode-plane decision belongs to "
+                            f"plan.executor.select_plane; consume a "
+                            f"PlaneDecision instead of re-deriving the "
+                            f"gate"))
+    return findings

@@ -167,7 +167,9 @@ class CohortDataset:
         import os
 
         from hadoop_bam_tpu.jobs import journal as jj
-        from hadoop_bam_tpu.jobs.runner import COHORT_FINGERPRINT_FIELDS
+        from hadoop_bam_tpu.jobs.runner import (
+            COHORT_FINGERPRINT_FIELDS, plan_journal_params,
+        )
         from hadoop_bam_tpu.utils.metrics import METRICS
 
         # reentrancy is refused at the top of site_chunks (two live
@@ -203,9 +205,15 @@ class CohortDataset:
                         self.config, COHORT_FINGERPRINT_FIELDS),
                     config_values=jj.fingerprint_values(
                         self.config, COHORT_FINGERPRINT_FIELDS),
-                    params={"manifest":
+                    # the plan digest rides the params (the IR-level
+                    # twin of the spill sort's span plan_digest): a
+                    # resume whose compiled plan differs — changed
+                    # manifest identity, changed unit-partitioning
+                    # knobs — refuses instead of mis-stitching chunks
+                    params=plan_journal_params(self.plan(), {
+                        "manifest":
                             (os.path.abspath(self.manifest.path)
-                             if self.manifest.path else None)},
+                             if self.manifest.path else None)}),
                     fsync=bool(getattr(self.config, "journal_fsync",
                                        True)))
                 replayed = []
@@ -277,38 +285,32 @@ class CohortDataset:
 
     # -- mesh feed -----------------------------------------------------------
 
+    def plan(self):
+        """This cohort's compiled PlanIR (plan/builders.cohort_plan):
+        the identity the journal seam records and ``hbam explain
+        cohort`` prints."""
+        from hadoop_bam_tpu.plan import builders
+        return builders.cohort_plan(self.manifest, self.config,
+                                    geometry=self.geometry)
+
     def tensor_batches(self, mesh=None, geometry=None) -> Iterator[Dict]:
         """Yield device-resident joined tensor batches (class
-        docstring).  Same feed discipline as
+        docstring).  Compiles to a plan and runs through the one
+        executor, which owns the feed discipline shared with
         ``VcfDataset.tensor_batches``: ring-slot groups, async
-        device_put with in-flight handles, fixed-shape tiles."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        device_put with in-flight handles, fixed-shape tiles.  Lazy:
+        no join work (and no journal open) until first iteration.
 
-        from hadoop_bam_tpu.parallel.mesh import make_mesh
-        from hadoop_bam_tpu.parallel.variant_pipeline import variant_feed
+        The compiled plan is ALWAYS ``self.plan()`` — the join identity
+        the journal seam records: ``site_chunks`` joins with
+        ``self.geometry`` regardless of a feed-geometry override here
+        (``geometry`` only re-tiles the mesh feed), so the executing
+        plan and the journaled plan_digest can never diverge."""
+        from hadoop_bam_tpu.plan import executor as plan_executor
 
-        if mesh is None:
-            mesh = make_mesh()
-        if geometry is None:
-            geometry = self.geometry
-        n_dev = int(np.prod(mesh.devices.shape))
-        sharding = NamedSharding(mesh, P("data"))
-
-        keys, fp, tuples = variant_feed(self.site_chunks(), n_dev,
-                                        geometry.tile_records, self.config,
-                                        fixed_shape=True, fmt="cohort")
-        if fp is None:
-            return
-
-        def emit(arrays, counts) -> Dict:
-            # the device dict doubles as the slot's in-flight handle
-            out = {k: jax.device_put(a, sharding)
-                   for k, a in zip(keys, arrays)}
-            out["n_records"] = jax.device_put(counts, sharding)
-            return out
-
-        yield from fp.stream(tuples, emit)
+        return plan_executor.execute(self.plan(), config=self.config,
+                                     mesh=mesh, geometry=geometry,
+                                     dataset=self)
 
     # -- drivers -------------------------------------------------------------
 

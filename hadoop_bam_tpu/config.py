@@ -505,6 +505,13 @@ DEFAULT_CONFIG = HBamConfig()
 
 INFLATE_BACKENDS = ("auto", "native", "zlib", "device")
 
+# Decode planes, fastest first — the vocabulary plan/executor.select_plane
+# (the ONE plane-gating predicate; planroute lint PL101 keeps gates out of
+# every other package) decides over, and the rung order the resilience
+# DemotionLadder demotes along.  "fused" is a MODE of the native plane
+# (the single-pass sweep), not a plane of its own.
+DECODE_PLANES = ("device", "native", "zlib")
+
 _PLANE_CACHE: dict = {}
 
 
@@ -512,7 +519,11 @@ def resolve_inflate_backend(config: "HBamConfig | None") -> str:
     """Resolve a config's ``inflate_backend`` to a concrete plane name
     ("native" | "zlib" | "device").  "auto" probes once per process.
 
-    This is only the STARTING rung: with ``config.adaptive_planes`` the
+    This is only the STARTING rung, and only one input of the decision:
+    per-plan routing (which plane a given op DAG actually runs on, given
+    intervals / skip_bad_spans / fused availability) is decided in
+    ``plan.executor.select_plane``, the single predicate table every
+    driver consults.  With ``config.adaptive_planes`` the
     drivers run the resolved plane through a ``resilience.DemotionLadder``
     — oracle-confirmed plane-local faults demote it mid-run and a
     half-open probe revisits the faster plane after the breaker

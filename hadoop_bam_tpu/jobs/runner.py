@@ -33,6 +33,19 @@ COHORT_FINGERPRINT_FIELDS = (
 )
 
 
+def plan_journal_params(plan, extra: Optional[Dict] = None) -> Dict:
+    """Journal params carrying a compiled plan's IR digest — the
+    IR-level twin of ``journal.plan_digest(spans)``.  Where the span
+    digest pins the CUT GEOMETRY of a pinned span plan, the plan digest
+    pins the compiled workload itself (source identity, op DAG, the
+    unit-partitioning knobs the builder folded in), so a resume whose
+    plan compiles differently refuses inside ``JobJournal.resume``'s
+    params match instead of silently mis-joining units."""
+    out = dict(extra or {})
+    out["plan_digest"] = plan.digest()
+    return out
+
+
 def sort_job_params(input_path: str, output_path: str, *,
                     exchange: Optional[str],
                     round_records: Optional[int],

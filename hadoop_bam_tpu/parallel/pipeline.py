@@ -38,6 +38,15 @@ from hadoop_bam_tpu.parallel.staging import (
 from hadoop_bam_tpu.config import (
     DEFAULT_CONFIG, HBamConfig, resolve_inflate_backend,
 )
+# plane gating lives in plan/executor.py (the ONE predicate table; the
+# planroute lint rule PL101 keeps gate conditionals out of this module).
+# _use_fused/_fused_stream_gate keep their historical names here for the
+# span-level decoders and the existing import surface.
+from hadoop_bam_tpu.plan.executor import (  # noqa: F401 — re-exports
+    FLAGSTAT_DAG, PAYLOAD_DAG, _fused_stream_gate, _use_fused,
+    host_backend_for, select_plane,
+)
+from hadoop_bam_tpu.plan.ir import SourceIR
 from hadoop_bam_tpu.formats.bam import SAMHeader
 from hadoop_bam_tpu.ops import inflate as inflate_ops
 from hadoop_bam_tpu.ops.flagstat import flagstat_from_columns
@@ -302,34 +311,12 @@ def _decode_span_core(source, span: FileVirtualSpan,
 # config.use_fused_decode=False, and the rare cut-final-record span).
 # ---------------------------------------------------------------------------
 
-def _use_fused(config: Optional[HBamConfig],
-               inflate_backend: str = "auto") -> bool:
-    """Fused-path eligibility: the config knob (default on), a native
-    backend choice, and the fused entry points actually loadable."""
-    cfg = config if config is not None else DEFAULT_CONFIG
-    return (bool(getattr(cfg, "use_fused_decode", True))
-            and inflate_backend in ("auto", "native")
-            and inflate_ops.fused_available())
-
-
 def _close_stream(item) -> None:
     """_iter_windowed cleanup hook: join a fused chunk stream's native
     workers; buffered results (plain arrays/tuples) need nothing."""
     close = getattr(item, "close", None)
     if close is not None:
         close()
-
-
-def _fused_stream_gate(config: Optional[HBamConfig], intervals) -> bool:
-    """Chunk-streaming eligibility, shared by every driver that feeds
-    fused chunks to the FeedPipeline (ONE place, so a new
-    streaming-incompatible condition cannot be added to one driver and
-    missed in another): fused on, no interval filtering (the row mask
-    needs the whole span's offsets), and no skip_bad_spans (quarantine
-    is span-granular; a streamed span's early chunks would already be
-    dispatched when a late chunk turns out corrupt)."""
-    return (_use_fused(config) and intervals is None
-            and not getattr(config, "skip_bad_spans", False))
 
 
 def _flatten_span_stream(items) -> Iterator[Tuple[np.ndarray, ...]]:
@@ -391,7 +378,7 @@ def _start_fused_span(src, span: FileVirtualSpan, mode: str, *,
     dec = inflate_ops.FusedSpanDecode(
         raw, table, start=span.start[1], stop=end_inflated, mode=mode,
         check_crc=check_crc,
-        chunk_blocks=max(1, int(getattr(cfg, "decode_chunk_blocks", 32))),
+        chunk_blocks=max(1, int(cfg.decode_chunk_blocks)),
         **kwargs)
     return dec, end_inflated, next_c, table
 
@@ -896,7 +883,7 @@ _ADD = jax.jit(jnp.add)
 
 def parse_config_intervals(config: HBamConfig, header):
     """config.bam_intervals -> parsed Interval list (None when unset)."""
-    if not getattr(config, "bam_intervals", None):
+    if not config.bam_intervals:
         return None
     from hadoop_bam_tpu.split.intervals import parse_intervals
     return parse_intervals(config.bam_intervals,
@@ -912,14 +899,14 @@ def _resilient_source(path, config: HBamConfig):
     """What the decode stages should read through: the plain path, or a
     RetryingByteSource wrap when ``config.io_read_retries`` asks for
     read-level retries (backoff + per-read deadline under the span grain)."""
-    r = int(getattr(config, "io_read_retries", 0) or 0)
+    r = int(config.io_read_retries or 0)
     if r <= 0 or not isinstance(path, (str, os.PathLike)):
         return path
     return RetryingByteSource(path, RetryPolicy(
         retries=r,
-        backoff_base_s=float(getattr(config, "retry_backoff_base_s", 0.05)),
-        backoff_max_s=float(getattr(config, "retry_backoff_max_s", 2.0)),
-        deadline_s=getattr(config, "io_read_deadline_s", None)))
+        backoff_base_s=float(config.retry_backoff_base_s),
+        backoff_max_s=float(config.retry_backoff_max_s),
+        deadline_s=config.io_read_deadline_s))
 
 
 def decode_with_retry(fn: Callable, span: FileVirtualSpan,
@@ -1009,7 +996,7 @@ def decode_with_retry(fn: Callable, span: FileVirtualSpan,
                 policy.sleep(d)
                 continue
             break
-    if getattr(config, "skip_bad_spans", False):
+    if config.skip_bad_spans:
         METRICS.count("pipeline.bad_spans")
         logger.warning("skipping bad span %s after %d attempt(s) [%s]: %s",
                        span, attempts, kind, last)
@@ -1084,14 +1071,12 @@ def _iter_windowed(pool: cf.ThreadPoolExecutor, items: Sequence,
     submit_policy = RetryPolicy(retries=3, backoff_base_s=0.01,
                                 backoff_max_s=0.1)
 
-    timeout_s = getattr(config, "pool_task_timeout_s", None) \
-        if config is not None else None
+    timeout_s = config.pool_task_timeout_s if config is not None else None
     timeout_s = float(timeout_s) if timeout_s else None
-    max_resubmits = int(getattr(config, "span_retries", 2) or 0) \
+    max_resubmits = int(config.span_retries or 0) \
         if timeout_s is not None else 0
     latency = None
-    if config is not None and bool(getattr(config, "speculative_decode",
-                                           True)):
+    if config is not None and bool(config.speculative_decode):
         from hadoop_bam_tpu.jobs.speculate import UnitLatency
         latency = UnitLatency.from_config(config)
 
@@ -1374,7 +1359,7 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     hand out ring views that the packer will overwrite)."""
     cap = geometry.tile_records
     widths = (PREFIX, geometry.seq_stride, geometry.qual_stride)
-    check_crc = bool(getattr(config, "check_crc", False))
+    check_crc = bool(config.check_crc)
     intervals = parse_config_intervals(config, header)
     # same fast-fail quarantine gate as flagstat_file: a file whose last
     # run tripped the bad-span circuit sheds here while it is OPEN
@@ -1386,21 +1371,23 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     pool = decode_pool(config)
     window = max(1, prefetch) * decode_pool_size(config)
 
-    # the payload family has no device plane (seq/qual are variable-
-    # length series the token-feed step doesn't pack); "device" rides
-    # the host planes here, "zlib"/"native" are honored as asked
-    backend = resolve_inflate_backend(config)
-    host_backend = "auto" if backend == "device" else backend
+    # the ONE routing decision (plan/executor.py): the payload family
+    # has no device plane (seq/qual are variable-length series the
+    # token-feed step doesn't pack), so "device" rides the host planes
+    # here, "zlib"/"native" are honored as asked, and chunk streaming
+    # follows the shared fused-stream gate
+    decision = select_plane(SourceIR(path, "bam"), PAYLOAD_DAG, config,
+                            intervals=intervals)
+    host_backend = decision.host_backend
     # same demotion ladder as flagstat's host path: corrupt failures on
     # the native rung re-decode on zlib (byte-identical) and oracle-
     # confirmed blame opens the native domain's breaker
-    ladder = decode_ladder(path, backend, config) \
-        if getattr(config, "adaptive_planes", True) else None
+    ladder = decode_ladder(path, decision.backend, config) \
+        if config.adaptive_planes else None
 
     # same chunk-streaming shape as flagstat_file: fused spans hand their
     # prefix/seq/qual chunks to the packer as the native walk lands them
-    stream_fused = (_fused_stream_gate(config, intervals)
-                    and _use_fused(config, host_backend))
+    stream_fused = decision.stream_fused
     if stream_fused:
         window = _stream_window(window)
 
@@ -1833,7 +1820,29 @@ def seq_stats_file(path: str, mesh: Optional[Mesh] = None,
     """Distributed sequence/quality stats over a whole BAM: mean GC
     fraction, mean per-read quality, and the 4-bit base-code histogram —
     computed by the fused Pallas payload kernel on every device of the
-    mesh.  The payload analog of flagstat_file."""
+    mesh.  The payload analog of flagstat_file, and like it a thin plan
+    builder over the one executor."""
+    from hadoop_bam_tpu.plan import builders
+    from hadoop_bam_tpu.plan import executor as plan_executor
+
+    plan = builders.seq_stats_plan(path, config, geometry=geometry)
+    return plan_executor.execute(plan, config=config, mesh=mesh,
+                                 geometry=geometry, header=header,
+                                 spans=spans, prefetch=prefetch,
+                                 quarantine=quarantine)
+
+
+def _seq_stats_impl(path: str, mesh: Optional[Mesh] = None,
+                    config: HBamConfig = DEFAULT_CONFIG,
+                    geometry: Optional[PayloadGeometry] = None,
+                    header: Optional[SAMHeader] = None,
+                    spans: Optional[Sequence[FileVirtualSpan]] = None,
+                    prefetch: int = 2,
+                    quarantine: Optional[QuarantineManifest] = None,
+                    ) -> Dict[str, object]:
+    """The payload-stats mesh-feed implementation (executor runner):
+    iter_payload_tile_groups decode/pack under the shared routing
+    decision, fused Pallas kernel per tile group, 64-bit host drain."""
     from hadoop_bam_tpu.formats.bamio import read_bam_header
     from hadoop_bam_tpu.parallel.mesh import make_mesh
 
@@ -2074,13 +2083,13 @@ def _flagstat_device_plane(path: str, mesh: Mesh, config: HBamConfig,
     spans = list(spans)
     if quarantine is not None and quarantine.total_spans is None:
         quarantine.total_spans = len(spans)
-    check_crc = bool(getattr(config, "check_crc", False))
+    check_crc = bool(config.check_crc)
     step = make_device_flagstat_step(mesh)
     sharding = NamedSharding(mesh, P("data"))
     src = _resilient_source(path, config)
     pool = decode_pool(config)
     window = max(1, prefetch) * decode_pool_size(config)
-    ring_slots = int(getattr(config, "feed_ring_slots", 2))
+    ring_slots = int(config.feed_ring_slots)
     # the ring is sized LAZILY to the ladder shapes the plan actually
     # produces (worst case [n_dev, 64, 65536] u32 is a quarter GB of
     # token staging on a wide mesh; a small-block plan needs a tiny
@@ -2282,6 +2291,30 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     (SURVEY.md section 7): plan -> shard -> inflate -> pack prefixes ->
     device reduce.
 
+    A thin plan builder since the plan/execute layer landed: compiles to
+    ``plan.builders.flagstat_plan`` and runs through the one executor
+    (byte-identical to the inline path ``_flagstat_impl``, which the
+    ``plan_overhead_pct`` bench row pins against this wrapper)."""
+    from hadoop_bam_tpu.plan import builders
+    from hadoop_bam_tpu.plan import executor as plan_executor
+
+    plan = builders.flagstat_plan(path, config)
+    return plan_executor.execute(plan, config=config, mesh=mesh,
+                                 geometry=geometry, header=header,
+                                 spans=spans, prefetch=prefetch,
+                                 quarantine=quarantine)
+
+
+def _flagstat_impl(path: str, mesh: Optional[Mesh] = None,
+                   config: HBamConfig = DEFAULT_CONFIG,
+                   geometry: Optional[DecodeGeometry] = None,
+                   header: Optional[SAMHeader] = None,
+                   spans: Optional[Sequence[FileVirtualSpan]] = None,
+                   prefetch: int = 2,
+                   quarantine: Optional[QuarantineManifest] = None,
+                   ) -> Dict[str, int]:
+    """The flagstat mesh-feed implementation (executor runner).
+
     Uses the columnar projected-tile path: host threads inflate spans and
     pack just the flagstat columns (11 B/record over the link instead of
     whole spans); the device sees dense tiles and reduces them with one
@@ -2308,22 +2341,20 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     # hint attached) instead of re-planning a doomed run; HALF_OPEN lets
     # this run through as the probe and a clean finish heals it
     check_quarantine_gate(path, config)
-    backend = resolve_inflate_backend(config)
     intervals = parse_config_intervals(config, header)
     # the demotion ladder: plane-local faults demote device -> native ->
     # zlib mid-run with byte-identical results and heal back through
     # half-open probes (resilience/domains.py)
-    ladder = decode_ladder(path, backend, config) \
-        if getattr(config, "adaptive_planes", True) else None
+    ladder = decode_ladder(path, resolve_inflate_backend(config), config) \
+        if config.adaptive_planes else None
     device_blame: Optional[BaseException] = None
-    device_gated = (backend == "device" and intervals is None
-                    and not getattr(config, "skip_bad_spans", False))
-    # the breaker gate consumes a half-open probe slot, so consult it
-    # only when the device path would actually run (and report back)
-    if device_gated and ladder is not None \
-            and not ladder.allow_plane("device"):
-        device_gated = False         # OPEN device circuit: host planes
-    if device_gated:
+    # THE routing decision (plan/executor.select_plane): device plane
+    # when the token-feed DAG applies and every gate passes (the breaker
+    # gate consumes a half-open probe slot, so select_plane consults it
+    # last, only when the device path would actually run)
+    decision = select_plane(SourceIR(path, "bam"), FLAGSTAT_DAG, config,
+                            intervals=intervals, ladder=ladder)
+    if decision.plane == "device":
         # the token-feed device decode plane (resolve+walk+unpack on the
         # mesh).  Interval filtering needs whole-span offsets and
         # skip_bad_spans needs span-granular quarantine — both fall back
@@ -2349,7 +2380,7 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
                            "demoting to the host planes for %s",
                            type(e).__name__, e, path)
             device_blame = e
-    host_backend = "auto" if backend == "device" else backend
+    host_backend = decision.host_backend
 
     if spans is None:
         # Span size trades host-decode parallelism (smaller = more threads
@@ -2378,18 +2409,17 @@ def flagstat_file(path: str, mesh: Optional[Mesh] = None,
     pool = decode_pool(config)
     window = max(1, prefetch) * decode_pool_size(config)
     totals_vec = None
-    check_crc = bool(getattr(config, "check_crc", False))
+    check_crc = bool(config.check_crc)
 
     # Chunk-streamed fused decode: each pool worker starts its span's
     # native job (fetch inside the retry boundary) and hands back a lazy
     # chunk iterator; the FeedPipeline's packer consumes row chunks the
     # moment the native walk publishes them, so staging tiles pack while
-    # the span's tail is still inflating.  Gated off when skip_bad_spans
-    # needs span-granular quarantine (a streamed span's early chunks
-    # would already be dispatched when a late chunk turns out corrupt)
-    # or when interval filtering needs the whole span's offsets.
-    stream_fused = (_fused_stream_gate(config, intervals)
-                    and _use_fused(config, host_backend))
+    # the span's tail is still inflating.  Gated off (in select_plane,
+    # with the other routing gates) when skip_bad_spans needs
+    # span-granular quarantine or when interval filtering needs the
+    # whole span's offsets.
+    stream_fused = decision.stream_fused
     if stream_fused:
         window = _stream_window(window)
     ranges = projection_ranges(projection)
@@ -2507,8 +2537,8 @@ def decode_span_cigar_rows(source, span: FileVirtualSpan, max_cigar: int,
     """
     # coverage has no device plane (the cigar series is variable-length);
     # "device" rides the host planes, "zlib"/"native" are honored
-    backend = resolve_inflate_backend(config)
-    host_backend = "auto" if backend == "device" else backend
+    # (plan/executor owns the mapping)
+    host_backend = host_backend_for(config)
     got = _decode_span_fused(source, span, "offsets", check_crc=check_crc,
                              want_voffs=False, config=config) \
         if _use_fused(config, host_backend) else None
@@ -2646,7 +2676,7 @@ def coverage_file(path: str, region, mesh: Optional[Mesh] = None,
 
     sharding = NamedSharding(mesh, P("data"))
     rep = NamedSharding(mesh, P())
-    check_crc = bool(getattr(config, "check_crc", False))
+    check_crc = bool(config.check_crc)
     row_w = _cigar_row_bytes(max_cigar)
     window_depth = None                   # [n_dev, window], device-sharded
     tref = jax.device_put(np.int32(target_refid), rep)

@@ -538,7 +538,24 @@ def variant_stats_file(path: str, mesh: Optional[Mesh] = None,
     """Distributed variant stats over a whole VCF/BCF (any container the
     dispatcher recognises): variant/SNP/PASS counts, mean ALT allele
     frequency, and per-sample call rates, reduced over the mesh's data
-    axis."""
+    axis.  A thin plan builder over the one executor
+    (plan/builders.py + plan/executor.py)."""
+    from hadoop_bam_tpu.plan import builders
+    from hadoop_bam_tpu.plan import executor as plan_executor
+
+    plan = builders.variant_stats_plan(path, geometry=geometry)
+    return plan_executor.execute(plan, config=config, mesh=mesh,
+                                 geometry=geometry, header=header,
+                                 spans=spans, prefetch=prefetch)
+
+
+def _variant_stats_impl(path: str, mesh: Optional[Mesh] = None,
+                        config: HBamConfig = DEFAULT_CONFIG,
+                        geometry: Optional[VariantGeometry] = None,
+                        header: Optional[VCFHeader] = None,
+                        spans=None,
+                        prefetch: int = 2) -> Dict[str, object]:
+    """The variant-stats mesh-feed implementation (executor runner)."""
     from hadoop_bam_tpu.api.vcf_dataset import open_vcf
     from hadoop_bam_tpu.parallel.mesh import make_mesh
 

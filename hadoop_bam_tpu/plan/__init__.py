@@ -1,0 +1,18 @@
+"""hadoop_bam_tpu.plan — the declarative plan/execute layer.
+
+- ``ir``:        Source -> Spans -> TensorOps DAG -> Sink frozen
+                 dataclasses with a stable ``plan_digest``-compatible
+                 serialization.
+- ``builders``:  drivers compile to plans here (one catalogue of what
+                 each workload is).
+- ``executor``:  ``select_plane`` (the single plane-gating predicate —
+                 PL101 keeps gates out of every other package) and
+                 ``execute`` (the one entry the rewired drivers funnel
+                 through).
+"""
+from hadoop_bam_tpu.plan.ir import (  # noqa: F401
+    PlanIR, SinkIR, SourceIR, SpansIR, TensorOpIR, op_node,
+)
+from hadoop_bam_tpu.plan.executor import (  # noqa: F401
+    PlaneDecision, execute, plane_report, select_plane,
+)

@@ -1,0 +1,144 @@
+"""Plan builders: drivers compile to IR here.
+
+Each public driver is now a thin wrapper: build the plan, hand it to
+``plan.executor.execute``.  The builders are the one catalogue of what
+each workload IS — source format, span grain, tensor-op DAG, sink — so
+a new workload (markdup, pileup windows, query-then-analyze fusion)
+starts as a new builder composing existing ops, not a sixth hand-wired
+pipeline.
+
+Builders never touch the filesystem beyond what identity requires (the
+cohort builder reads the manifest's identity digest); expensive
+planning — span cutting, header reads — stays execution-time, so
+``hbam explain`` can print any plan cheaply.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Union
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.plan.ir import (
+    PlanIR, SinkIR, SourceIR, SpansIR, op_node,
+)
+
+# the whole-file scan span grains the drivers plan at when the caller
+# didn't pin spans (values lifted from the drivers they replaced; the
+# flagstat 4 MiB sweep result is recorded in parallel/pipeline.py)
+FLAGSTAT_SPAN_BYTES = 4 << 20
+PAYLOAD_SPAN_BYTES = 8 << 20
+
+
+def flagstat_plan(path: str,
+                  config: Optional[HBamConfig] = None) -> PlanIR:
+    """BAM flagstat: project the flagstat columns, reduce with one psum
+    per tile group.  The only DAG the token-feed device plane currently
+    implements (``executor._device_capable``)."""
+    from hadoop_bam_tpu.ops.unpack_bam import FLAGSTAT_PROJECTION
+
+    cfg = config if config is not None else DEFAULT_CONFIG
+    return PlanIR(
+        source=SourceIR(path, "bam"),
+        spans=SpansIR.auto(span_bytes=FLAGSTAT_SPAN_BYTES),
+        ops=(op_node("project", projection=FLAGSTAT_PROJECTION,
+                     intervals=cfg.bam_intervals),
+             op_node("flagstat_reduce")),
+        sink=SinkIR.of("flagstat"))
+
+
+def seq_stats_plan(path: str, config: Optional[HBamConfig] = None,
+                   geometry=None) -> PlanIR:
+    """BAM payload stats: pack prefix + 4-bit seq + qual row tiles,
+    reduce through the fused Pallas payload kernel."""
+    from hadoop_bam_tpu.parallel.pipeline import PayloadGeometry
+
+    cfg = config if config is not None else DEFAULT_CONFIG
+    g = geometry if geometry is not None else PayloadGeometry()
+    return PlanIR(
+        source=SourceIR(path, "bam"),
+        spans=SpansIR.auto(span_bytes=PAYLOAD_SPAN_BYTES),
+        ops=(op_node("payload_pack", max_len=g.max_len,
+                     seq_stride=g.seq_stride, qual_stride=g.qual_stride,
+                     tile_records=g.tile_records,
+                     fixed_shape=g.fixed_shape,
+                     intervals=cfg.bam_intervals),
+             op_node("seq_stats_reduce")),
+        sink=SinkIR.of("seq_stats"))
+
+
+def variant_stats_plan(path: str, geometry=None) -> PlanIR:
+    """VCF/BCF variant stats: pack (chrom, pos, flags, dosage) tiles,
+    reduce counts + allele frequency + per-sample call rates.  No
+    config parameter: nothing config-derived participates in the
+    variant family's plan identity (no interval gate, no device
+    plane)."""
+    fmt = "bcf" if path.lower().endswith(".bcf") else "vcf"
+    params = {}
+    if geometry is not None:
+        params = dict(n_samples=geometry.n_samples,
+                      tile_records=geometry.tile_records)
+    return PlanIR(
+        source=SourceIR(path, fmt),
+        spans=SpansIR.auto(),
+        ops=(op_node("variant_pack", **params),
+             op_node("variant_stats_reduce")),
+        sink=SinkIR.of("variant_stats"))
+
+
+def query_chunk_plan(path: str, kind: str, start_voffset: int,
+                     end_voffset: int) -> PlanIR:
+    """One index-resolved, coalesced query chunk: decode the pinned
+    virtual-offset range into host predicate columns for the mesh
+    overlap filter (query/engine.py)."""
+    return PlanIR(
+        source=SourceIR(path, kind, role="chunk"),
+        spans=SpansIR.pin([(path, start_voffset, end_voffset)]),
+        ops=(op_node("chunk_decode"),),
+        sink=SinkIR.of("chunk_columns"))
+
+
+def query_region_plan(path: str, kind: str, region: str,
+                      chunks) -> PlanIR:
+    """A whole region query (the ``hbam explain query`` surface): every
+    coalesced chunk the index resolved for ``region``, pinned."""
+    return PlanIR(
+        source=SourceIR(path, kind, role="chunk"),
+        spans=SpansIR.pin([(path, s, e) for s, e in chunks]),
+        ops=(op_node("chunk_decode"),
+             op_node("overlap_filter", region=region)),
+        sink=SinkIR.of("chunk_columns"))
+
+
+def cohort_plan(manifest, config: Optional[HBamConfig] = None,
+                geometry=None) -> PlanIR:
+    """Cohort tensor batches: k single-sample call sets k-way
+    position-joined, allele-harmonized, packed into
+    [variants, samples] dosage/qual mesh tiles.
+
+    The plan digest covers the manifest IDENTITY (anchor + per-input
+    file identity digest) plus the JOIN-affecting knobs — exactly what
+    the journaled join's refuse-to-resume contract needs
+    (``jobs.runner.plan_journal_params``).  Feed-only geometry
+    (tile_records) is deliberately NOT part of the identity: the
+    journaled chunk artifacts are cut by chunk_sites and shaped by
+    samples_pad, and a changed mesh-feed tile height replays them
+    byte-identically."""
+    from hadoop_bam_tpu.cohort.manifest import as_manifest
+
+    cfg = config if config is not None else DEFAULT_CONFIG
+    m = as_manifest(manifest)
+    anchor, k, digest = m.identity()
+    if geometry is None:
+        from hadoop_bam_tpu.parallel.variant_pipeline import (
+            VariantGeometry,
+        )
+        geometry = VariantGeometry(n_samples=k)
+    return PlanIR(
+        source=SourceIR(anchor or "<inline-manifest>", "cohort",
+                        role="join"),
+        spans=SpansIR.auto(),
+        ops=(op_node("kway_join", samples=k, manifest_digest=digest,
+                     chunk_sites=cfg.cohort_chunk_sites),
+             op_node("variant_pack",
+                     samples_pad=geometry.samples_pad)),
+        sink=SinkIR.of("tensor_batches"))

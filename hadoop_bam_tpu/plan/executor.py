@@ -1,0 +1,377 @@
+"""The one executor: plane selection, dispatch, and the driver seam.
+
+Two jobs live here, and ONLY here:
+
+1. **Plane selection** (``select_plane``): the single predicate table
+   that decides which decode plane a plan runs on and why every other
+   plane was rejected.  The gates — ``intervals``, ``skip_bad_spans``,
+   ``inflate_backend``, fused availability — used to be re-implemented
+   per driver (three independent copies in ``parallel/pipeline.py``
+   alone); the ``planroute`` lint analyzer (PL101) now keeps
+   plane-gating conditionals out of every package but this one.
+
+2. **Execution** (``execute``): the uniform entry the rewired drivers
+   funnel through.  A driver is a thin plan *builder*
+   (``plan/builders.py``); ``execute`` dispatches the compiled plan to
+   its family runner, counting executions and stamping the
+   ``plan.execute_wall`` span, and owns the generic wiring — the cohort
+   tensor feed is wired HERE (FeedPipeline + sharded device_put), and
+   the query-chunk runner owns ``decode_with_retry`` + the
+   ``query.decode_wall``/chunk metrics taxonomy.  Family runners that
+   need the mesh-feed machinery of ``parallel/pipeline.py`` delegate to
+   its ``_*_impl`` functions, which consume the decision this module
+   computed instead of re-deriving gates.
+
+Decode planes (``config.DECODE_PLANES``): "device" (token-feed on-mesh
+inflate; flagstat is the pilot DAG), "native" (host C++ inflate, with
+the fused single-pass sweep as a MODE when eligible), "zlib" (portable
+Python).  ``resolve_inflate_backend`` (config.py) turns "auto" into a
+concrete starting rung once per process; the ``DemotionLadder``
+(resilience/domains.py) may still demote mid-run.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, Optional, Tuple
+
+from hadoop_bam_tpu.config import (
+    DECODE_PLANES, DEFAULT_CONFIG, HBamConfig, resolve_inflate_backend,
+)
+from hadoop_bam_tpu.plan.ir import PlanIR, SourceIR, TensorOpIR, op_node
+from hadoop_bam_tpu.utils.errors import PlanError
+from hadoop_bam_tpu.utils.metrics import METRICS
+
+
+# ---------------------------------------------------------------------------
+# plane selection — THE predicate table
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PlaneDecision:
+    """One plan's resolved routing: the selected plane, the backend
+    strings the span-level decoders consume, fused-mode eligibility,
+    and — for ``hbam explain`` — why each rejected plane/mode failed
+    its gate."""
+    plane: str            # selected decode plane for this op DAG
+    backend: str          # resolve_inflate_backend(config) result
+    host_backend: str     # what host span decoders pass as backend
+    use_fused: bool       # fused single-pass native sweep eligible
+    stream_fused: bool    # chunk-streamed fused decode eligible
+    rejected: Tuple[Tuple[str, str], ...]   # (plane_or_mode, reason)
+
+    def to_doc(self) -> Dict:
+        return {"plane": self.plane, "backend": self.backend,
+                "host_backend": self.host_backend,
+                "use_fused": self.use_fused,
+                "stream_fused": self.stream_fused,
+                "rejected": {p: r for p, r in self.rejected}}
+
+
+def _use_fused(config: Optional[HBamConfig],
+               inflate_backend: str = "auto") -> bool:
+    """Fused-path eligibility: the config knob (default on), a native
+    backend choice, and the fused entry points actually loadable.  The
+    span-level decoders (``decode_span_*``) consult this directly —
+    they run under per-span ladder demotion, below the plan grain."""
+    from hadoop_bam_tpu.ops import inflate as inflate_ops
+
+    cfg = config if config is not None else DEFAULT_CONFIG
+    return (bool(cfg.use_fused_decode)
+            and inflate_backend in ("auto", "native")
+            and inflate_ops.fused_available())
+
+
+def _fused_stream_gate(config: Optional[HBamConfig], intervals) -> bool:
+    """Chunk-streaming eligibility, shared by every driver that feeds
+    fused chunks to the FeedPipeline (ONE place, so a new
+    streaming-incompatible condition cannot be added to one driver and
+    missed in another): fused on, no interval filtering (the row mask
+    needs the whole span's offsets), and no skip_bad_spans (quarantine
+    is span-granular; a streamed span's early chunks would already be
+    dispatched when a late chunk turns out corrupt)."""
+    cfg = config if config is not None else DEFAULT_CONFIG
+    return (_use_fused(cfg) and intervals is None
+            and not cfg.skip_bad_spans)
+
+
+def host_backend_for(config: Optional[HBamConfig]) -> str:
+    """The backend string host span decoders take: the resolved plane,
+    with "device" mapped to "auto" (families ride the host planes
+    wherever the token-feed plane does not apply)."""
+    backend = resolve_inflate_backend(config)
+    return "auto" if backend == "device" else backend
+
+
+def _device_capable(source: SourceIR, ops: Tuple[TensorOpIR, ...]) -> bool:
+    """Does the token-feed device plane implement this op DAG?  The
+    pilot is BAM flagstat (PR 9); new DAGs earn entries here as the
+    plane generalizes (ROADMAP item 1)."""
+    return (getattr(source, "fmt", None) == "bam"
+            and any(getattr(o, "op", None) == "flagstat_reduce"
+                    for o in ops))
+
+
+# canonical op DAGs of the in-repo BAM scan families (plan/builders.py
+# carries the fully-parameterized versions; these minimal twins are what
+# the mesh-feed impls pass to select_plane when invoked directly)
+FLAGSTAT_DAG = (op_node("project"), op_node("flagstat_reduce"))
+PAYLOAD_DAG = (op_node("payload_pack"), op_node("seq_stats_reduce"))
+
+
+def select_plane(source: SourceIR, ops: Tuple[TensorOpIR, ...],
+                 config: Optional[HBamConfig], *,
+                 intervals=None, ladder=None) -> PlaneDecision:
+    """THE plane-selection predicate table (module docstring).
+
+    ``intervals`` is the parsed interval filter (None = no filtering —
+    the gates test identity, matching the drivers' historical
+    ``intervals is None``).  ``ladder`` is the file's ``DemotionLadder``
+    when adaptive planes are on; its device breaker is consulted LAST,
+    only when every other device gate passed, because ``allow_plane``
+    consumes a half-open probe slot.
+
+    Native-library absence deliberately does NOT gate the device plane
+    here: an explicit ``inflate_backend="device"`` without the native
+    tokenizer is a configuration fault and must surface as PlanError
+    from the device runner, not silently reroute.  It DOES gate the
+    fused mode (``fused_available`` implies native)."""
+    from hadoop_bam_tpu.ops import inflate as inflate_ops
+
+    cfg = config if config is not None else DEFAULT_CONFIG
+    backend = resolve_inflate_backend(cfg)
+    host_backend = "auto" if backend == "device" else backend
+    rejected = []
+
+    fused = True
+    if not cfg.use_fused_decode:
+        fused = False
+        rejected.append(("fused", "config.use_fused_decode is off"))
+    elif host_backend not in ("auto", "native"):
+        fused = False
+        rejected.append(
+            ("fused", f"backend {host_backend!r} disables the native "
+                      f"fused sweep"))
+    elif not inflate_ops.fused_available():
+        fused = False
+        rejected.append(
+            ("fused", "native fused entry points unavailable"))
+
+    plane = None
+    if backend != "device":
+        rejected.append(
+            ("device", f"inflate_backend resolved to {backend!r}"))
+    elif not _device_capable(source, ops):
+        rejected.append(
+            ("device", "no device decode plane for this op DAG "
+                       "(token-feed pilot: BAM flagstat)"))
+    elif intervals is not None:
+        rejected.append(
+            ("device", "interval filtering needs whole-span offsets "
+                       "on the host"))
+    elif cfg.skip_bad_spans:
+        rejected.append(
+            ("device", "skip_bad_spans needs span-granular quarantine"))
+    elif ladder is not None and not ladder.allow_plane("device"):
+        rejected.append(
+            ("device", "device fault-domain breaker is OPEN"))
+    else:
+        plane = "device"
+
+    if plane is None:
+        if backend == "zlib":
+            rejected.append(
+                ("native", "inflate_backend='zlib' pins the portable "
+                           "plane"))
+            plane = "zlib"
+        else:
+            plane = "native"
+
+    stream = fused and intervals is None and not cfg.skip_bad_spans
+    if fused and not stream:
+        rejected.append(
+            ("fused-stream",
+             "interval filtering needs the whole span's offsets"
+             if intervals is not None
+             else "skip_bad_spans needs span-granular quarantine"))
+    assert plane in DECODE_PLANES
+    return PlaneDecision(plane=plane, backend=backend,
+                         host_backend=host_backend, use_fused=fused,
+                         stream_fused=stream, rejected=tuple(rejected))
+
+
+def plane_report(config: Optional[HBamConfig] = None) -> Dict[str, Dict]:
+    """Display-only decision table per driver family for this process +
+    config — the ``hbam serve`` health surface.  Never consumes breaker
+    probes (ladder=None) and never touches files; the interval gate is
+    approximated by whether ``config.bam_intervals`` is set."""
+    cfg = config if config is not None else DEFAULT_CONFIG
+    intervals = () if getattr(cfg, "bam_intervals", None) else None
+    # the SAME DAG constants the drivers route with — rebuilding them
+    # here would be exactly the per-surface drift this module removes
+    fams = {
+        "flagstat": (SourceIR("<bam>", "bam"), FLAGSTAT_DAG),
+        "payload": (SourceIR("<bam>", "bam"), PAYLOAD_DAG),
+        "variant": (SourceIR("<vcf>", "vcf"),
+                    (op_node("variant_pack"),
+                     op_node("variant_stats_reduce"))),
+    }
+    return {name: select_plane(src, ops, cfg,
+                               intervals=intervals).to_doc()
+            for name, (src, ops) in fams.items()}
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+def execute(plan: PlanIR, *, config: Optional[HBamConfig] = None,
+            **kw):
+    """Run a compiled plan.  ``kw`` carries the family runner's
+    execution-time context (mesh, header, pinned spans, geometry,
+    quarantine manifest, prefetch depth, and family extras like the
+    query runner's ``decode_fn`` or the cohort runner's ``dataset``).
+
+    Returns whatever the sink promises: a stats dict, a lazy tensor
+    batch iterator, or the query tier's (columns, cache-cost) pair."""
+    cfg = config if config is not None else DEFAULT_CONFIG
+    runner = _runner_for(plan)
+    METRICS.count("plan.executions")
+    if getattr(runner, "lazy_sink", False):
+        # generator sinks: a span here would close at dispatch,
+        # microseconds in — a mixed-semantics series next to the eager
+        # sinks' full-run walls.  The iteration's own stage spans
+        # (cohort.*) already cover the work.
+        return runner(plan, cfg, kw)
+    with METRICS.span("plan.execute_wall", sink=plan.sink.kind,
+                      fmt=plan.source.fmt):
+        return runner(plan, cfg, kw)
+
+
+def _runner_for(plan: PlanIR):
+    kind = plan.sink.kind
+    if kind == "flagstat":
+        return _run_flagstat
+    if kind == "seq_stats":
+        return _run_seq_stats
+    if kind == "variant_stats":
+        return _run_variant_stats
+    if kind == "chunk_columns":
+        return _run_chunk_columns
+    if kind == "tensor_batches" and plan.source.role == "join":
+        return _run_cohort_batches
+    raise PlanError(
+        f"no executor runner for sink {kind!r} "
+        f"(source role {plan.source.role!r}) — known sinks: flagstat, "
+        f"seq_stats, variant_stats, chunk_columns, join/tensor_batches")
+
+
+def _run_flagstat(plan: PlanIR, cfg: HBamConfig, kw: Dict):
+    from hadoop_bam_tpu.parallel import pipeline
+
+    return pipeline._flagstat_impl(
+        plan.source.path, mesh=kw.get("mesh"), config=cfg,
+        geometry=kw.get("geometry"), header=kw.get("header"),
+        spans=kw.get("spans"), prefetch=kw.get("prefetch", 2),
+        quarantine=kw.get("quarantine"))
+
+
+def _run_seq_stats(plan: PlanIR, cfg: HBamConfig, kw: Dict):
+    from hadoop_bam_tpu.parallel import pipeline
+
+    return pipeline._seq_stats_impl(
+        plan.source.path, mesh=kw.get("mesh"), config=cfg,
+        geometry=kw.get("geometry"), header=kw.get("header"),
+        spans=kw.get("spans"), prefetch=kw.get("prefetch", 2),
+        quarantine=kw.get("quarantine"))
+
+
+def _run_variant_stats(plan: PlanIR, cfg: HBamConfig, kw: Dict):
+    from hadoop_bam_tpu.parallel import variant_pipeline
+
+    return variant_pipeline._variant_stats_impl(
+        plan.source.path, mesh=kw.get("mesh"), config=cfg,
+        geometry=kw.get("geometry"), header=kw.get("header"),
+        spans=kw.get("spans"), prefetch=kw.get("prefetch", 2))
+
+
+def _run_chunk_columns(plan: PlanIR, cfg: HBamConfig, kw: Dict):
+    """Query-engine chunk decode: ONE pinned span through
+    ``decode_with_retry`` under the query metrics taxonomy.  Returns
+    the ``(columns, cache_cost)`` pair ``ChunkCache.get_or_compute``
+    stores — cost None on a quarantined chunk, so a healed transient
+    fault re-decodes on the next query instead of caching emptiness."""
+    import time
+
+    import numpy as np
+
+    from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
+    from hadoop_bam_tpu.split.spans import FileVirtualSpan
+
+    decode_fn = kw["decode_fn"]
+    (path, s, e), = plan.spans.pinned
+    span = FileVirtualSpan(path, s, e)
+    t0 = time.perf_counter()
+    with METRICS.span("query.decode_wall", kind=plan.source.fmt):
+        value = decode_with_retry(decode_fn, span, cfg)
+    # per-chunk fetch+decode latency/size distributions: cache misses
+    # only — the p99 here is what a cold region costs
+    METRICS.observe("query.chunk_fetch_s", time.perf_counter() - t0)
+    if value is None:
+        # config.skip_bad_spans quarantined the chunk: serve it as
+        # empty (the scan drivers' skip semantics), and do NOT cache
+        METRICS.count("query.chunks_skipped")
+        return ({"rid": np.empty(0, np.int32),
+                 "pos1": np.empty(0, np.int32),
+                 "end1": np.empty(0, np.int32),
+                 "records": [], "n": 0, "nbytes": 0}, None)
+    METRICS.observe("query.chunk_bytes", int(value["nbytes"]))
+    METRICS.count("query.chunks_decoded")
+    return (value, int(value["nbytes"]))
+
+
+def _run_cohort_batches(plan: PlanIR, cfg: HBamConfig,
+                        kw: Dict) -> Iterator[Dict]:
+    """The cohort tensor feed, wired by the executor: joined site
+    chunks through the shared ``variant_feed``/FeedPipeline with the
+    sharded device_put emit whose returned dict doubles as the ring
+    slot's in-flight handle.  A generator, so a dataset whose
+    ``tensor_batches`` is built but never iterated starts no join (and
+    opens no journal)."""
+    dataset = kw["dataset"]
+
+    def gen():
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from hadoop_bam_tpu.parallel.mesh import make_mesh
+        from hadoop_bam_tpu.parallel.variant_pipeline import variant_feed
+
+        mesh = kw.get("mesh")
+        if mesh is None:
+            mesh = make_mesh()
+        geometry = kw.get("geometry")
+        if geometry is None:
+            geometry = dataset.geometry
+        n_dev = int(np.prod(mesh.devices.shape))
+        sharding = NamedSharding(mesh, P("data"))
+
+        keys, fp, tuples = variant_feed(dataset.site_chunks(), n_dev,
+                                        geometry.tile_records, cfg,
+                                        fixed_shape=True, fmt="cohort")
+        if fp is None:
+            return
+
+        def emit(arrays, counts) -> Dict:
+            # the device dict doubles as the slot's in-flight handle
+            out = {k: jax.device_put(a, sharding)
+                   for k, a in zip(keys, arrays)}
+            out["n_records"] = jax.device_put(counts, sharding)
+            return out
+
+        yield from fp.stream(tuples, emit)
+
+    return gen()
+
+
+_run_cohort_batches.lazy_sink = True   # see execute(): no dispatch span

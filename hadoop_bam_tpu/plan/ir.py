@@ -1,0 +1,202 @@
+"""Plan IR: the declarative middle layer between drivers and execution.
+
+Every driver family used to hand-wire the same pipeline shape — plan
+spans, pick a decode plane, feed the staging ring, retry/quarantine bad
+spans, reduce on the mesh — and the gating conditions (`intervals`,
+`skip_bad_spans`, `inflate_backend`, `fixed_shape`) were re-implemented
+per path.  The IR makes that shape EXPLICIT:
+
+    Source -> Spans -> [DecodePlane] -> TensorOps DAG -> Sink
+
+as frozen dataclasses with a stable, canonical serialization
+(``PlanIR.to_doc``) and a content digest (``PlanIR.digest``) built with
+the same recipe as ``jobs.journal.plan_digest`` — canonical sorted-key
+JSON, path spellings canonicalized to abspath, sha256 truncated to 24
+hex chars — so a plan digest can sit next to a span-plan digest in a
+job journal's refuse-to-resume contract.
+
+The decode PLANE is deliberately *not* part of the IR: plane selection
+is a property of the process (probed backends, native availability,
+breaker state), not of the work, and is decided in exactly one place —
+``plan.executor.select_plane`` — at execution time.  ``hbam explain``
+prints both: the plan (portable) and the decision (local).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+IR_VERSION = 1
+
+# JSON-able parameter scalar types accepted by op_node / SinkIR.of
+_SCALARS = (str, int, float, bool, type(None))
+
+
+def _norm_value(v):
+    """Normalize one op/sink parameter value to a hashable, JSON-stable
+    form (tuples for sequences, scalars pass through)."""
+    if isinstance(v, _SCALARS):
+        return v
+    if isinstance(v, (list, tuple)):
+        return tuple(_norm_value(x) for x in v)
+    raise TypeError(
+        f"plan IR parameters must be JSON-able scalars/sequences, got "
+        f"{type(v).__name__}: {v!r}")
+
+
+def _params_tuple(params: Dict) -> Tuple[Tuple[str, object], ...]:
+    return tuple((k, _norm_value(params[k])) for k in sorted(params))
+
+
+def _params_doc(params: Tuple[Tuple[str, object], ...]) -> Dict:
+    def unroll(v):
+        return list(unroll(x) for x in v) if isinstance(v, tuple) else v
+    return {k: unroll(v) for k, v in params}
+
+
+@dataclasses.dataclass(frozen=True)
+class SourceIR:
+    """What the plan reads.  ``role`` distinguishes the three access
+    shapes: "scan" (whole-file span plan), "chunk" (pinned virtual-offset
+    ranges out of a genomic index), "join" (k-way cohort merge keyed by a
+    manifest)."""
+    path: str
+    fmt: str            # "bam" | "vcf" | "bcf" | "cram" | "fastq" | ...
+    role: str = "scan"  # "scan" | "chunk" | "join"
+
+    def to_doc(self) -> Dict:
+        return {"path": os.path.abspath(self.path), "fmt": self.fmt,
+                "role": self.role}
+
+
+@dataclasses.dataclass(frozen=True)
+class SpansIR:
+    """How the source cuts into retryable decode units.  ``mode="auto"``
+    defers to the family's span planner (the digest then covers the
+    requested grain, not the data-dependent cuts — pinned span GEOMETRY
+    is ``jobs.journal.plan_digest``'s job); ``mode="pinned"`` carries
+    explicit (path, start_voffset, end_voffset) triples, e.g. the
+    coalesced chunk ranges of a region query."""
+    mode: str = "auto"                 # "auto" | "pinned"
+    n_spans: Optional[int] = None
+    span_bytes: Optional[int] = None
+    pinned: Tuple[Tuple[str, int, int], ...] = ()
+
+    @classmethod
+    def auto(cls, n_spans: Optional[int] = None,
+             span_bytes: Optional[int] = None) -> "SpansIR":
+        return cls(mode="auto", n_spans=n_spans, span_bytes=span_bytes)
+
+    @classmethod
+    def pin(cls, triples) -> "SpansIR":
+        return cls(mode="pinned",
+                   pinned=tuple((str(p), int(s), int(e))
+                                for p, s, e in triples))
+
+    def to_doc(self) -> Dict:
+        doc: Dict = {"mode": self.mode}
+        if self.n_spans is not None:
+            doc["n_spans"] = int(self.n_spans)
+        if self.span_bytes is not None:
+            doc["span_bytes"] = int(self.span_bytes)
+        if self.pinned:
+            doc["pinned"] = [[os.path.abspath(p), s, e]
+                             for p, s, e in self.pinned]
+        return doc
+
+    def summary(self) -> str:
+        if self.mode == "pinned":
+            return f"pinned n={len(self.pinned)}"
+        bits = []
+        if self.n_spans is not None:
+            bits.append(f"n_spans={self.n_spans}")
+        if self.span_bytes is not None:
+            bits.append(f"span_bytes={self.span_bytes}")
+        return "auto" + (f" ({', '.join(bits)})" if bits else "")
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorOpIR:
+    """One node of the tensor-op DAG (linear for every current family:
+    a pack/projection stage followed by a reduce or filter)."""
+    op: str
+    params: Tuple[Tuple[str, object], ...] = ()
+
+    def to_doc(self) -> Dict:
+        doc: Dict = {"op": self.op}
+        if self.params:
+            doc["params"] = _params_doc(self.params)
+        return doc
+
+    def render(self) -> str:
+        if not self.params:
+            return self.op
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.params)
+        return f"{self.op}({inner})"
+
+
+def op_node(op: str, **params) -> TensorOpIR:
+    """TensorOpIR constructor with keyword params (sorted + normalized,
+    so two spellings of the same op always digest identically)."""
+    return TensorOpIR(op=op, params=_params_tuple(params))
+
+
+@dataclasses.dataclass(frozen=True)
+class SinkIR:
+    """Where the op DAG's output lands: "stats" (a reduced host dict),
+    "tensor_batches" (sharded device dicts), "chunk_columns" (host
+    predicate columns for the query/serve tiers)."""
+    kind: str
+    params: Tuple[Tuple[str, object], ...] = ()
+
+    @classmethod
+    def of(cls, kind: str, **params) -> "SinkIR":
+        return cls(kind=kind, params=_params_tuple(params))
+
+    def to_doc(self) -> Dict:
+        doc: Dict = {"kind": self.kind}
+        if self.params:
+            doc["params"] = _params_doc(self.params)
+        return doc
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanIR:
+    """The whole plan.  Frozen and hashable; ``digest()`` is the stable
+    identity the journal seam records (``jobs.runner.plan_journal_params``)
+    and ``hbam explain`` prints."""
+    source: SourceIR
+    spans: SpansIR
+    ops: Tuple[TensorOpIR, ...]
+    sink: SinkIR
+
+    def to_doc(self) -> Dict:
+        return {
+            "v": IR_VERSION,
+            "source": self.source.to_doc(),
+            "spans": self.spans.to_doc(),
+            "ops": [o.to_doc() for o in self.ops],
+            "sink": self.sink.to_doc(),
+        }
+
+    def digest(self) -> str:
+        """sha256 over the canonical serialization, truncated to 24 hex
+        chars — the ``jobs.journal.plan_digest`` recipe, so IR digests
+        and span-plan digests share one format in journal headers."""
+        blob = json.dumps(self.to_doc(), sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+    def render(self) -> List[str]:
+        """Human-readable lines (the ``hbam explain`` text body)."""
+        return [
+            f"plan    {self.digest()}",
+            f"source  path={self.source.path} fmt={self.source.fmt} "
+            f"role={self.source.role}",
+            f"spans   {self.spans.summary()}",
+            "ops     " + " -> ".join(o.render() for o in self.ops),
+            f"sink    {self.sink.kind}",
+        ]

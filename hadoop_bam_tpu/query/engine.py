@@ -365,31 +365,17 @@ class QueryEngine:
             lambda: self._compute_chunk(meta, s, e))
 
     def _compute_chunk(self, meta: _FileMeta, s: int, e: int):
-        import time
+        """One cold chunk decode, compiled to a plan: the executor owns
+        ``decode_with_retry`` and the query decode metrics taxonomy;
+        this engine owns only the per-format column decoders and the
+        cache tiering above."""
+        from hadoop_bam_tpu.plan import builders
+        from hadoop_bam_tpu.plan import executor as plan_executor
 
-        from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
-
-        span = FileVirtualSpan(meta.path, s, e)
-        t0 = time.perf_counter()
-        with METRICS.span("query.decode_wall", kind=meta.kind):
-            value = decode_with_retry(
-                lambda sp: self._decode_chunk(meta, sp), span, self.config)
-        # per-chunk fetch+decode latency/size distributions: cache
-        # misses only — the p99 here is what a cold region costs
-        METRICS.observe("query.chunk_fetch_s", time.perf_counter() - t0)
-        if value is not None:
-            METRICS.observe("query.chunk_bytes", int(value["nbytes"]))
-        if value is None:
-            # config.skip_bad_spans quarantined the chunk: serve it as
-            # empty (the scan drivers' skip semantics), and do NOT cache
-            # — a transient fault may heal on the next query
-            METRICS.count("query.chunks_skipped")
-            return ({"rid": np.empty(0, np.int32),
-                     "pos1": np.empty(0, np.int32),
-                     "end1": np.empty(0, np.int32),
-                     "records": [], "n": 0, "nbytes": 0}, None)
-        METRICS.count("query.chunks_decoded")
-        return (value, int(value["nbytes"]))
+        plan = builders.query_chunk_plan(meta.path, meta.kind, s, e)
+        return plan_executor.execute(
+            plan, config=self.config,
+            decode_fn=lambda sp: self._decode_chunk(meta, sp))
 
     def _decode_chunk(self, meta: _FileMeta,
                       span: FileVirtualSpan) -> Dict[str, object]:

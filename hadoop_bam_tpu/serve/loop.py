@@ -261,6 +261,7 @@ class ServeLoop:
         registry's fault domains (decode-ladder + quarantine circuits),
         registry fault pressure, and whether prefetch auto-paused."""
         from hadoop_bam_tpu import resilience
+        from hadoop_bam_tpu.plan.executor import plane_report
 
         reg = resilience.registry()
         with self._cond:
@@ -271,6 +272,11 @@ class ServeLoop:
         return {
             "status": "stopping" if stopping else "serving",
             "queued": queued,
+            # the routing this process would decide right now, per
+            # driver family (plan/executor.select_plane — display only,
+            # consumes no breaker probes): what `hbam top` shows when
+            # an operator asks "which plane is this server actually on"
+            "planes": plane_report(self.config),
             "fault_pressure": round(reg.fault_pressure(), 4),
             "open_breakers": reg.open_breakers(),
             "domains": reg.states(),

@@ -413,6 +413,73 @@ def cmd_seq_stats(args) -> int:
     return 0
 
 
+def cmd_explain(args) -> int:
+    """Compile the plan for an op and print the IR + routing decision:
+    source, spans summary, op DAG, sink, digest, the selected decode
+    plane, and the reason each rejected plane/mode failed its gate
+    (plan/executor.select_plane — the same single predicate the
+    drivers consume)."""
+    import dataclasses as _dc
+    import json as _json
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.plan import builders
+    from hadoop_bam_tpu.plan.executor import select_plane
+
+    # flag -> config-field forwarding, value-filtered (no gate
+    # conditionals here: PL101 applies to this module too)
+    overrides = {
+        "inflate_backend": args.inflate_backend,
+        "bam_intervals": args.intervals,
+        "skip_bad_spans": True if args.skip_bad_spans else None,
+        "use_fused_decode": False if args.no_fused else None,
+    }
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    cfg = _dc.replace(DEFAULT_CONFIG, **overrides) if overrides \
+        else DEFAULT_CONFIG
+
+    if args.op == "flagstat":
+        plan = builders.flagstat_plan(args.path, cfg)
+    elif args.op == "seq-stats":
+        plan = builders.seq_stats_plan(args.path, cfg)
+    elif args.op == "vcf-stats":
+        plan = builders.variant_stats_plan(args.path)
+    elif args.op == "cohort":
+        plan = builders.cohort_plan(args.path, cfg)
+    else:  # query
+        if not args.region:
+            raise SystemExit("explain query needs --region")
+        from hadoop_bam_tpu.query.engine import QueryEngine
+        engine = QueryEngine(config=cfg)
+        meta = engine._file_meta(args.path)
+        _iv, ranges = engine._resolve(meta, args.region)
+        chunks = engine._coalesce(ranges, meta.kind)
+        plan = builders.query_region_plan(args.path, meta.kind,
+                                          args.region, chunks)
+    # the gate sees parsed intervals at run time; a set-but-unparsed
+    # config string is the same gate signal for explain purposes
+    intervals = () if cfg.bam_intervals else None
+    decision = select_plane(plan.source, plan.ops, cfg,
+                            intervals=intervals)
+    if args.json:
+        print(_json.dumps({"plan": plan.to_doc(),
+                           "digest": plan.digest(),
+                           "decision": decision.to_doc()},
+                          indent=1, sort_keys=True))
+        return 0
+    for line in plan.render():
+        print(line)
+    print(f"plane   {decision.plane} (backend={decision.backend}, "
+          f"host_backend={decision.host_backend}, "
+          f"fused={'on' if decision.use_fused else 'off'}, "
+          f"stream_fused={'on' if decision.stream_fused else 'off'})")
+    if decision.rejected:
+        print("rejected:")
+        for p, reason in decision.rejected:
+            print(f"  {p:13s} {reason}")
+    return 0
+
+
 def cmd_vcf_stats(args) -> int:
     from hadoop_bam_tpu.parallel.distributed import (
         distributed_variant_stats,
@@ -1182,6 +1249,33 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("--format", choices=("text", "prometheus", "json"),
                     default="text")
     mt.set_defaults(fn=cmd_metrics, uses_device=False)
+
+    ex = sub.add_parser(
+        "explain",
+        help="compile an op's plan IR and print it with the decode-"
+             "plane decision (which plane, and why each rejected "
+             "plane failed its gate)")
+    ex.add_argument("op", choices=["flagstat", "seq-stats", "vcf-stats",
+                                   "query", "cohort"])
+    ex.add_argument("path", help="input file (BAM/VCF/BCF) or cohort "
+                                 "manifest JSON")
+    ex.add_argument("--region", default=None,
+                    help="region for `explain query` (resolved through "
+                         "the file's genomic index into pinned chunks)")
+    ex.add_argument("--intervals", default=None,
+                    help="explain with hadoopbam.bam.intervals set "
+                         "(gates the device plane and fused streaming)")
+    ex.add_argument("--inflate-backend", default=None,
+                    choices=["auto", "native", "zlib", "device"],
+                    help="explain under this backend instead of the "
+                         "config default")
+    ex.add_argument("--skip-bad-spans", action="store_true",
+                    help="explain with quarantine-and-skip on")
+    ex.add_argument("--no-fused", action="store_true",
+                    help="explain with the fused decode knob off")
+    ex.add_argument("--json", action="store_true",
+                    help="emit {plan, digest, decision} as JSON")
+    ex.set_defaults(fn=cmd_explain, uses_device=True)
 
     ln = sub.add_parser("lint",
                         help="static analysis: trace safety (TS1xx), "

@@ -32,6 +32,7 @@ def _fill_state(bench, n_notes=6):
         ("region_serve_queries_per_sec", 200.3, "queries/s", 9.5),
         ("faulted_serve_queries_per_sec", 151.2, "queries/s", 0.81),
         ("obs_overhead_pct", 1.3, "%", None),
+        ("plan_overhead_pct", 0.6, "%", None),
         ("cohort_join_variants_per_sec", 48211.5, "variants/s", None),
         ("device_inflate_records_per_sec", 93211.4, "records/s", 0.42),
         ("fastq_reads_per_sec", 188001.0, "reads/s", 2.37),
@@ -89,6 +90,12 @@ def _fill_state(bench, n_notes=6):
                        byte_identical_to_serial=True)
         if m == "obs_overhead_pct":
             row.update(instrumented_s=0.1301, null_s=0.1284)
+        if m == "plan_overhead_pct":
+            # the r18 plan-layer row: both arm walls + the value-identity
+            # pin ride the FULL row only; the compact line keeps the
+            # overhead number
+            row.update(plan_s=0.1310, inline_s=0.1302,
+                       identical_to_inline=True)
         if m == "resume_overhead_pct":
             # the r16 crash-safe jobs row: journal-on vs journal-off
             # walls, and the SIGKILL-resume arm's journal-verified
@@ -314,6 +321,23 @@ def test_resume_row_shape_pinned(bench):
     assert row["resume_rounds_skipped"] >= 1
     out = bench._compact_snapshot(full)
     assert out["components"]["resume_overhead_pct"] == 1.4
+    assert len(json.dumps(out)) <= bench.FINAL_LINE_BUDGET
+
+
+def test_plan_overhead_row_shape_pinned(bench):
+    """The r18 plan/execute-layer row: the full row carries both arm
+    walls and the identity pin (flagstat via the executor must be
+    value-identical to the inline mesh-feed impl); the compact final
+    line keeps only the overhead number and still fits the budget."""
+    _fill_state(bench)
+    full = bench._snapshot("ok")
+    row = next(c for c in full["components"]
+               if c["metric"] == "plan_overhead_pct")
+    assert row["unit"] == "%"
+    assert row["plan_s"] > 0 and row["inline_s"] > 0
+    assert row["identical_to_inline"] is True
+    out = bench._compact_snapshot(full)
+    assert out["components"]["plan_overhead_pct"] == 0.6
     assert len(json.dumps(out)) <= bench.FINAL_LINE_BUDGET
 
 

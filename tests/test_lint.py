@@ -1193,6 +1193,71 @@ def test_dv_outside_plane_not_scoped():
 
 
 # ---------------------------------------------------------------------------
+# plane-routing discipline (PL101)
+# ---------------------------------------------------------------------------
+
+_PL_BAD = '''
+def gate(config, intervals):
+    if config.use_fused_decode:                      # PL101: solo knob
+        pass
+    b = "x" if getattr(config, "inflate_backend", "auto") == "native" \
+        else "y"                                     # PL101: getattr form
+    return (not config.skip_bad_spans) and intervals is None \
+        and config.use_fused_decode                  # PL101: combo gate
+'''
+
+_PL_GOOD = '''
+from hadoop_bam_tpu.plan.executor import select_plane
+
+
+def run(config, source, ops, intervals, quarantine):
+    decision = select_plane(source, ops, config, intervals=intervals)
+    if decision.stream_fused:          # consuming the decision: fine
+        pass
+    if config.skip_bad_spans:          # solo read: failure policy,
+        return None                    # not plane routing
+    backend = config.inflate_backend   # assignment, not a gate
+    import dataclasses
+    cfg = dataclasses.replace(config, use_fused_decode=False)  # kwarg
+    return decision.plane, backend, cfg
+'''
+
+
+def test_pl_seeded_violations_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/bad.py": _PL_BAD}, only=["planroute"])
+    assert rules_of(findings) == {"PL101"}
+    assert all(f.severity == "error" for f in findings)
+    knobs = {k for f in findings
+             for k in ("use_fused_decode", "inflate_backend",
+                       "skip_bad_spans") if f"'{k}'" in f.message}
+    # the solo knobs fire, and skip_bad_spans fires in the combo gate
+    assert knobs == {"use_fused_decode", "inflate_backend",
+                     "skip_bad_spans"}
+
+
+def test_pl_clean_twin_and_policy_reads_pass():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/parallel/good.py": _PL_GOOD},
+        only=["planroute"])
+    assert findings == []
+
+
+def test_pl_scope_excludes_plan_and_config():
+    # the same gate inside plan/ (its one home) and config.py (knob
+    # definitions + the auto resolver) is silent; in a driver package
+    # it fires
+    src = ("def f(c, intervals):\n"
+           "    return c.use_fused_decode and intervals is None\n")
+    assert lint_sources({"hadoop_bam_tpu/plan/executor.py": src},
+                        only=["planroute"]) == []
+    assert lint_sources({"hadoop_bam_tpu/config.py": src},
+                        only=["planroute"]) == []
+    assert rules_of(lint_sources({"hadoop_bam_tpu/query/gate.py": src},
+                                 only=["planroute"])) == {"PL101"}
+
+
+# ---------------------------------------------------------------------------
 # the CI gate: the repo itself lints clean
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,442 @@
+"""Plan/execute layer tests (hadoop_bam_tpu/plan/).
+
+The load-bearing pins:
+
+- **Byte/value identity per rewired driver**: every driver that became
+  a thin plan builder (flagstat, seq_stats, variant_stats, query-engine
+  chunk decode, cohort tensor_batches) produces output identical to the
+  pre-refactor direct path — the inline mesh-feed impls it now wraps.
+- **Plane selection in ONE function**: ``select_plane`` is the single
+  predicate table; the gate matrix (intervals x skip_bad_spans x
+  backend x native-missing x op DAG x breaker) is pinned combination
+  by combination, including the rejection reasons ``hbam explain``
+  prints.
+- **Digest stability**: the IR serialization is canonical — same plan,
+  same digest across processes; any field change moves it; the format
+  matches ``jobs.journal.plan_digest`` (24 hex chars) so the two can
+  share journal headers.
+"""
+import dataclasses
+import json
+import re
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.formats.bamio import BamWriter
+from hadoop_bam_tpu.plan import builders
+from hadoop_bam_tpu.plan.executor import select_plane
+from hadoop_bam_tpu.plan.ir import (
+    PlanIR, SinkIR, SourceIR, SpansIR, op_node,
+)
+from tests.fixtures import make_header, make_records
+
+pytestmark = pytest.mark.plan
+
+
+@pytest.fixture(scope="module")
+def bam(tmp_path_factory):
+    d = tmp_path_factory.mktemp("plan")
+    header = make_header()
+    recs = make_records(header, 500, seed=11)
+    path = str(d / "plan.bam")
+    with BamWriter(path, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    return path, header, recs
+
+
+# ---------------------------------------------------------------------------
+# IR digest
+# ---------------------------------------------------------------------------
+
+def test_digest_stable_and_plan_digest_compatible(bam):
+    path, _, _ = bam
+    a = builders.flagstat_plan(path)
+    b = builders.flagstat_plan(path)
+    assert a == b
+    assert a.digest() == b.digest()
+    # the jobs.journal.plan_digest format: 24 lowercase hex chars
+    assert re.fullmatch(r"[0-9a-f]{24}", a.digest())
+    # any field change moves the digest
+    other = builders.flagstat_plan(path + ".other")
+    assert other.digest() != a.digest()
+    cfg = dataclasses.replace(DEFAULT_CONFIG, bam_intervals="chr1")
+    assert builders.flagstat_plan(path, cfg).digest() != a.digest()
+    # and the doc round-trips through canonical JSON
+    doc = json.loads(json.dumps(a.to_doc(), sort_keys=True))
+    assert doc["source"]["fmt"] == "bam"
+    assert doc["sink"]["kind"] == "flagstat"
+    assert [o["op"] for o in doc["ops"]] == ["project", "flagstat_reduce"]
+
+
+def test_pinned_spans_and_param_normalization():
+    s = SpansIR.pin([("f.bam", 7, 99)])
+    assert s.mode == "pinned" and s.pinned == (("f.bam", 7, 99),)
+    assert "pinned" in s.summary()
+    # list and tuple params digest identically
+    assert op_node("x", cols=["a", "b"]) == op_node("x", cols=("a", "b"))
+    with pytest.raises(TypeError):
+        op_node("x", bad=object())
+    plan = PlanIR(SourceIR("f.bam", "bam", role="chunk"), s,
+                  (op_node("chunk_decode"),), SinkIR.of("chunk_columns"))
+    assert plan.to_doc()["spans"]["pinned"][0][1:] == [7, 99]
+
+
+# ---------------------------------------------------------------------------
+# plane selection: the gate matrix
+# ---------------------------------------------------------------------------
+
+_FLAG_SRC = SourceIR("x.bam", "bam")
+_FLAG_OPS = (op_node("project"), op_node("flagstat_reduce"))
+_PAYLOAD_OPS = (op_node("payload_pack"), op_node("seq_stats_reduce"))
+
+
+def _cfg(**kw):
+    return dataclasses.replace(HBamConfig(), **kw)
+
+
+def _rejected(decision):
+    return dict(decision.rejected)
+
+
+def test_select_native_clean_path():
+    from hadoop_bam_tpu.ops.inflate import fused_available
+    d = select_plane(_FLAG_SRC, _FLAG_OPS,
+                     _cfg(inflate_backend="native"))
+    assert d.plane == "native" and d.backend == "native"
+    assert d.host_backend == "native"
+    assert d.use_fused == fused_available()
+    assert d.stream_fused == fused_available()
+    assert "device" in _rejected(d)
+
+
+def test_select_zlib_pins_portable_plane():
+    d = select_plane(_FLAG_SRC, _FLAG_OPS, _cfg(inflate_backend="zlib"))
+    assert d.plane == "zlib"
+    assert not d.use_fused and not d.stream_fused
+    rej = _rejected(d)
+    assert "native" in rej and "fused" in rej
+
+
+def test_select_device_full_gate_pass():
+    d = select_plane(_FLAG_SRC, _FLAG_OPS,
+                     _cfg(inflate_backend="device"))
+    assert d.plane == "device"
+    assert d.host_backend == "auto"      # host fallback rides auto
+
+
+def test_select_device_rejected_by_intervals():
+    d = select_plane(_FLAG_SRC, _FLAG_OPS,
+                     _cfg(inflate_backend="device"), intervals=[()])
+    assert d.plane == "native"
+    assert "whole-span offsets" in _rejected(d)["device"]
+    # fused streaming is gated by the same condition
+    assert not d.stream_fused
+
+
+def test_select_device_rejected_by_skip_bad_spans():
+    d = select_plane(_FLAG_SRC, _FLAG_OPS,
+                     _cfg(inflate_backend="device", skip_bad_spans=True))
+    assert d.plane == "native"
+    assert "quarantine" in _rejected(d)["device"]
+    assert not d.stream_fused
+
+
+def test_select_device_rejected_for_non_device_dag():
+    d = select_plane(_FLAG_SRC, _PAYLOAD_OPS,
+                     _cfg(inflate_backend="device"))
+    assert d.plane == "native"
+    assert "op DAG" in _rejected(d)["device"]
+    # but the payload family keeps fused streaming when eligible
+    from hadoop_bam_tpu.ops.inflate import fused_available
+    assert d.stream_fused == fused_available()
+
+
+def test_select_device_rejected_by_open_breaker():
+    class OpenLadder:
+        probes = 0
+
+        def allow_plane(self, plane):
+            self.probes += 1
+            return False
+
+    lad = OpenLadder()
+    d = select_plane(_FLAG_SRC, _FLAG_OPS,
+                     _cfg(inflate_backend="device"), ladder=lad)
+    assert d.plane == "native"
+    assert "breaker" in _rejected(d)["device"]
+    assert lad.probes == 1
+
+    # the probe slot is consumed ONLY when every other gate passed
+    lad2 = OpenLadder()
+    select_plane(_FLAG_SRC, _FLAG_OPS,
+                 _cfg(inflate_backend="device", skip_bad_spans=True),
+                 ladder=lad2)
+    assert lad2.probes == 0
+
+
+def test_select_native_missing_disables_fused(monkeypatch):
+    from hadoop_bam_tpu.ops import inflate as inflate_ops
+    monkeypatch.setattr(inflate_ops, "fused_available", lambda: False)
+    d = select_plane(_FLAG_SRC, _FLAG_OPS,
+                     _cfg(inflate_backend="native"))
+    assert d.plane == "native"
+    assert not d.use_fused and not d.stream_fused
+    assert "unavailable" in _rejected(d)["fused"]
+    # explicit device WITHOUT the native tokenizer still selects device:
+    # the runner raises PlanError (configuration fault), selection must
+    # not silently reroute a user's explicit plane choice
+    d2 = select_plane(_FLAG_SRC, _FLAG_OPS,
+                      _cfg(inflate_backend="device"))
+    assert d2.plane == "device"
+
+
+def test_select_fused_off_by_config():
+    d = select_plane(_FLAG_SRC, _FLAG_OPS,
+                     _cfg(inflate_backend="native",
+                          use_fused_decode=False))
+    assert not d.use_fused and not d.stream_fused
+    assert "use_fused_decode" in _rejected(d)["fused"]
+
+
+def test_plane_report_families():
+    from hadoop_bam_tpu.plan.executor import plane_report
+    rep = plane_report(_cfg(inflate_backend="native"))
+    assert set(rep) == {"flagstat", "payload", "variant"}
+    for fam in rep.values():
+        assert fam["plane"] in ("device", "native", "zlib")
+        assert isinstance(fam["rejected"], dict)
+
+
+# ---------------------------------------------------------------------------
+# byte/value identity: plan path vs the pre-refactor direct path
+# ---------------------------------------------------------------------------
+
+def test_flagstat_plan_path_identical(bam):
+    from hadoop_bam_tpu.parallel.pipeline import (
+        _flagstat_impl, flagstat_file,
+    )
+    path, header, _ = bam
+    via_plan = flagstat_file(path, header=header)
+    inline = _flagstat_impl(path, header=header)
+    assert via_plan == inline
+    assert via_plan["total"] == 500
+
+
+def test_seq_stats_plan_path_identical(bam):
+    from hadoop_bam_tpu.parallel.pipeline import (
+        _seq_stats_impl, seq_stats_file,
+    )
+    path, header, _ = bam
+    via_plan = seq_stats_file(path, header=header)
+    inline = _seq_stats_impl(path, header=header)
+    assert via_plan["n_reads"] == inline["n_reads"] > 0
+    assert via_plan["mean_gc"] == inline["mean_gc"]
+    assert via_plan["mean_qual"] == inline["mean_qual"]
+    assert np.array_equal(via_plan["base_hist"], inline["base_hist"])
+
+
+def test_variant_stats_plan_path_identical(tmp_path):
+    from hadoop_bam_tpu.formats.vcf import VCFHeader, VcfRecord
+    from hadoop_bam_tpu.parallel.variant_pipeline import (
+        _variant_stats_impl, variant_stats_file,
+    )
+    hdr = ("##fileformat=VCFv4.2\n"
+           "##contig=<ID=c1,length=100000>\n"
+           '##FORMAT=<ID=GT,Number=1,Type=String,Description="G">\n'
+           "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\ts0\n")
+    path = str(tmp_path / "v.vcf")
+    with open(path, "w") as f:
+        f.write(hdr)
+        for i in range(300):
+            gt = ("0/1", "1/1", "0/0", "./.")[i % 4]
+            f.write(f"c1\t{100 + i}\t.\tA\tG\t30\tPASS\t.\tGT\t{gt}\n")
+    via_plan = variant_stats_file(path)
+    inline = _variant_stats_impl(path)
+    for k in ("n_variants", "n_snp", "n_pass", "mean_af", "n_af"):
+        assert via_plan[k] == inline[k]
+    assert via_plan["n_variants"] == 300
+    assert np.array_equal(via_plan["sample_callrate"],
+                          inline["sample_callrate"])
+
+
+def test_query_chunk_plan_path_identical(bam, tmp_path):
+    from hadoop_bam_tpu.parallel.pipeline import decode_with_retry
+    from hadoop_bam_tpu.query.engine import QueryEngine
+    from hadoop_bam_tpu.split.spans import FileVirtualSpan
+    from hadoop_bam_tpu.tools.cli import main
+    path, header, _ = bam
+    assert main(["index", "--flavor", "bai", path]) == 0
+    engine = QueryEngine(config=DEFAULT_CONFIG)
+    meta = engine._file_meta(path)
+    _iv, ranges = engine._resolve(meta, "chr1")
+    chunks = engine._coalesce(ranges, meta.kind)
+    assert chunks
+    s, e = chunks[0]
+    via_plan, cost = engine._compute_chunk(meta, s, e)
+    direct = decode_with_retry(
+        lambda sp: engine._decode_chunk(meta, sp),
+        FileVirtualSpan(meta.path, s, e), engine.config)
+    assert cost == int(direct["nbytes"])
+    assert via_plan["n"] == direct["n"] > 0
+    for k in ("rid", "pos1", "end1"):
+        assert np.array_equal(via_plan[k], direct[k])
+    assert np.array_equal(via_plan["batch"].data, direct["batch"].data)
+
+
+def test_cohort_plan_path_identical(tmp_path):
+    """tensor_batches (plan path, executor-wired feed) vs an inline
+    replica of the pre-refactor wiring (variant_feed + device_put)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hadoop_bam_tpu.cohort import CohortDataset
+    from hadoop_bam_tpu.parallel.mesh import make_mesh
+    from hadoop_bam_tpu.parallel.variant_pipeline import variant_feed
+
+    hdr = ("##fileformat=VCFv4.2\n"
+           "##contig=<ID=c1,length=100000>\n"
+           '##FORMAT=<ID=GT,Number=1,Type=String,Description="G">\n')
+
+    def write_sample(name, offset):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            f.write(hdr + "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\t"
+                          f"INFO\tFORMAT\t{name}\n")
+            for i in range(60):
+                gt = ("0/1", "1/1", "0/0")[(i + offset) % 3]
+                f.write(f"c1\t{50 + 3 * i}\t.\tA\tT\t9\tPASS\t.\t"
+                        f"GT\t{gt}\n")
+        return p
+
+    paths = [write_sample(f"s{i}.vcf", i) for i in range(3)]
+
+    ds = CohortDataset(paths)
+    got = list(ds.tensor_batches())
+
+    ds2 = CohortDataset(paths)
+    mesh = make_mesh()
+    n_dev = int(np.prod(mesh.devices.shape))
+    sharding = NamedSharding(mesh, P("data"))
+    keys, fp, tuples = variant_feed(ds2.site_chunks(), n_dev,
+                                    ds2.geometry.tile_records,
+                                    ds2.config, fixed_shape=True,
+                                    fmt="cohort")
+
+    def emit(arrays, counts):
+        out = {k: jax.device_put(a, sharding)
+               for k, a in zip(keys, arrays)}
+        out["n_records"] = jax.device_put(counts, sharding)
+        return out
+
+    want = list(fp.stream(tuples, emit))
+    assert len(got) == len(want) > 0
+    for g, w in zip(got, want):
+        assert set(g) == set(w)
+        for k in g:
+            ga, wa = np.asarray(g[k]), np.asarray(w[k])
+            assert np.array_equal(ga, wa, equal_nan=(ga.dtype.kind
+                                                     == "f"))
+
+
+def test_cohort_tensor_batches_stays_lazy(tmp_path):
+    """Building the batch iterator must start no join and open no
+    journal (the executor runner is a generator)."""
+    from hadoop_bam_tpu.cohort import CohortDataset
+    hdr = ("##fileformat=VCFv4.2\n"
+           "##contig=<ID=c1,length=1000>\n"
+           '##FORMAT=<ID=GT,Number=1,Type=String,Description="G">\n')
+    p = str(tmp_path / "s.vcf")
+    with open(p, "w") as f:
+        f.write(hdr + "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\t"
+                      "FORMAT\ts\n")
+        f.write("c1\t10\t.\tA\tT\t9\tPASS\t.\tGT\t0/1\n")
+    jp = str(tmp_path / "j.hbam-journal")
+    ds = CohortDataset([p], journal_path=jp)
+    it = ds.tensor_batches()          # built, never iterated
+    import os
+    assert not os.path.exists(jp)
+    assert not ds._journal_live
+    del it
+    assert len(list(ds.tensor_batches())) >= 1   # still usable after
+
+
+# ---------------------------------------------------------------------------
+# journal seam + executor surface
+# ---------------------------------------------------------------------------
+
+def test_plan_journal_params_carries_digest(bam):
+    from hadoop_bam_tpu.jobs.runner import plan_journal_params
+    path, _, _ = bam
+    plan = builders.flagstat_plan(path)
+    params = plan_journal_params(plan, {"input": path})
+    assert params["plan_digest"] == plan.digest()
+    assert params["input"] == path
+
+
+def test_execute_counts_and_rejects_unknown_sink(bam):
+    from hadoop_bam_tpu.plan.executor import execute
+    from hadoop_bam_tpu.utils.errors import PlanError
+    from hadoop_bam_tpu.utils.metrics import METRICS, MetricsContext
+    path, header, _ = bam
+    bad = PlanIR(SourceIR(path, "bam"), SpansIR.auto(),
+                 (op_node("nope"),), SinkIR.of("nope"))
+    with pytest.raises(PlanError):
+        execute(bad)
+    with MetricsContext():
+        from hadoop_bam_tpu.parallel.pipeline import flagstat_file
+        flagstat_file(path, header=header)
+        snap = METRICS.snapshot()
+    assert snap["counters"]["plan.executions"] == 1
+
+
+def test_explain_cli_text_and_json(bam, capsys):
+    from hadoop_bam_tpu.tools.cli import main
+    path, _, _ = bam
+    assert main(["explain", "flagstat", path]) == 0
+    out = capsys.readouterr().out
+    assert "plane   " in out and "sink    flagstat" in out
+
+    assert main(["explain", "flagstat", path, "--json",
+                 "--inflate-backend", "device",
+                 "--skip-bad-spans"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["digest"] == builders.flagstat_plan(path).digest()
+    assert doc["decision"]["plane"] == "native"
+    assert "quarantine" in doc["decision"]["rejected"]["device"]
+
+
+def test_explain_cli_query_pins_chunks(bam, capsys):
+    from hadoop_bam_tpu.tools.cli import main
+    path, _, _ = bam
+    main(["index", "--flavor", "bai", path])
+    capsys.readouterr()               # drain the index verb's output
+    assert main(["explain", "query", path, "--region", "chr1",
+                 "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["plan"]["source"]["role"] == "chunk"
+    assert len(doc["plan"]["spans"]["pinned"]) >= 1
+    ops = [o["op"] for o in doc["plan"]["ops"]]
+    assert ops == ["chunk_decode", "overlap_filter"]
+
+
+def test_explain_cli_cohort(tmp_path, capsys):
+    from hadoop_bam_tpu.tools.cli import main
+    hdr = ("##fileformat=VCFv4.2\n"
+           "##contig=<ID=c1,length=1000>\n"
+           '##FORMAT=<ID=GT,Number=1,Type=String,Description="G">\n')
+    p = tmp_path / "s.vcf"
+    p.write_text(hdr + "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\t"
+                       "FORMAT\ts\nc1\t10\t.\tA\tT\t9\tPASS\t.\tGT\t"
+                       "0/1\n")
+    man = tmp_path / "cohort.json"
+    man.write_text(json.dumps(
+        {"samples": [{"id": "s", "path": str(p)}]}))
+    assert main(["explain", "cohort", str(man), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["plan"]["source"]["role"] == "join"
+    assert doc["plan"]["sink"]["kind"] == "tensor_batches"
+    assert doc["plan"]["ops"][0]["op"] == "kway_join"
+    assert doc["plan"]["ops"][0]["params"]["samples"] == 1
