@@ -907,10 +907,19 @@ def bench_region_serve(path: str):
     4. PREFETCH: a fresh loop with prefetch ON serving the zipf order —
        prefetch usefulness (useful/issued) and realistic first-pass
        tile hit rate.
+    5. FLEET: two REAL replica subprocesses (rendezvous ownership,
+       replication 1, hedged peer-fetch over TCP): wire q/s against 1
+       then both endpoints, the cross-replica tile hit rate from the
+       fleet counters (peer-fetched / decoded-anywhere), and the
+       kill-one-replica arm — SIGKILL one replica and measure the
+       surviving replica's client-observed p99 through the failover
+       (every request must still answer; peer faults fall back to
+       local decode, never to the client).
 
     Acceptance bars: warm tile-hit p50 >= 5x better than cold p50 (vs
     the 3.1-3.7x byte-LRU-only warm speedup of PR 5), warm host_decode
-    share ~0, q/s(8 clients) >= q/s(1 client)."""
+    share ~0, q/s(8 clients) >= q/s(1 client), zero failed fleet
+    requests through the kill."""
     import dataclasses as _dc
     import threading as _th
 
@@ -990,6 +999,9 @@ def bench_region_serve(path: str):
         zipf_hits = p1["hits"] - p0["hits"]
         zipf_total = zipf_hits + p1["misses"] - p0["misses"]
 
+    # -- arm 5: the replica fleet (2 subprocesses, SIGKILL failover) --
+    fleet = _fleet_serve_arm(bam, regions)
+
     cold_qps = len(unique) / cold_dt
     warm_qps = len(regions) / warm_dt
     cold_p50 = cold_lat.get("p50", 0.0)
@@ -1011,11 +1023,154 @@ def bench_region_serve(path: str):
             "clients_qps": clients_qps,
             "regions": len(regions),
             "distinct_windows": len(unique),
+            **fleet,
             "note": ("zipf 250-region set via ServeLoop; cold = each "
                      "distinct window first-touch (prefetch off); warm "
                      "= all-tile-hit zipf set (no decode at all); "
                      "vs_baseline = cold_p50/warm_p50, bar >= 5x; "
-                     "clients_qps pins 1->8 client saturation")}
+                     "clients_qps pins 1->8 client saturation; "
+                     "fleet_qps pins 1->2 replica endpoints, "
+                     "fleet_kill_p99_ms the client-observed failover")}
+
+
+_FLEET_REPLICA_SRC = """
+import dataclasses, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from hadoop_bam_tpu.config import DEFAULT_CONFIG
+from hadoop_bam_tpu.serve import ServeLoop, make_tcp_server
+rid, port, peers, warm = sys.argv[1], int(sys.argv[2]), sys.argv[3], \\
+    sys.argv[4]
+cfg = dataclasses.replace(
+    DEFAULT_CONFIG, serve_replica_id=rid, serve_peers=peers,
+    fleet_replication=1, fleet_heartbeat_s=0.15, fleet_suspicion_s=0.6,
+    fleet_eviction_s=1.5, breaker_cooldown_s=0.5,
+    breaker_failure_threshold=2.0, serve_prefetch=False)
+with ServeLoop(config=cfg) as loop:
+    loop.engine._file_meta(warm)
+    server = make_tcp_server(loop, host="127.0.0.1", port=port)
+    print("READY", flush=True)
+    server.serve_forever()
+"""
+
+
+def _fleet_serve_arm(bam: str, regions):
+    """Arm 5 of ``bench_region_serve``: a real 2-replica fleet.  Every
+    request is a wire round trip (socket JSONL), so the numbers are
+    endpoint-observed, failover included."""
+    import json as _json
+    import socket as _socket
+    import tempfile as _tf
+    import threading as _th
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def wire(port, doc, timeout=30.0):
+        with _socket.create_connection(("127.0.0.1", port),
+                                       timeout=timeout) as s:
+            s.settimeout(timeout)
+            f = s.makefile("rw", encoding="utf-8", newline="\n")
+            f.write(_json.dumps(doc) + "\n")
+            f.flush()
+            return _json.loads(f.readline())
+
+    p1, p2 = free_port(), free_port()
+    peers = f"r1=127.0.0.1:{p1},r2=127.0.0.1:{p2}"
+    with _tf.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_FLEET_REPLICA_SRC)
+        script = f.name
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+
+    def spawn(rid, port):
+        return subprocess.Popen(
+            [sys.executable, script, rid, str(port), peers, bam],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+
+    def await_healthy(port, deadline_s=180.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            try:
+                if wire(port, {"op": "health", "id": 1},
+                        timeout=2.0).get("health"):
+                    return
+            except (OSError, ValueError):
+                time.sleep(0.25)
+        raise TimeoutError(f"fleet replica on {port} never healthy")
+
+    subset = regions[:60]
+    failed = [0]
+
+    def drive(ports, rs, threads=4):
+        slices = [rs[i::threads] for i in range(threads)]
+
+        def client(i, chunk):
+            for j, region in enumerate(chunk):
+                port = ports[(i + j) % len(ports)]
+                try:
+                    doc = wire(port, {"id": 1, "path": bam,
+                                      "region": region})
+                    if "error" in doc:
+                        failed[0] += 1
+                except (OSError, ValueError):
+                    failed[0] += 1
+
+        t0 = time.perf_counter()
+        ts = [_th.Thread(target=client, args=(i, c))
+              for i, c in enumerate(slices) if c]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return len(rs) / (time.perf_counter() - t0)
+
+    procs = [spawn("r1", p1), spawn("r2", p2)]
+    try:
+        await_healthy(p1)
+        await_healthy(p2)
+        drive([p1, p2], subset)                      # warm both tiles
+        qps_one = drive([p1], subset)                # 1 endpoint
+        qps_two = drive([p1, p2], subset)            # both endpoints
+        fl1 = wire(p1, {"op": "fleet", "id": 1})["fleet"]
+        fl2 = wire(p2, {"op": "fleet", "id": 1})["fleet"]
+        fetched = fl1["peer_fetch_ok"] + fl2["peer_fetch_ok"]
+        decoded = fl1["local_decodes"] + fl2["local_decodes"]
+        cross_rate = fetched / max(1, fetched + decoded)
+        # the kill arm: SIGKILL r2, then the surviving endpoint's
+        # client-observed latency through eviction + re-ranking
+        procs[1].kill()
+        procs[1].wait(timeout=30)
+        lats = []
+        for region in subset[:40]:
+            t0 = time.perf_counter()
+            doc = wire(p1, {"id": 1, "path": bam, "region": region})
+            lats.append(time.perf_counter() - t0)
+            if "error" in doc:
+                failed[0] += 1
+        lats.sort()
+        kill_p99 = lats[int(0.99 * (len(lats) - 1))]
+        return {"fleet_replicas": 2,
+                "fleet_qps": [[1, round(qps_one, 1)],
+                              [2, round(qps_two, 1)]],
+                "cross_replica_tile_hit_rate": round(cross_rate, 4),
+                "fleet_kill_p99_ms": round(kill_p99 * 1e3, 3),
+                "fleet_failed_requests": failed[0]}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass
+        os.unlink(script)
 
 
 def bench_faulted_serve(path: str):
@@ -2601,7 +2756,7 @@ def main() -> None:
     _run_component(lambda: bench_region_query(path),
                    "region_query_queries_per_sec", est_s=45)
     _run_component(lambda: bench_region_serve(path),
-                   "region_serve_queries_per_sec", est_s=50)
+                   "region_serve_queries_per_sec", est_s=110)
     _run_component(lambda: bench_faulted_serve(path),
                    "faulted_serve_queries_per_sec", est_s=50)
     _run_component(lambda: bench_obs_overhead(path),

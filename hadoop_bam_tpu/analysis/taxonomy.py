@@ -33,7 +33,10 @@ from hadoop_bam_tpu.analysis.core import Finding, Project, register
 # (transport.error_kind) or poisons the parallel writer with a class
 # the retry policy misreads — and in ISSUE 12 to the cohort plane's
 # boundary modules, where the class decides whether a faulting sample
-# input QUARANTINES (data) or fails the build (configuration)
+# input QUARANTINES (data) or fails the build (configuration).
+# ISSUE 16 adds the fleet modules, where the class also decides
+# whether a peer answer feeds that peer's circuit breaker (PLAN never
+# does) and what error_kind a peer sees on the wire
 SCOPE = (
     "hadoop_bam_tpu/formats/bgzf.py",
     "hadoop_bam_tpu/formats/bamio.py",
@@ -52,6 +55,8 @@ SCOPE = (
     "hadoop_bam_tpu/serve/tenancy.py",
     "hadoop_bam_tpu/serve/prefetch.py",
     "hadoop_bam_tpu/serve/tiles.py",
+    "hadoop_bam_tpu/serve/fleet.py",
+    "hadoop_bam_tpu/serve/membership.py",
     "hadoop_bam_tpu/cohort/manifest.py",
     "hadoop_bam_tpu/cohort/join.py",
     "hadoop_bam_tpu/cohort/serving.py",

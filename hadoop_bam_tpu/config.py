@@ -114,6 +114,16 @@ _HADOOP_KEY_MAP = {
     "hbam.serve-tenant-queue-depth": "serve_tenant_queue_depth",
     "hbam.serve-max-tenants": "serve_max_tenants",
     "hbam.serve-ring-slots": "serve_ring_slots",
+    # fleet knobs (serve/fleet.py + serve/membership.py; no reference
+    # analog — Hadoop-BAM had no serving tier to replicate)
+    "hbam.serve-replica-id": "serve_replica_id",
+    "hbam.serve-peers": "serve_peers",
+    "hbam.fleet-replication": "fleet_replication",
+    "hbam.fleet-heartbeat-s": "fleet_heartbeat_s",
+    "hbam.fleet-suspicion-s": "fleet_suspicion_s",
+    "hbam.fleet-eviction-s": "fleet_eviction_s",
+    "hbam.fleet-peer-timeout-s": "fleet_peer_timeout_s",
+    "hbam.fleet-hedge-min-s": "fleet_hedge_min_s",
     # resilience knobs (resilience/; no reference analog — Hadoop's only
     # adaptive behavior was task re-execution)
     "hbam.adaptive-planes": "adaptive_planes",
@@ -419,6 +429,26 @@ class HBamConfig:
     #                                     builder (>= 3: one filling plus
     #                                     pinned-in-transfer slack)
 
+    # --- serving fleet (serve/fleet.py, serve/membership.py) ---
+    serve_replica_id: Optional[str] = None  # this process's fleet member
+    #                                     id; None = not fleet-joined
+    serve_peers: str = ""               # "id=host:port,..." peer list;
+    #                                     empty = single-replica serving
+    fleet_replication: int = 2          # R: rendezvous owners per tile
+    #                                     key (self counts when ranked)
+    fleet_heartbeat_s: float = 0.25     # peer heartbeat cadence
+    fleet_suspicion_s: float = 1.5      # no heartbeat for this long ->
+    #                                     SUSPECT (ownership unchanged)
+    fleet_eviction_s: float = 5.0       # suspect for this long ->
+    #                                     EVICTED from the member set
+    #                                     (ownership re-ranks)
+    fleet_peer_timeout_s: float = 2.0   # per-peer-call socket cap; the
+    #                                     request's enqueue-anchored
+    #                                     deadline still binds below it
+    fleet_hedge_min_s: float = 0.05     # hedged peer-fetch soft-deadline
+    #                                     floor (p95 * straggler_multiplier,
+    #                                     never below this)
+
     # --- TPU backend ---
     backend: str = "tpu"                  # "tpu" | "cpu" (host NumPy decode)
     blocks_per_batch: int = 512           # BGZF blocks per device batch
@@ -466,7 +496,9 @@ def _coerce(kwargs: dict) -> dict:
               "cohort_max_quarantine_fraction", "pool_task_timeout_s",
               "straggler_multiplier", "straggler_min_s",
               "collective_timeout_s", "slo_latency_s", "slo_target",
-              "slo_tick_s"):
+              "slo_tick_s", "fleet_heartbeat_s", "fleet_suspicion_s",
+              "fleet_eviction_s", "fleet_peer_timeout_s",
+              "fleet_hedge_min_s"):
         if k in out and isinstance(out[k], str):
             out[k] = float(out[k])
     for k in ("span_retries", "io_read_retries", "feed_ring_slots",
@@ -482,7 +514,8 @@ def _coerce(kwargs: dict) -> dict:
               "serve_max_tenants", "serve_ring_slots",
               "breaker_half_open_probes", "chaos_seed",
               "cohort_chunk_sites", "serve_cohort_manifests",
-              "flight_dump_cap", "slo_min_events"):
+              "flight_dump_cap", "slo_min_events",
+              "fleet_replication"):
         if k in out and isinstance(out[k], str):
             out[k] = int(out[k])
     return out

@@ -51,6 +51,18 @@ class UnitLatency:
             multiplier=float(getattr(config, "straggler_multiplier", 4.0)),
             min_s=float(getattr(config, "straggler_min_s", 0.5)))
 
+    @classmethod
+    def for_peer_fetch(cls, config) -> "UnitLatency":
+        """The serving fleet's hedged peer-fetch tracker: same decaying
+        p95 machinery, but floored at ``fleet_hedge_min_s`` (peer RTTs
+        are milliseconds, not span decodes — the straggler floor would
+        never hedge) and warmed after fewer samples (a fleet that just
+        booted should start hedging within one zipf pass)."""
+        return cls(
+            multiplier=float(getattr(config, "straggler_multiplier", 4.0)),
+            min_s=float(getattr(config, "fleet_hedge_min_s", 0.05)),
+            min_samples=8)
+
     def observe(self, seconds: float) -> None:
         with self._lock:
             self.hist.record(max(float(seconds), 0.0))

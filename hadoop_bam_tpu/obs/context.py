@@ -67,6 +67,23 @@ def new_trace_id() -> str:
     return os.urandom(8).hex()
 
 
+# the fleet identity of THIS process (serve/fleet.py sets it once at
+# replica construction): stamped on every span event so a Chrome-trace
+# or flight dump assembled from N replicas attributes each span to the
+# process that did the work.  None outside a fleet — spans stay as they
+# were, zero overhead beyond one global read.
+_REPLICA_ID: Optional[str] = None
+
+
+def set_replica_id(replica_id: Optional[str]) -> None:
+    global _REPLICA_ID
+    _REPLICA_ID = str(replica_id) if replica_id is not None else None
+
+
+def replica_id() -> Optional[str]:
+    return _REPLICA_ID
+
+
 def current_trace() -> Optional[TraceContext]:
     """The active TraceContext, or None outside any entry point."""
     return _CURRENT.get()

@@ -199,6 +199,36 @@ def select_plane(source: SourceIR, ops: Tuple[TensorOpIR, ...],
                          stream_fused=stream, rejected=tuple(rejected))
 
 
+def select_chunk_source(*, tile_cached: bool, fleet_owned: bool,
+                        degraded: bool, want_records: bool,
+                        peer_ready: bool) -> Tuple[str, str]:
+    """THE chunk-source predicate for the serving fleet: which plane
+    answers one chunk of a region query — ``"tile"`` (device-resident
+    tile, no work), ``"local"`` (host fetch+inflate+decode on this
+    replica), or ``"peer"`` (fetch the decoded columns from the chunk's
+    rendezvous owner, so a warm peer beats local host decode).
+
+    Lives HERE for the same reason ``select_plane`` does: the serving
+    loop consumes a decision instead of re-deriving routing gates, and
+    ``hbam explain``/health surfaces can show why a chunk went where.
+    Returns ``(source, reason)``."""
+    if tile_cached:
+        return "tile", "device-resident tile hit"
+    if degraded:
+        # quorum lost: serve what we own locally rather than erroring —
+        # peers we cannot see cannot be owners we can reach
+        return "local", "degraded partition mode (no quorum)"
+    if want_records:
+        # record materialization reads the host chunk anyway; a peer
+        # round trip would be pure overhead on top of the local decode
+        return "local", "records mode needs the local host chunk"
+    if fleet_owned:
+        return "local", "this replica is a rendezvous owner"
+    if not peer_ready:
+        return "local", "no reachable peer owner (breakers/eviction)"
+    return "peer", "peer-owned chunk: fetch decoded columns"
+
+
 def plane_report(config: Optional[HBamConfig] = None) -> Dict[str, Dict]:
     """Display-only decision table per driver family for this process +
     config — the ``hbam serve`` health surface.  Never consumes breaker

@@ -23,6 +23,9 @@ point                     instrumented at
 ``write.deflate``         ``ParallelBGZFWriter._deflate`` pool workers
 ``serve.transport``       ``serve/transport.handle_stream`` per line
                           (an injected disconnect)
+``serve.peer``            ``serve/fleet.Fleet._peer_call`` before the
+                          socket is opened (delay/drop/disconnect on
+                          every fleet heartbeat and peer-fetch)
 ========================  =================================================
 
 Faults raise the PR-1 taxonomy (``TransientIOError`` for "transient",
@@ -49,7 +52,8 @@ from hadoop_bam_tpu.utils.errors import CorruptDataError, TransientIOError
 from hadoop_bam_tpu.utils.metrics import METRICS
 
 KNOWN_POINTS = ("pool.submit", "pool.task", "decode.native",
-                "device.step", "write.deflate", "serve.transport")
+                "device.step", "write.deflate", "serve.transport",
+                "serve.peer")
 
 FAULT_KINDS = ("transient", "corrupt", "disconnect", "delay")
 

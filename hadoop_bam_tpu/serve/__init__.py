@@ -20,8 +20,20 @@ PR-5 query engine without changing it:
   single-threaded device dispatcher, per-client MetricsContext
   isolation, ``serve.*`` spans/histograms through the PR-6 obs layer.
 - ``transport.py`` JSONL over stdin/stdout or TCP (``hbam serve``).
+- ``membership.py`` rendezvous (HRW) tile ownership + heartbeat-observed
+  fleet membership with suspicion/eviction (injectable clock).
+- ``fleet.py``     the replicated serving fleet: R-way tile ownership,
+  per-peer circuit breakers, enqueue-anchored deadline re-budgeting on
+  the wire, hedged peer-fetch of decoded tiles, degraded partition
+  mode, seamless failover (``hbam serve --peers --replica-id``).
 """
+from hadoop_bam_tpu.serve.fleet import (  # noqa: F401
+    Fleet, effective_deadline_s, parse_peers,
+)
 from hadoop_bam_tpu.serve.loop import ServeLoop, ServeResult  # noqa: F401
+from hadoop_bam_tpu.serve.membership import (  # noqa: F401
+    Membership, owners, rank_members, rendezvous_weight,
+)
 from hadoop_bam_tpu.serve.prefetch import Prefetcher  # noqa: F401
 from hadoop_bam_tpu.serve.tenancy import (  # noqa: F401
     PRIORITIES, TenantQuotas,

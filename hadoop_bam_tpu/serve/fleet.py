@@ -1,0 +1,514 @@
+"""The serving fleet: replicated tile ownership, failover, hedged fetch.
+
+``hbam serve`` stays one process; a FLEET is N of them, each running
+this module against a static peer roster (``--peers``/``--replica-id``).
+Tile ownership is assigned by rendezvous hashing over
+``(file_identity, chunk_range, projection)`` with
+``fleet_replication``-way replication (``serve/membership.py``), so a
+chunk's decoded tile lives device-resident on R replicas and everyone
+else peer-fetches the decoded columns instead of re-paying
+fetch + inflate + host_decode — the Compressed-Resident idea at fleet
+scale, and the reason a replica loss does not cold-start the tile tier.
+
+The robustness stack around every peer call:
+
+- ``chaos.fire("serve.peer")`` first — the injectable seam the chaos
+  soak drives (delay / transient / disconnect, like the five other
+  points);
+- a per-peer circuit breaker, ``("serve","peer",replica_id)`` in the
+  PROCESS resilience registry: a dead peer stops being dialed after
+  ``breaker_failure_threshold`` decayed failures, and REJOINS only
+  through half-open probes (the heartbeat doubles as the probe);
+- the originating request's enqueue-anchored deadline rides the wire
+  (``deadline_s`` + ``enqueue_age_s``), so a peer re-anchors to the
+  budget the CLIENT started with — admission wait and every prior hop
+  already count against it (PR 8's anchor, fleet-wide);
+- a hedge to the next-ranked replica when the call overruns the
+  decaying-p95 soft deadline (``jobs/speculate.UnitLatency``; first
+  result wins, the loser is abandoned to its socket timeout);
+- total peer failure falls back to LOCAL decode — peers being sick
+  never fails a request that this replica can answer itself.
+
+Membership is heartbeat-driven (one daemon thread, injectable clock);
+a replica that lost quorum keeps serving what it owns with
+``extra.degraded=true`` instead of erroring.  Forwarded work adopts the
+originating trace id and every span is stamped with this process's
+``replica_id`` (``obs/context.set_replica_id``), so one fleet request
+exports as ONE Chrome-trace tree across processes.
+"""
+from __future__ import annotations
+
+import base64
+import concurrent.futures as cf
+import json
+import socket as _socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.jobs.speculate import UnitLatency
+from hadoop_bam_tpu.obs import flight
+from hadoop_bam_tpu.obs.context import current_trace_id, set_replica_id
+from hadoop_bam_tpu.resilience import chaos, registry
+from hadoop_bam_tpu.serve.membership import Membership
+from hadoop_bam_tpu.utils.errors import (
+    CorruptDataError, PLAN, PlanError, TransientIOError, classify_error,
+)
+from hadoop_bam_tpu.utils.metrics import METRICS
+
+# sanity cap on the wire-carried enqueue age: a peer must re-anchor to
+# the originating budget, not to a corrupted/hostile timestamp
+_MAX_ENQUEUE_AGE_S = 3600.0
+_HEDGE_WORKERS = 4
+
+
+def parse_peers(spec: str) -> "Dict[str, Tuple[str, int]]":
+    """``"a=127.0.0.1:7001,b=127.0.0.1:7002"`` -> id -> (host, port).
+    A bare ``host:port`` entry uses the address itself as the id."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" in entry:
+            pid, addr = entry.split("=", 1)
+        else:
+            pid, addr = entry, entry
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise PlanError(
+                f"bad peer spec {entry!r} — want id=host:port "
+                f"(or host:port)")
+        out[pid.strip()] = (host.strip(), int(port))
+    return out
+
+
+def effective_deadline_s(deadline_s, enqueue_age_s) -> Optional[float]:
+    """The budget a peer request has LEFT, re-anchored to the
+    originating request's enqueue instant: the original ``deadline_s``
+    minus the elapsed age carried on the wire.  Returns None when the
+    request is unbudgeted; clamps at 0.0 (an exhausted budget must
+    surface as an immediate deadline miss, never a fresh budget)."""
+    if deadline_s is None:
+        return None
+    d = float(deadline_s)
+    try:
+        age = float(enqueue_age_s) if enqueue_age_s is not None else 0.0
+    except (TypeError, ValueError):
+        age = 0.0
+    if not (0.0 <= age <= _MAX_ENQUEUE_AGE_S):
+        age = 0.0
+    return max(0.0, d - age)
+
+
+def _peer_error(resp: Dict) -> BaseException:
+    """Rehydrate a peer's wire error into the PR-1 taxonomy class the
+    local policy boundaries expect (breakers, retry, quarantine)."""
+    msg = f"peer error: {resp.get('error')}"
+    kind = resp.get("kind")
+    if kind == "transient":
+        return TransientIOError(msg,
+                                retry_after_s=resp.get("retry_after_s"))
+    if kind == "plan":
+        return PlanError(msg)
+    if kind == "corrupt":
+        return CorruptDataError(msg)
+    return RuntimeError(msg)
+
+
+def encode_chunk_doc(value: Dict) -> Dict:
+    """The ``{"op": "chunk"}`` response payload: the decoded interval
+    columns of ``QueryEngine._chunk`` as base64 little-endian int32 —
+    everything a peer's TileBuilder needs, records excluded (record
+    materialization is always local)."""
+    def b64(col) -> str:
+        a = np.ascontiguousarray(np.asarray(col, np.int32))
+        return base64.b64encode(a.tobytes()).decode("ascii")
+
+    return {"n": int(value["n"]), "nbytes": int(value["nbytes"]),
+            "cols": {k: b64(value[k]) for k in ("rid", "pos1", "end1")}}
+
+
+def decode_chunk_doc(doc: Dict) -> Dict:
+    """Inverse of ``encode_chunk_doc``: a ``_chunk``-shaped value dict
+    (empty ``records`` — peer-fetched tiles serve counts; records mode
+    routes local).  Shape-checked: a short/oversized column is CORRUPT
+    (the taxonomy quarantine understands), not an index error later."""
+    n = int(doc["n"])
+    cols = doc["cols"]
+    out: Dict[str, object] = {"n": n, "nbytes": int(doc["nbytes"]),
+                              "records": []}
+    for k in ("rid", "pos1", "end1"):
+        a = np.frombuffer(base64.b64decode(cols[k]), dtype=np.int32)
+        if a.shape[0] != n:
+            raise CorruptDataError(
+                f"peer chunk column {k!r} has {a.shape[0]} rows, "
+                f"expected {n}")
+        out[k] = a
+    return out
+
+
+class Fleet:
+    """One replica's view of the serving fleet (module docstring).
+
+    Owns the heartbeat thread and a small hedge executor; attached to a
+    ``ServeLoop`` (``loop.fleet``) which consults
+    ``plan.executor.select_chunk_source`` per chunk and calls
+    ``fetch_chunk`` for peer-owned tiles."""
+
+    def __init__(self, config: HBamConfig = DEFAULT_CONFIG, *,
+                 replica_id: Optional[str] = None,
+                 peers: Optional[Dict[str, Tuple[str, int]]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config
+        rid = replica_id if replica_id is not None else \
+            getattr(config, "serve_replica_id", None)
+        if not rid:
+            raise PlanError("a fleet replica needs a replica id "
+                            "(--replica-id / config.serve_replica_id)")
+        self.replica_id = str(rid)
+        self.peers = dict(peers) if peers is not None else \
+            parse_peers(getattr(config, "serve_peers", ""))
+        self.peers.pop(self.replica_id, None)   # never dial ourselves
+        self.replication = max(1, int(
+            getattr(config, "fleet_replication", 2)))
+        self.heartbeat_s = float(getattr(config, "fleet_heartbeat_s", 0.25))
+        self.peer_timeout_s = float(
+            getattr(config, "fleet_peer_timeout_s", 2.0))
+        self.membership = Membership(
+            self.replica_id, list(self.peers),
+            suspicion_s=float(getattr(config, "fleet_suspicion_s", 1.5)),
+            eviction_s=float(getattr(config, "fleet_eviction_s", 5.0)),
+            clock=clock)
+        # hedged peer-fetch soft deadline: the fleet's OWN decaying
+        # latency distribution (jobs/speculate.py), floored well below
+        # the straggler default — peer RTTs are milliseconds, not span
+        # decodes
+        self.latency = UnitLatency.for_peer_fetch(config)
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._executor: Optional[cf.ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+        # provenance counters (states() + the bench's fleet arm)
+        self.peer_fetch_ok = 0
+        self.peer_fetch_failed = 0
+        self.local_decodes = 0       # chunks this replica host-decoded
+        self.chunks_served = 0       # inbound {"op":"chunk"} answered
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.degraded_serves = 0
+        # every span this process emits carries the replica id from now
+        # on — the trace-hop contract (one fleet request, one tree)
+        set_replica_id(self.replica_id)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Fleet":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._heartbeat_loop, name="hbam-fleet-hb",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            ex, self._executor = self._executor, None
+        if ex is not None:
+            ex.shutdown(wait=False)
+
+    def _executor_or_make(self) -> cf.ThreadPoolExecutor:
+        with self._lock:
+            if self._executor is None:
+                self._executor = cf.ThreadPoolExecutor(
+                    max_workers=_HEDGE_WORKERS,
+                    thread_name_prefix="hbam-fleet")
+            return self._executor
+
+    # -- membership / heartbeats ---------------------------------------------
+
+    def degraded(self) -> bool:
+        return not self.membership.has_quorum()
+
+    def _domain(self, peer_id: str):
+        return registry().domain("serve", "peer", peer_id,
+                                 config=self.config)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self.heartbeat_round()
+            except Exception:  # noqa: BLE001 — liveness loop never dies
+                METRICS.count("fleet.heartbeat_errors")
+
+    def heartbeat_round(self) -> None:
+        """One heartbeat pass: dial every peer whose breaker allows it
+        (in HALF_OPEN the heartbeat IS the probe — success heals the
+        breaker before any query traffic flows), then age membership.
+        Public so tests drive rounds deterministically."""
+        for pid in list(self.peers):
+            dom = self._domain(pid)
+            if not dom.breaker.allow():
+                continue
+            try:
+                self._peer_call(
+                    pid, {"op": "heartbeat", "from": self.replica_id},
+                    timeout_s=min(self.peer_timeout_s,
+                                  max(self.heartbeat_s, 0.05)))
+            except (OSError, ValueError, TransientIOError,
+                    CorruptDataError, RuntimeError) as e:
+                dom.record_failure(e)
+                continue
+            dom.record_success()
+            if self.membership.observe(pid):
+                flight.recorder().record_transition(
+                    "fleet", f"peer.{pid}", "rejoined")
+        for pid, state in self.membership.sweep():
+            rec = flight.recorder()
+            rec.record_transition("fleet", f"peer.{pid}", state)
+            if state == "evicted":
+                # a member leaving the fleet is incident-grade: keep
+                # the ring around the moment ownership re-ranked
+                rec.dump("fleet_eviction",
+                         error=f"peer {pid} evicted from membership")
+
+    def note_local_decode(self) -> None:
+        """ServeLoop accounting: a chunk this replica host-decoded
+        (the denominator of the bench's cross-replica tile hit rate)."""
+        with self._lock:
+            self.local_decodes += 1
+
+    def note_degraded(self) -> None:
+        with self._lock:
+            self.degraded_serves += 1
+        METRICS.count("fleet.degraded_serves")
+
+    def observe_peer(self, peer_id) -> None:
+        """An INBOUND heartbeat (transport ``{"op":"heartbeat"}``) is as
+        good an observation as our own round trip."""
+        if isinstance(peer_id, str) and peer_id in self.peers:
+            if self.membership.observe(peer_id):
+                flight.recorder().record_transition(
+                    "fleet", f"peer.{peer_id}", "rejoined")
+
+    # -- the peer wire -------------------------------------------------------
+
+    def _peer_call(self, peer_id: str, doc: Dict,
+                   timeout_s: float) -> Dict:
+        """One JSONL round trip to a peer over the existing TCP
+        transport.  The ``serve.peer`` chaos point fires first, so an
+        injected delay/transient/disconnect exercises exactly the
+        breaker/hedge/fallback stack a real peer fault would."""
+        chaos.fire("serve.peer")
+        host, port = self.peers[peer_id]
+        timeout = max(0.02, float(timeout_s))
+        with _socket.create_connection((host, port),
+                                       timeout=timeout) as s:
+            s.settimeout(timeout)
+            f = s.makefile("rw", encoding="utf-8", newline="\n")
+            f.write(json.dumps(doc) + "\n")
+            f.flush()
+            line = f.readline()
+        if not line:
+            raise TransientIOError(
+                f"fleet peer {peer_id} closed the connection "
+                f"without answering")
+        resp = json.loads(line)
+        if not isinstance(resp, dict):
+            raise CorruptDataError(
+                f"fleet peer {peer_id} answered a non-object line")
+        if "error" in resp:
+            raise _peer_error(resp)
+        return resp
+
+    def _timed_call(self, peer_id: str, doc: Dict,
+                    timeout_s: float) -> Dict:
+        """A breaker-fed, latency-observed peer call (hedge executor
+        body).  PLAN-class answers are the REQUEST's fault and never
+        feed the peer's breaker — the tenancy discipline, applied to
+        peers."""
+        dom = self._domain(peer_id)
+        t0 = time.perf_counter()
+        try:
+            resp = self._peer_call(peer_id, doc, timeout_s)
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if classify_error(e) != PLAN:
+                dom.record_failure(e)
+            METRICS.count("fleet.peer_call_errors")
+            raise
+        dom.record_success()
+        self.latency.observe(time.perf_counter() - t0)
+        return resp
+
+    # -- hedged peer-fetch ---------------------------------------------------
+
+    def fetch_chunk(self, path: str, key: Tuple, s: int, e: int,
+                    deadline=None) -> Dict:
+        """Peer-fetch one decoded chunk from its rendezvous owners:
+        breaker-gated, deadline-budgeted (re-anchored on the wire),
+        hedged to the next-ranked replica past the decaying-p95 soft
+        deadline — first result wins.  Raises ``TransientIOError`` when
+        no owner could answer (the caller's cue to decode locally)."""
+        cands = [pid for pid in
+                 self.membership.owners_for(key, self.replication + 1)
+                 if pid != self.replica_id and pid in self.peers]
+        if not cands:
+            raise TransientIOError("no fleet peer owns this chunk")
+        doc = {"op": "chunk", "path": path, "s": int(s), "e": int(e),
+               "from": self.replica_id}
+        tid = current_trace_id()
+        if tid is not None:
+            doc["trace"] = tid
+        if deadline is not None and deadline.seconds is not None:
+            rem = deadline.remaining()
+            if rem is not None and rem <= 0:
+                deadline.check("fleet peer fetch")
+            # the ORIGINATING enqueue anchor, carried as elapsed age:
+            # the peer rebuilds the same remaining budget in its own
+            # clock domain (monotonic anchors never cross processes raw)
+            doc["deadline_s"] = deadline.seconds
+            doc["enqueue_age_s"] = round(
+                max(0.0, deadline.seconds - (rem or 0.0)), 6)
+        try:
+            resp = self._fetch_hedged(cands, doc, deadline)
+            value = decode_chunk_doc(resp)
+        except BaseException:
+            with self._lock:
+                self.peer_fetch_failed += 1
+            METRICS.count("fleet.peer_fetch_failed")
+            raise
+        with self._lock:
+            self.peer_fetch_ok += 1
+        METRICS.count("fleet.peer_fetch_ok")
+        return value
+
+    def _fetch_hedged(self, cands: Sequence[str], doc: Dict,
+                      deadline=None) -> Dict:
+        ex = self._executor_or_make()
+        timeout_s = self.peer_timeout_s
+        if deadline is not None:
+            rem = deadline.remaining()
+            if rem is not None:
+                timeout_s = min(timeout_s, max(rem, 0.02))
+        futs: List[Tuple[cf.Future, bool]] = []   # (future, is_hedge)
+        errors: List[str] = []
+        idx = 0
+
+        def launch(is_hedge: bool) -> bool:
+            nonlocal idx
+            while idx < len(cands):
+                pid = cands[idx]
+                idx += 1
+                if not self._domain(pid).breaker.allow():
+                    errors.append(f"{pid}: breaker open")
+                    continue
+                futs.append((ex.submit(self._timed_call, pid, dict(doc),
+                                       timeout_s), is_hedge))
+                return True
+            return False
+
+        if not launch(False):
+            raise TransientIOError(
+                "all fleet owners unavailable: " + "; ".join(errors))
+        soft = self.latency.soft_deadline_s()
+        hedged = False
+        while futs:
+            if deadline is not None:
+                deadline.check("fleet peer fetch")
+            wait = (soft if (soft is not None and not hedged)
+                    else 0.05)
+            if deadline is not None:
+                rem = deadline.remaining()
+                if rem is not None:
+                    wait = min(wait, max(rem, 0.001))
+            done, _ = cf.wait([f for f, _ in futs], timeout=wait,
+                              return_when=cf.FIRST_COMPLETED)
+            for f, is_hedge in list(futs):
+                if f not in done:
+                    continue
+                futs.remove((f, is_hedge))
+                try:
+                    resp = f.result()
+                except BaseException as e:  # noqa: BLE001 — next owner
+                    errors.append(str(e))
+                    continue
+                if is_hedge:
+                    with self._lock:
+                        self.hedge_wins += 1
+                    METRICS.count("fleet.hedge_wins")
+                return resp
+            if futs and not done and not hedged and soft is not None:
+                # primary overran its decaying-p95 soft deadline: race
+                # the next-ranked replica, first result wins (the loser
+                # is abandoned to its socket timeout)
+                hedged = True
+                if launch(True):
+                    with self._lock:
+                        self.hedges += 1
+                    METRICS.count("fleet.hedges")
+            if not futs and not launch(hedged):
+                break
+        raise TransientIOError(
+            "fleet peer fetch failed on every owner: "
+            + ("; ".join(errors) or "no candidates"))
+
+    # -- inbound peer-op serving (transport side) ----------------------------
+
+    def serve_chunk(self, engine, doc: Dict) -> Dict:
+        """Answer a peer's ``{"op": "chunk"}``: the host-decoded chunk
+        columns from the warm ``ChunkCache`` (single-flight; safe on
+        the transport reader thread — the prefetcher already decodes
+        there-adjacent from pool threads).  The peer's re-anchored
+        deadline binds the decode."""
+        from hadoop_bam_tpu.query.scheduler import Deadline
+
+        path = doc.get("path")
+        if not isinstance(path, str) or "s" not in doc or "e" not in doc:
+            raise PlanError('peer chunk request needs "path", "s", "e"')
+        eff = effective_deadline_s(doc.get("deadline_s"),
+                                   doc.get("enqueue_age_s"))
+        dl = Deadline(eff, clock=self._clock)
+        dl.check("peer chunk")
+        meta = engine._file_meta(path)
+        value = engine._chunk(meta, int(doc["s"]), int(doc["e"]))
+        dl.check("peer chunk decode")
+        with self._lock:
+            self.chunks_served += 1
+        METRICS.count("fleet.chunks_served")
+        return encode_chunk_doc(value)
+
+    # -- health surface ------------------------------------------------------
+
+    def states(self) -> Dict[str, object]:
+        reg = registry()
+        breakers = {}
+        for pid in sorted(self.peers):
+            d = reg.domain("serve", "peer", pid, config=self.config)
+            breakers[pid] = d.snapshot()
+        with self._lock:
+            counters = {
+                "peer_fetch_ok": self.peer_fetch_ok,
+                "peer_fetch_failed": self.peer_fetch_failed,
+                "local_decodes": self.local_decodes,
+                "chunks_served": self.chunks_served,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "degraded_serves": self.degraded_serves,
+            }
+        soft = self.latency.soft_deadline_s()
+        return {"replica_id": self.replica_id,
+                "replication": self.replication,
+                "degraded": self.degraded(),
+                "membership": self.membership.states(),
+                "peer_breakers": breakers,
+                "hedge_soft_deadline_s": (round(soft, 6)
+                                          if soft is not None else None),
+                **counters}

@@ -50,11 +50,13 @@ from hadoop_bam_tpu.obs.slo import SloEngine
 from hadoop_bam_tpu.query.engine import QueryEngine, _I32_MAX
 from hadoop_bam_tpu.serve.prefetch import Prefetcher
 from hadoop_bam_tpu.serve.tenancy import TenantQuotas, priority_rank
+from hadoop_bam_tpu.plan.executor import select_chunk_source
 from hadoop_bam_tpu.serve.tiles import (
-    DeviceTileCache, TileBuilder, make_tile_filter_step, tile_key,
+    INTERVAL_PROJECTION, DeviceTileCache, TileBuilder,
+    make_tile_filter_step, tile_key,
 )
 from hadoop_bam_tpu.utils.errors import (
-    PLAN, PlanError, TransientIOError, classify_error,
+    PLAN, CorruptDataError, PlanError, TransientIOError, classify_error,
 )
 from hadoop_bam_tpu.utils.metrics import (
     METRICS, base_metrics, current_metrics,
@@ -101,10 +103,20 @@ class ServeLoop:
     auto-starts."""
 
     def __init__(self, config: HBamConfig = DEFAULT_CONFIG,
-                 engine: Optional[QueryEngine] = None, mesh=None):
+                 engine: Optional[QueryEngine] = None, mesh=None,
+                 fleet=None):
         self.config = config
         self.engine = engine if engine is not None else QueryEngine(
             config=config, mesh=mesh)
+        # the serving fleet (serve/fleet.py): explicit injection wins
+        # (tests drive injectable clocks); otherwise auto-built when the
+        # config names a replica id AND a peer roster.  None = the
+        # single-replica serving every prior PR shipped, untouched.
+        if fleet is None and getattr(config, "serve_replica_id", None) \
+                and getattr(config, "serve_peers", ""):
+            from hadoop_bam_tpu.serve.fleet import Fleet
+            fleet = Fleet(config)
+        self.fleet = fleet
         self.tiles = DeviceTileCache(
             int(getattr(config, "serve_tile_cache_bytes", 512 << 20)))
         self.tenants = TenantQuotas(config)
@@ -155,6 +167,8 @@ class ServeLoop:
                     target=self._dispatch_loop, name="hbam-serve",
                     daemon=True)
                 self._thread.start()
+        if self.fleet is not None:
+            self.fleet.start()
         return self
 
     def stop(self) -> None:
@@ -163,6 +177,8 @@ class ServeLoop:
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30.0)
+        if self.fleet is not None:
+            self.fleet.stop()
         self.prefetcher.stop()
         # anything still queued will never run: fail it loudly as
         # retryable (a restarting server is a transient condition)
@@ -289,6 +305,11 @@ class ServeLoop:
             "flight": flight.recorder().stats(),
             "slo": self.slo.summary(self.slo_metrics),
             "pool": pools.pool_stats(),
+            # fleet view: membership/ownership, per-peer breakers,
+            # degraded flag, peer-fetch + hedge counters (None when
+            # this process serves single-replica)
+            "fleet": (self.fleet.states()
+                      if self.fleet is not None else None),
         }
 
     # -- dispatcher ----------------------------------------------------------
@@ -417,10 +438,15 @@ class ServeLoop:
         iv_dev = builder.put_interval([
             rid, min(iv.start, int(_I32_MAX)), min(iv.end, int(_I32_MAX))])
 
+        fleet = self.fleet
+        degraded = fleet.degraded() if fleet is not None else False
+        if degraded:
+            fleet.note_degraded()
         count = 0
         n_candidates = 0
         tile_hits = 0
         tile_misses = 0
+        peer_chunks = 0
         rows_per_chunk: List[Tuple[Tuple, np.ndarray, int]] = []
         for s, e in chunks:
             job.deadline.check("serve chunk")
@@ -429,10 +455,44 @@ class ServeLoop:
             tiles = self.tiles.get(key)
             if tiles is None:
                 tile_misses += 1
-                value = engine._chunk(meta, s, e)
-                # ticks serve.prefetch_useful when the host chunk was
-                # decoded ahead of need by the prefetcher
-                self.prefetcher.was_prefetched(engine.chunk_key(meta, s, e))
+                value = None
+                if fleet is not None:
+                    # chunk-source routing is the executor's decision
+                    # (plan/executor.select_chunk_source — the
+                    # select_plane discipline applied to the fleet), the
+                    # loop only consumes it
+                    okey = (meta.ident, (s, e), INTERVAL_PROJECTION)
+                    owner_ids = fleet.membership.owners_for(
+                        okey, fleet.replication)
+                    source, _why = select_chunk_source(
+                        tile_cached=False,
+                        fleet_owned=fleet.replica_id in owner_ids,
+                        degraded=degraded,
+                        want_records=job.want_records,
+                        peer_ready=any(pid in fleet.peers
+                                       for pid in owner_ids))
+                    if source == "peer":
+                        try:
+                            value = fleet.fetch_chunk(
+                                job.path, okey, s, e,
+                                deadline=job.deadline)
+                            peer_chunks += 1
+                        except (TransientIOError, CorruptDataError,
+                                RuntimeError, OSError, ValueError):
+                            # every owner failed/hedged out: decode
+                            # locally — sick peers never fail a request
+                            # this replica can answer itself (the
+                            # deadline still binds the fallback)
+                            METRICS.count("fleet.peer_fallback_local")
+                            value = None
+                if value is None:
+                    value = engine._chunk(meta, s, e)
+                    # ticks serve.prefetch_useful when the host chunk
+                    # was decoded ahead of need by the prefetcher
+                    self.prefetcher.was_prefetched(
+                        engine.chunk_key(meta, s, e))
+                    if fleet is not None:
+                        fleet.note_local_decode()
                 tiles = builder.build(meta.ident, value)
                 if int(value["n"]) > 0 or int(value["nbytes"]) > 0:
                     self.tiles.put(key, tiles)
@@ -464,10 +524,21 @@ class ServeLoop:
             records = self._materialize(meta, rows_per_chunk)
         METRICS.count("serve.requests")
         self.prefetcher.note(meta, iv)
+        extra = None
+        if fleet is not None:
+            # fleet provenance rides the wire doc verbatim: which
+            # replica answered, whether it was partitioned (degraded
+            # mode serves owned data instead of erroring), and how many
+            # chunks arrived pre-decoded from peers
+            extra = {"replica": fleet.replica_id}
+            if degraded:
+                extra["degraded"] = True
+            if peer_chunks:
+                extra["peer_chunks"] = peer_chunks
         return ServeResult(region=region, count=count,
                            n_candidates=n_candidates,
                            tile_hits=tile_hits, tile_misses=tile_misses,
-                           records=records)
+                           records=records, extra=extra)
 
     @staticmethod
     def _flat_rows(masks: List[np.ndarray], builder: TileBuilder

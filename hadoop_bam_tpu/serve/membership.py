@@ -1,0 +1,173 @@
+"""Fleet membership: rendezvous tile ownership + heartbeat liveness.
+
+Two small, separately-testable pieces of the serving fleet
+(``serve/fleet.py`` composes them with the transport and breakers):
+
+**Rendezvous (highest-random-weight) ownership.**  Every cacheable tile
+key ``(file_identity, chunk_range, projection)`` hashes against every
+member id; the R highest weights own the tile.  The properties the
+fleet leans on, both pinned by tests:
+
+- *deterministic across processes*: the weight is a keyed BLAKE2b
+  digest, never Python's salted ``hash()``, so every replica computes
+  the same owner ranking from the same member set with no coordination;
+- *minimal disruption*: removing a member only re-ranks the keys that
+  member owned (each surviving member's weight for a key never
+  changes), so a replica death moves exactly the dead replica's share —
+  no ring to rebuild, no bulk ownership churn.
+
+**Heartbeat membership.**  Liveness is observation-driven: the fleet's
+heartbeat loop calls ``observe(peer)`` on every successful round trip
+and ``sweep()`` on every tick.  A peer silent past
+``fleet_suspicion_s`` turns SUSPECT (still ranked — a hiccup must not
+thrash ownership); silent past ``fleet_eviction_s`` it is EVICTED and
+drops out of the owner ranking entirely.  A heartbeat from an evicted
+peer re-admits it to the member set immediately — but the fleet's
+per-peer circuit breaker (``("serve","peer",id)``) still gates actual
+traffic, so a healed replica takes requests only after its half-open
+probes succeed (the rejoin contract the failover test pins).
+
+The clock is injectable (the ``resilience/breaker.py`` convention) so
+suspicion/eviction transitions are tested without real time passing.
+Quorum is majority of the CONFIGURED member set (self + static peer
+list): a replica that can see fewer than half its fleet serves what it
+owns in degraded mode instead of erroring (``extra.degraded`` on the
+wire) — partition behavior, not an outage.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from hadoop_bam_tpu.utils.errors import PlanError
+from hadoop_bam_tpu.utils.metrics import METRICS
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+EVICTED = "evicted"
+
+
+def rendezvous_weight(key: Tuple, member: str) -> int:
+    """The HRW weight of ``member`` for ``key``: a keyed 8-byte BLAKE2b
+    digest, deterministic across processes and Python runs (``hash()``
+    is salted per process and can never be used here)."""
+    h = hashlib.blake2b(repr(key).encode("utf-8"), digest_size=8,
+                        key=member.encode("utf-8")[:64])
+    return int.from_bytes(h.digest(), "big")
+
+
+def rank_members(key: Tuple, members: Sequence[str]) -> List[str]:
+    """Members ranked by descending rendezvous weight for ``key``
+    (owner first).  Ties break on the id so the order is total."""
+    return sorted(members,
+                  key=lambda m: (rendezvous_weight(key, m), m),
+                  reverse=True)
+
+
+def owners(key: Tuple, members: Sequence[str], r: int) -> List[str]:
+    """The R-way owner set: the ``r`` highest-ranked members."""
+    return rank_members(key, members)[:max(1, int(r))]
+
+
+class Membership:
+    """Heartbeat-observed fleet membership (module docstring).
+
+    Thread-safe: ``observe`` runs on the heartbeat thread AND the
+    transport reader threads (an inbound heartbeat is also an
+    observation), ``alive_members``/``owners_for`` on the dispatcher.
+    """
+
+    def __init__(self, self_id: str, peer_ids: Sequence[str],
+                 *, suspicion_s: float = 1.5, eviction_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if not self_id:
+            raise PlanError("membership needs a non-empty replica id")
+        self.self_id = str(self_id)
+        self.suspicion_s = float(suspicion_s)
+        self.eviction_s = max(float(eviction_s), self.suspicion_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        now = clock()
+        # peer id -> (state, last_observed).  Peers start ALIVE with a
+        # fresh timestamp: a booting fleet must not evict everyone
+        # before the first heartbeat round completes.
+        self._peers: Dict[str, Tuple[str, float]] = {
+            str(p): (ALIVE, now) for p in peer_ids if str(p) != self_id}
+        self.evictions_total = 0
+        self.rejoins_total = 0
+
+    # -- observation ---------------------------------------------------------
+
+    def observe(self, peer_id: str) -> bool:
+        """Record a successful heartbeat round trip (either direction).
+        Returns True when this observation RE-ADMITTED an evicted peer
+        (the fleet logs it as a rejoin; the peer's breaker still gates
+        traffic until its half-open probes pass)."""
+        pid = str(peer_id)
+        with self._lock:
+            cur = self._peers.get(pid)
+            if cur is None:
+                return False          # not in this fleet's static roster
+            state, _ = cur
+            self._peers[pid] = (ALIVE, self._clock())
+            if state == EVICTED:
+                self.rejoins_total += 1
+                METRICS.count("fleet.rejoins")
+                return True
+        return False
+
+    def sweep(self) -> List[Tuple[str, str]]:
+        """Age observations into SUSPECT/EVICTED transitions; returns
+        the ``(peer_id, new_state)`` transitions this sweep made (the
+        fleet records them on the flight ring)."""
+        out: List[Tuple[str, str]] = []
+        now = self._clock()
+        with self._lock:
+            for pid, (state, seen) in list(self._peers.items()):
+                age = now - seen
+                if state != EVICTED and age >= self.eviction_s:
+                    self._peers[pid] = (EVICTED, seen)
+                    self.evictions_total += 1
+                    out.append((pid, EVICTED))
+                elif state == ALIVE and age >= self.suspicion_s:
+                    self._peers[pid] = (SUSPECT, seen)
+                    out.append((pid, SUSPECT))
+        for pid, state in out:
+            METRICS.count(f"fleet.peer_{state}")
+        return out
+
+    # -- ownership views -----------------------------------------------------
+
+    def members(self) -> List[str]:
+        """Every NON-EVICTED member (self included), sorted — the set
+        ownership ranks over.  SUSPECT peers stay ranked: a heartbeat
+        hiccup must not move tile ownership; only eviction does."""
+        with self._lock:
+            ids = [pid for pid, (state, _) in self._peers.items()
+                   if state != EVICTED]
+        return sorted(ids + [self.self_id])
+
+    def owners_for(self, key: Tuple, r: int) -> List[str]:
+        return owners(key, self.members(), r)
+
+    def has_quorum(self) -> bool:
+        """Majority of the CONFIGURED fleet visible (self counts)."""
+        with self._lock:
+            total = len(self._peers) + 1
+            visible = 1 + sum(1 for state, _ in self._peers.values()
+                              if state != EVICTED)
+        return visible * 2 > total
+
+    def states(self) -> Dict[str, object]:
+        """Health-surface snapshot."""
+        now = self._clock()
+        with self._lock:
+            peers = {pid: {"state": state,
+                           "age_s": round(now - seen, 3)}
+                     for pid, (state, seen) in sorted(self._peers.items())}
+        return {"self": self.self_id, "peers": peers,
+                "quorum": self.has_quorum(),
+                "evictions_total": self.evictions_total,
+                "rejoins_total": self.rejoins_total}

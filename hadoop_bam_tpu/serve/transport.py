@@ -32,6 +32,26 @@ cohort manifest JSON and each region slices the joined
 demotion-ladder state (``ServeLoop.health``) — the liveness/diagnosis
 surface a degraded server keeps serving even while it sheds queries.
 
+Fleet ops (serve/fleet.py; answered inline on the reader thread, like
+health/metrics, so they work while every tenant sheds):
+
+- ``{"op": "heartbeat", "from": ID}`` — liveness ping; the sender is
+  observed into membership (an inbound heartbeat is as good as our own
+  round trip) and the reply names this replica.
+- ``{"op": "chunk", "path": P, "s": S, "e": E}`` — peer-fetch of one
+  host-decoded chunk's interval columns (base64 int32), served from the
+  warm ChunkCache so a peer skips fetch+inflate+host_decode.
+- ``{"op": "fleet"}`` — the fleet view of health (membership, per-peer
+  breakers, degraded flag, hedge counters).
+
+Fleet requests re-anchor deadlines to the ORIGINATING request's enqueue
+instant: ``deadline_s`` is the original budget and ``enqueue_age_s``
+the elapsed age at send time, so a hop never gets a fresh budget
+(PR 8's enqueue anchor, fleet-wide).  Forwarded requests carry the
+originating ``trace`` id, which is adopted (validated) instead of
+minting a fresh one — one fleet request exports as ONE trace tree, each
+span stamped with the replica that did the work.
+
 The TCP flavor is a thread-per-connection ``socketserver`` veneer over
 the same per-line handler; every connection funnels into the ONE
 ``ServeLoop`` dispatcher, so device work stays single-threaded no
@@ -50,6 +70,7 @@ from typing import Dict, List
 
 from hadoop_bam_tpu.obs.context import trace_context
 from hadoop_bam_tpu.resilience import chaos
+from hadoop_bam_tpu.serve.fleet import effective_deadline_s
 from hadoop_bam_tpu.utils.errors import (
     CircuitBreakerError, CorruptDataError, HBamError, PlanError,
     TransientIOError,
@@ -87,11 +108,13 @@ def error_doc(req_id, exc: BaseException, kind: "str | None" = None,
 
 
 def _result_doc(req_id, tenant: str, results, t_enqueue: float,
-                trace: "str | None" = None) -> Dict:
+                trace: "str | None" = None,
+                replica: "str | None" = None) -> Dict:
     return {
         "id": req_id,
         "tenant": tenant,
         **({"trace": trace} if trace is not None else {}),
+        **({"replica": replica} if replica is not None else {}),
         "latency_ms": round((time.perf_counter() - t_enqueue) * 1e3, 3),
         "results": [
             {"region": r.region, "count": r.count,
@@ -197,29 +220,62 @@ def handle_stream(loop, rfile, wfile) -> int:
                     # rates, also answered inline on the reader thread
                     write({"id": req_id, **_metrics_doc(loop, doc)})
                     continue
+                fleet = getattr(loop, "fleet", None)
+                if doc.get("op") == "heartbeat":
+                    if fleet is not None:
+                        fleet.observe_peer(doc.get("from"))
+                    write({"id": req_id, "ok": True,
+                           "replica": (fleet.replica_id
+                                       if fleet is not None else None)})
+                    continue
+                if doc.get("op") == "fleet":
+                    write({"id": req_id,
+                           "fleet": (fleet.states()
+                                     if fleet is not None else None)})
+                    continue
+                if doc.get("op") == "chunk":
+                    if fleet is None:
+                        raise PlanError(
+                            "peer chunk op on a non-fleet server")
+                    # a peer's fetch adopts the ORIGINATING trace id:
+                    # the spans below join the peer request's tree
+                    with trace_context(
+                            op="serve.peer_chunk",
+                            trace_id=_client_trace(doc.get("trace"))
+                            ) as tctx:
+                        with METRICS.span("serve.peer_chunk_wall"):
+                            payload = fleet.serve_chunk(loop.engine, doc)
+                        write({"id": req_id, "trace": tctx.trace_id,
+                               "replica": fleet.replica_id, **payload})
+                    continue
                 regions = doc.get("regions")
                 if regions is None:
                     regions = [doc["region"]] if "region" in doc else None
                 if not regions or "path" not in doc:
                     raise PlanError(
                         'request needs "path" and "regions" (or "region")')
+                # fleet hop: the deadline re-anchors to the ORIGINATING
+                # request's enqueue instant — the original budget minus
+                # the age it already spent upstream, never a fresh one
+                deadline_s = effective_deadline_s(
+                    doc.get("deadline_s"), doc.get("enqueue_age_s"))
                 # ONE trace per request line, minted here at the wire —
                 # loop.submit's contextvars snapshot carries it through
                 # the dispatcher, the decode pool and the staging
                 # packer, and the response line echoes it back; a
-                # client-supplied "trace" is adopted so ids can span
-                # systems
+                # client- or peer-supplied "trace" is adopted (validated)
+                # so a forwarded fleet request keeps its originating id
                 with trace_context(
                         op="serve.request",
                         tenant=str(doc.get("tenant", "default")),
-                        deadline_s=doc.get("deadline_s"),
+                        deadline_s=deadline_s,
                         trace_id=_client_trace(doc.get("trace"))) as tctx:
                     trace_id = tctx.trace_id
                     fut = loop.submit(
                         doc["path"], regions,
                         tenant=str(doc.get("tenant", "default")),
                         priority=str(doc.get("priority", "interactive")),
-                        deadline_s=doc.get("deadline_s"),
+                        deadline_s=deadline_s,
                         want_records=bool(doc.get("records", False)),
                         cohort=bool(doc.get("cohort", False)))
             except (ValueError, KeyError, TypeError) as e:
@@ -244,6 +300,8 @@ def handle_stream(loop, rfile, wfile) -> int:
                       tenant=str(doc.get("tenant", "default")),
                       t_enqueue=t_enqueue, ev=ev,
                       trace_id=trace_id) -> None:
+                replica = (fleet.replica_id if fleet is not None
+                           else None)
                 try:
                     exc = f.exception()
                     if exc is not None:
@@ -255,7 +313,8 @@ def handle_stream(loop, rfile, wfile) -> int:
                         with METRICS.span("serve.response_wall"):
                             write(_result_doc(req_id, tenant,
                                               f.result(), t_enqueue,
-                                              trace=trace_id))
+                                              trace=trace_id,
+                                              replica=replica))
                 finally:
                     ev.set()
 

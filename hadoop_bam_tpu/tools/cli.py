@@ -664,8 +664,22 @@ def cmd_serve(args) -> int:
         overrides["breaker_cooldown_s"] = args.breaker_cooldown
     if getattr(args, "flight_dir", None):
         overrides["flight_dump_dir"] = args.flight_dir
+    if getattr(args, "replica_id", None):
+        overrides["serve_replica_id"] = args.replica_id
+    if getattr(args, "peers", None):
+        overrides["serve_peers"] = args.peers
+    if getattr(args, "replication", None) is not None:
+        overrides["fleet_replication"] = args.replication
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
+    if cfg.serve_peers and not cfg.serve_replica_id:
+        print("error: --peers requires --replica-id (this replica's own "
+              "name in the peer set)", file=sys.stderr)
+        return 2
+    if cfg.serve_peers and args.port is None:
+        print("error: --peers requires --port (peer fetch rides the TCP "
+              "transport)", file=sys.stderr)
+        return 2
     _start_obs(args)
     n = 0
     with ServeLoop(config=cfg) as loop:
@@ -678,6 +692,11 @@ def cmd_serve(args) -> int:
             host, port = server.server_address[:2]
             print(f"serving on {host}:{port} (JSONL; ^C stops)",
                   file=sys.stderr)
+            if loop.fleet is not None:
+                print(f"fleet replica={loop.fleet.replica_id} "
+                      f"replication={loop.fleet.replication} "
+                      f"peers={','.join(sorted(loop.fleet.peers)) or '-'}",
+                      file=sys.stderr)
             try:
                 server.serve_forever()
             except KeyboardInterrupt:
@@ -1021,14 +1040,161 @@ def _render_top(health: dict, mdoc: dict, prev_counters: Optional[dict],
     return "\n".join(lines)
 
 
+def _parse_endpoints(spec: str) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        host, _, port = entry.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad endpoint {entry!r} — want HOST:PORT")
+        out.append((host, int(port)))
+    if not out:
+        raise ValueError("--endpoints needs at least one HOST:PORT")
+    return out
+
+
+def _fleet_snapshot(endpoints, timeout: float) -> List[dict]:
+    """Poll every fleet endpoint once; an unreachable replica becomes a
+    DOWN row, never a failed frame (the whole point of a fleet view is
+    seeing who is missing)."""
+    snaps = []
+    for host, port in endpoints:
+        ep = f"{host}:{port}"
+        try:
+            health, mdoc = _top_fetch(host, port, timeout=timeout)
+        except (OSError, ValueError) as e:
+            snaps.append({"endpoint": ep, "ok": False, "err": str(e)})
+            continue
+        snaps.append({"endpoint": ep, "ok": True,
+                      "health": health, "mdoc": mdoc})
+    return snaps
+
+
+def _render_fleet_top(snaps: List[dict], prev: dict,
+                      interval: float) -> str:
+    """The ``hbam top --endpoints`` frame: one row per replica
+    (q/s, p50/p99 across tenants, tile hit rate, peer-breaker states,
+    degraded flag) plus fleet-wide aggregates."""
+    from hadoop_bam_tpu.obs import Histogram
+
+    lines: List[str] = []
+    lines.append(f"{'replica':<12}{'endpoint':<22}{'q/s':>7}{'p50ms':>8}"
+                 f"{'p99ms':>8}{'tile%':>7}{'peers':>12}  flags")
+    up = 0
+    tot_qps = 0.0
+    tot_th = tot_tm = 0
+    tot_fetch_ok = tot_served = tot_local = 0
+    for snap in snaps:
+        ep = snap["endpoint"]
+        if not snap["ok"]:
+            lines.append(f"{'-':<12}{ep:<22}{'-':>7}{'-':>8}{'-':>8}"
+                         f"{'-':>7}{'-':>12}  DOWN ({snap['err']})")
+            continue
+        up += 1
+        health, mdoc = snap["health"], snap["mdoc"]
+        fleet = health.get("fleet") or {}
+        rid = str(fleet.get("replica_id") or "-")
+        metrics = mdoc.get("metrics", {}) or {}
+        counters = {k: int(v)
+                    for k, v in dict(metrics.get("counters", {})).items()}
+        hists = dict(metrics.get("histograms", {}))
+        reqs = sum(v for k, v in counters.items()
+                   if k.startswith("serve.requests."))
+        pc = prev.get(ep)
+        if pc is not None and interval > 0:
+            preqs = sum(v for k, v in pc.items()
+                        if k.startswith("serve.requests."))
+            qv = max(0, reqs - preqs) / interval
+            tot_qps += qv
+            qps = f"{qv:.1f}"
+        else:
+            qps = "-"
+        merged = Histogram.merged(
+            Histogram.from_dict(h) for k, h in hists.items()
+            if k.startswith("serve.latency_s.")
+            and isinstance(h, dict) and "buckets" in h)
+        if merged.count:
+            p50 = f"{merged.percentile(50) * 1e3:.1f}"
+            p99 = f"{merged.percentile(99) * 1e3:.1f}"
+        else:
+            p50 = p99 = "-"
+        tiles = health.get("tiles", {}) or {}
+        th, tm = int(tiles.get("hits", 0)), int(tiles.get("misses", 0))
+        tot_th += th
+        tot_tm += tm
+        tile = f"{100.0 * th / (th + tm):.0f}" if (th + tm) else "-"
+        brk = {}
+        for st in (d.get("state", "closed") for d in
+                   dict(fleet.get("peer_breakers") or {}).values()):
+            brk[st] = brk.get(st, 0) + 1
+        peers = ",".join(f"{n}{s[:1].upper()}"
+                         for s, n in sorted(brk.items())) or "-"
+        flags = []
+        if fleet.get("degraded"):
+            flags.append("DEGRADED")
+        if health.get("status") not in (None, "ok"):
+            flags.append(str(health.get("status")))
+        tot_fetch_ok += int(fleet.get("peer_fetch_ok", 0))
+        tot_served += int(fleet.get("chunks_served", 0))
+        tot_local += int(fleet.get("local_decodes", 0))
+        lines.append(f"{rid:<12}{ep:<22}{qps:>7}{p50:>8}{p99:>8}"
+                     f"{tile:>7}{peers:>12}  {' '.join(flags) or '-'}")
+        snap["counters"] = counters
+    agg_tile = (f"{100.0 * tot_th / (tot_th + tot_tm):.0f}%"
+                if (tot_th + tot_tm) else "-")
+    denom = tot_fetch_ok + tot_local
+    xr = f"{tot_fetch_ok / denom:.2f}" if denom else "-"
+    lines.append(
+        f"fleet: up={up}/{len(snaps)} q/s={tot_qps:.1f} "
+        f"tile_hit={agg_tile} peer_fetches={tot_fetch_ok} "
+        f"chunks_served_for_peers={tot_served} "
+        f"cross_replica_tile_rate={xr}")
+    return "\n".join(lines)
+
+
 def cmd_top(args) -> int:
     """Live introspection of a running ``hbam serve --port`` process:
     polls the ``{"op": "health"}`` / ``{"op": "metrics"}`` transport
     surfaces and renders per-tenant q/s, latency percentiles, cache hit
     rates, pool occupancy, breaker + SLO burn state, and (with
-    ``--jobs-dir``) journaled-job resume progress."""
+    ``--jobs-dir``) journaled-job resume progress.  With
+    ``--endpoints HOST:PORT,...`` it becomes the FLEET view: one row
+    per replica plus fleet-wide aggregates, DOWN rows for unreachable
+    replicas."""
     import time as _time
 
+    if getattr(args, "endpoints", None):
+        try:
+            endpoints = _parse_endpoints(args.endpoints)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        iterations = 1 if args.once else int(args.iterations)
+        prev: dict = {}
+        i = 0
+        try:
+            while True:
+                i += 1
+                snaps = _fleet_snapshot(endpoints, timeout=args.timeout)
+                frame = _render_fleet_top(snaps, prev,
+                                          float(args.interval))
+                print(f"-- hbam top (fleet, poll {i}"
+                      f"{'' if not iterations else f'/{iterations}'}"
+                      f") --")
+                print(frame, flush=True)
+                prev = {s["endpoint"]: s.get("counters", {})
+                        for s in snaps if s["ok"]}
+                if iterations and i >= iterations:
+                    return 0
+                _time.sleep(max(0.1, float(args.interval)))
+        except KeyboardInterrupt:
+            return 0
+    if args.port is None:
+        print("error: --port (single server) or --endpoints (fleet) "
+              "is required", file=sys.stderr)
+        return 2
     iterations = 1 if args.once else int(args.iterations)
     prev_counters = None
     i = 0
@@ -1059,6 +1225,54 @@ def cmd_top(args) -> int:
     except KeyboardInterrupt:
         # ^C is the documented way out of the default forever loop
         return 0
+
+
+def cmd_fleet(args) -> int:
+    """One replica's view of the serving fleet: the ``{"op": "fleet"}``
+    transport surface — membership states (alive/suspect/evicted),
+    per-peer breaker states, hedge soft deadline, peer-fetch/serve
+    counters, degraded flag."""
+    import json as _json
+    import socket
+
+    try:
+        with socket.create_connection((args.host, args.port),
+                                      timeout=args.timeout) as s:
+            f = s.makefile("rw", encoding="utf-8", newline="\n")
+            f.write(_json.dumps({"op": "fleet", "id": 1}) + "\n")
+            f.flush()
+            doc = _json.loads(f.readline() or "{}")
+    except (OSError, ValueError) as e:
+        print(f"error: cannot poll {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 1
+    fleet = doc.get("fleet")
+    if fleet is None:
+        print(f"{args.host}:{args.port}: not a fleet replica "
+              f"(started without --peers/--replica-id)")
+        return 1
+    if args.json:
+        print(_json.dumps(fleet, sort_keys=True, default=str))
+        return 0
+    print(f"replica={fleet.get('replica_id')} "
+          f"replication={fleet.get('replication')} "
+          f"degraded={fleet.get('degraded')}")
+    peers = dict((fleet.get("membership") or {}).get("peers") or {})
+    for pid in sorted(peers):
+        st = peers[pid] if isinstance(peers[pid], str) else \
+            peers[pid].get("state", "?")
+        brk = (dict(fleet.get("peer_breakers") or {}).get(pid)
+               or {}).get("state", "-")
+        print(f"  {pid:<16}{st:<10}breaker={brk}")
+    soft = fleet.get("hedge_soft_deadline_s")
+    print(f"hedge_soft_deadline_s={soft if soft is not None else '-'} "
+          f"peer_fetch_ok={fleet.get('peer_fetch_ok', 0)} "
+          f"peer_fetch_failed={fleet.get('peer_fetch_failed', 0)} "
+          f"chunks_served={fleet.get('chunks_served', 0)} "
+          f"hedges={fleet.get('hedges', 0)}/"
+          f"{fleet.get('hedge_wins', 0)} wins "
+          f"degraded_serves={fleet.get('degraded_serves', 0)}")
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -1236,6 +1450,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "rotation-capped (config.flight_dump_cap); "
                          "without it the always-on ring is memory-only "
                          "and still served via {\"op\": \"health\"}")
+    sv.add_argument("--replica-id", default=None, metavar="ID",
+                    help="this replica's name in the fleet peer set "
+                         "(enables fleet mode with --peers)")
+    sv.add_argument("--peers", default=None,
+                    metavar="ID=HOST:PORT,...",
+                    help="static fleet roster (every replica, including "
+                         "this one): rendezvous-hashed tile ownership, "
+                         "heartbeat membership, hedged peer-fetch of "
+                         "decoded tiles over the same TCP transport")
+    sv.add_argument("--replication", type=int, default=None,
+                    help="tile ownership replication factor R "
+                         "(default config.fleet_replication)")
     _add_obs_flags(sv)
     sv.set_defaults(fn=cmd_serve, uses_device=True)
 
@@ -1353,8 +1579,15 @@ def build_parser() -> argparse.ArgumentParser:
              "per-tenant q/s + p50/p99, cache hit rates, pool "
              "occupancy, breaker + SLO burn state, job resume progress")
     tp.add_argument("--host", default="127.0.0.1")
-    tp.add_argument("--port", type=int, required=True,
+    tp.add_argument("--port", type=int, default=None,
                     help="the serve process's TCP port")
+    tp.add_argument("--endpoints", default=None,
+                    metavar="HOST:PORT,...",
+                    help="fleet view: poll N replicas and render one "
+                         "row each (q/s, p50/p99, tile hit rate, peer "
+                         "breaker states, degraded flag) plus "
+                         "fleet-wide aggregates; DOWN rows for "
+                         "unreachable replicas")
     tp.add_argument("--interval", type=float, default=2.0,
                     help="seconds between polls (default 2)")
     tp.add_argument("--iterations", type=int, default=0,
@@ -1367,6 +1600,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also render *.hbam-journal resume progress "
                          "from DIR (the `hbam jobs --json` document)")
     tp.set_defaults(fn=cmd_top, uses_device=False)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="one replica's fleet view: membership states, per-peer "
+             "breaker states, hedge soft deadline, peer-fetch counters")
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, required=True,
+                    help="any fleet replica's TCP port")
+    fl.add_argument("--timeout", type=float, default=10.0)
+    fl.add_argument("--json", action="store_true",
+                    help="emit the raw fleet states document")
+    fl.set_defaults(fn=cmd_fleet, uses_device=False)
 
     vs = sub.add_parser("vcf-sort", help="sort a VCF/BCF by (contig, pos) "
                                          "(external spill-merge)")

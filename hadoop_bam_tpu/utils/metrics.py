@@ -214,6 +214,9 @@ class Metrics:
                 if ctx is not None:
                     ev_args["trace"] = ctx.trace_id
                     ev_args["psid"] = ctx.span_id
+                rid = trace_ctx.replica_id()
+                if rid is not None:
+                    ev_args["replica"] = rid
                 rec.complete(name, t0, seconds, ev_args or None)
         _flight.recorder().record_span(name, seconds, args or None)
 
@@ -258,6 +261,11 @@ class Metrics:
                         trace_ctx.end_span(tok)
                     except ValueError:
                         pass   # closed from another context: ids stand
+                rid = trace_ctx.replica_id()
+                if rid is not None:
+                    # fleet processes stamp their replica on every span
+                    # so a cross-replica trace attributes work correctly
+                    ev_args["replica"] = rid
                 rec.complete(name, t0, dur, ev_args or None)
             _flight.recorder().record_span(name, dur, args or None)
 

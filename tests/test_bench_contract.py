@@ -71,7 +71,16 @@ def _fill_state(bench, n_notes=6):
                        latency_p50_ms=4.6, latency_p99_ms=9.3,
                        cold_p50_ms=44.2, warm_host_decode_share=0.0,
                        clients_qps=[[1, 196.0], [8, 188.9]],
-                       regions=250, distinct_windows=51)
+                       regions=250, distinct_windows=51,
+                       # the r19 fleet arm: 1->2 endpoint q/s, the
+                       # cross-replica tile hit rate from the fleet
+                       # counters, and the client-observed SIGKILL
+                       # failover p99 — full row only
+                       fleet_replicas=2,
+                       fleet_qps=[[1, 41.2], [2, 66.9]],
+                       cross_replica_tile_hit_rate=0.44,
+                       fleet_kill_p99_ms=61.3,
+                       fleet_failed_requests=0)
         if m == "faulted_serve_queries_per_sec":
             # the r14 degrade-and-heal row: shed accounting, degraded vs
             # clean p50, ladder heal time and the reproducibility seed —
@@ -222,6 +231,16 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     assert rs["cold_p50_ms"] > rs["latency_p50_ms"] > 0
     assert [c for c, _q in rs["clients_qps"]] == [1, 8]
     assert all(q > 0 for _c, q in rs["clients_qps"])
+    # r19: the fleet arm pins the 1->2 endpoint q/s pairs, a bounded
+    # cross-replica tile hit rate, the client-observed kill-failover
+    # p99 and ZERO failed requests through the SIGKILL — shape only
+    # (rates are host-dependent), compact line keeps the number
+    assert rs["fleet_replicas"] == 2
+    assert [n for n, _q in rs["fleet_qps"]] == [1, 2]
+    assert all(q > 0 for _n, q in rs["fleet_qps"])
+    assert 0.0 <= rs["cross_replica_tile_hit_rate"] <= 1.0
+    assert rs["fleet_kill_p99_ms"] > 0
+    assert rs["fleet_failed_requests"] == 0
     ov = by_metric["obs_overhead_pct"]
     assert ov["instrumented_s"] > 0 and ov["null_s"] > 0
     # r12: the device decode plane row pins the tokenize / device-resolve

@@ -1,0 +1,859 @@
+"""Serving-fleet tests (``pytest -m serve``): rendezvous ownership
+determinism + minimal disruption, heartbeat membership on an injected
+clock (suspicion/eviction/rejoin, quorum), the chunk-source routing
+table, the ``serve.peer`` chaos point feeding per-peer breakers, the
+wire chunk codec, enqueue-anchored deadline re-budgeting across the
+hop, hedged peer-fetch (first result wins), two in-process replicas
+over real TCP (peer fetch vs the single-replica oracle, trace/replica
+stamping, degraded partition mode), the fleet ops views (``hbam
+fleet``, ``hbam top --endpoints``) — and the REAL failover test: a
+replica subprocess SIGKILLed mid-load with zero client-visible
+failures, eviction inside the window, and rejoin through half-open
+probes.
+"""
+import dataclasses
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+
+import pytest
+
+from hadoop_bam_tpu import resilience
+from hadoop_bam_tpu.config import DEFAULT_CONFIG
+from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+from hadoop_bam_tpu.resilience import CLOSED, OPEN
+from hadoop_bam_tpu.resilience.chaos import PointFault, fault_points_on
+from hadoop_bam_tpu.serve import ServeLoop, make_tcp_server
+from hadoop_bam_tpu.serve.fleet import (
+    Fleet, decode_chunk_doc, effective_deadline_s, encode_chunk_doc,
+    parse_peers,
+)
+from hadoop_bam_tpu.serve.membership import (
+    ALIVE, EVICTED, SUSPECT, Membership, owners, rank_members,
+    rendezvous_weight,
+)
+from hadoop_bam_tpu.utils.errors import (
+    CorruptDataError, PlanError, TransientIOError,
+)
+
+from fixtures import make_header, make_records
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+@pytest.fixture(autouse=True)
+def _restore_replica_id():
+    # Fleet.start() stamps the process-global replica id, and the
+    # in-process replica loops bump the global METRICS counters;
+    # both would otherwise leak into every later test.
+    from hadoop_bam_tpu.obs import context as obs_context
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
+    prev = obs_context.replica_id()
+    yield
+    obs_context.set_replica_id(prev)
+    METRICS.reset()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def _coord_sorted(header, recs):
+    def key(r):
+        rid = (header.ref_names.index(r.rname) if r.rname != "*"
+               else 1 << 30)
+        return (rid, r.pos)
+    return sorted(recs, key=key)
+
+
+@pytest.fixture(scope="module")
+def fleet_bam(tmp_path_factory):
+    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.split.bai import write_bai
+
+    path = str(tmp_path_factory.mktemp("fleet") / "f.bam")
+    header = make_header(2)
+    recs = _coord_sorted(header, make_records(header, 2000, seed=11))
+    with BamWriter(path, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    write_bai(path)
+    return path
+
+
+_REGIONS = ["chr1:1000-200000", "chr1:500000-650000", "chr2:1-5000",
+            "chr2:100000-400000"]
+
+
+def _oracle_counts(path, regions=_REGIONS):
+    engine = QueryEngine()
+    res = engine.query_records([QueryRequest(path, r) for r in regions])
+    return [len(r.records) for r in res]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wire(port, doc, timeout=10.0):
+    """One JSONL round trip to a replica's TCP transport."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        f = s.makefile("rw", encoding="utf-8", newline="\n")
+        f.write(json.dumps(doc) + "\n")
+        f.flush()
+        line = f.readline()
+    return json.loads(line)
+
+
+# ---------------------------------------------------------------------------
+# rendezvous ownership: deterministic, total, minimally disruptive
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_weight_is_keyed_blake2b_not_salted_hash():
+    # pinned values: the weight must be identical across processes and
+    # Python runs (a salted hash() here would silently split the fleet
+    # into disagreeing ownership views)
+    k = ("ident", (0, 100), "iv")
+    assert rendezvous_weight(k, "r1") == rendezvous_weight(k, "r1")
+    w1, w2 = rendezvous_weight(k, "r1"), rendezvous_weight(k, "r2")
+    assert w1 != w2
+    assert 0 <= w1 < (1 << 64)
+    # ranking is a permutation with total order (ties broken by id)
+    ms = ["r1", "r2", "r3", "r4"]
+    ranked = rank_members(k, ms)
+    assert sorted(ranked) == sorted(ms)
+    assert rank_members(k, list(reversed(ms))) == ranked
+
+
+def test_rendezvous_same_ranking_in_subprocess(tmp_path):
+    """The cross-process determinism contract, tested literally."""
+    keys = [("id", (i, i + 10), "iv") for i in range(20)]
+    ms = ["a", "b", "c"]
+    script = textwrap.dedent("""
+        import json, sys
+        from hadoop_bam_tpu.serve.membership import rank_members
+        keys = [tuple(k) if not isinstance(k, list) else
+                (k[0], tuple(k[1]), k[2])
+                for k in json.loads(sys.argv[1])]
+        print(json.dumps([rank_members(k, ["a", "b", "c"])
+                          for k in keys]))
+    """)
+    sp = str(tmp_path / "rdv.py")
+    open(sp, "w").write(script)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, sp, json.dumps(keys)], env=env,
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout) == [rank_members(k, ms) for k in keys]
+
+
+def test_rendezvous_removal_moves_only_the_dead_members_share():
+    """Minimal disruption: dropping one member re-ranks ONLY the keys
+    that member owned — every other key keeps its exact owner list."""
+    ms = ["r1", "r2", "r3", "r4", "r5"]
+    keys = [("f", (i * 100, i * 100 + 99), "iv") for i in range(300)]
+    before = {k: owners(k, ms, 2) for k in keys}
+    after = {k: owners(k, [m for m in ms if m != "r3"], 2) for k in keys}
+    moved = untouched = 0
+    for k in keys:
+        if "r3" in before[k]:
+            moved += 1
+            # survivors keep their relative order; r3's slot backfills
+            kept = [m for m in before[k] if m != "r3"]
+            assert after[k][:len(kept)] == kept
+        else:
+            untouched += 1
+            assert after[k] == before[k]
+    assert moved > 0 and untouched > 0
+
+
+# ---------------------------------------------------------------------------
+# heartbeat membership on an injected clock
+# ---------------------------------------------------------------------------
+
+def test_membership_suspicion_eviction_rejoin_transitions():
+    clk = FakeClock()
+    m = Membership("r1", ["r2", "r3"], suspicion_s=1.0, eviction_s=3.0,
+                   clock=clk)
+    assert m.members() == ["r1", "r2", "r3"]
+    assert m.sweep() == []                       # everyone fresh
+    clk.advance(1.5)
+    m.observe("r2")                              # r2 heartbeats, r3 silent
+    assert dict(m.sweep())["r3"] == SUSPECT
+    # SUSPECT stays ranked: a hiccup must not move tile ownership
+    assert "r3" in m.members()
+    clk.advance(2.0)                             # r3 now 3.5s silent
+    assert dict(m.sweep())["r3"] == EVICTED
+    assert m.members() == ["r1", "r2"]
+    assert m.evictions_total == 1
+    key = ("f", (0, 10), "iv")
+    assert "r3" not in m.owners_for(key, 3)
+    # an observation re-admits immediately (breakers still gate traffic)
+    assert m.observe("r3") is True
+    assert m.rejoins_total == 1
+    assert "r3" in m.members()
+    assert m.observe("r3") is False              # already alive
+    assert m.observe("stranger") is False        # not in the roster
+
+
+def test_membership_quorum_and_degraded_boundary():
+    clk = FakeClock()
+    m = Membership("r1", ["r2", "r3", "r4"], suspicion_s=0.5,
+                   eviction_s=1.0, clock=clk)
+    assert m.has_quorum()                        # 4/4 visible
+    clk.advance(2.0)
+    m.observe("r2")
+    m.sweep()                                    # r3, r4 evicted
+    # 2 of 4 visible: NOT a majority — degraded
+    assert not m.has_quorum()
+    m.observe("r3")
+    assert m.has_quorum()                        # 3 of 4 again
+    assert m.states()["peers"]["r4"]["state"] == EVICTED
+
+
+def test_membership_empty_id_is_plan_error():
+    with pytest.raises(PlanError):
+        Membership("", ["r2"])
+
+
+# ---------------------------------------------------------------------------
+# chunk-source routing (plan/executor.select_chunk_source)
+# ---------------------------------------------------------------------------
+
+def test_select_chunk_source_routing_table():
+    from hadoop_bam_tpu.plan.executor import select_chunk_source
+
+    def pick(**kw):
+        base = dict(tile_cached=False, fleet_owned=False, degraded=False,
+                    want_records=False, peer_ready=True)
+        base.update(kw)
+        return select_chunk_source(**base)[0]
+
+    assert pick(tile_cached=True) == "tile"          # hit beats all
+    assert pick(degraded=True) == "local"            # partition mode
+    assert pick(want_records=True) == "local"        # records are local
+    assert pick(fleet_owned=True) == "local"         # we own it
+    assert pick(peer_ready=False) == "local"         # nobody to ask
+    assert pick() == "peer"                          # peer-owned: fetch
+    # every row explains itself (the explain-plane discipline)
+    _, why = select_chunk_source(
+        tile_cached=False, fleet_owned=False, degraded=False,
+        want_records=False, peer_ready=True)
+    assert why
+
+
+# ---------------------------------------------------------------------------
+# wire plumbing: peer specs, deadline re-anchor, chunk codec
+# ---------------------------------------------------------------------------
+
+def test_parse_peers_specs_and_errors():
+    assert parse_peers("a=127.0.0.1:7001, b=h2:7002") == {
+        "a": ("127.0.0.1", 7001), "b": ("h2", 7002)}
+    assert parse_peers("127.0.0.1:9000") == {
+        "127.0.0.1:9000": ("127.0.0.1", 9000)}
+    assert parse_peers("") == {}
+    for bad in ("a=nohost", "a=host:", "a=:77", "x=h:7a"):
+        with pytest.raises(PlanError):
+            parse_peers(bad)
+
+
+def test_effective_deadline_reanchors_to_originating_enqueue():
+    assert effective_deadline_s(None, 1.0) is None   # unbudgeted
+    assert effective_deadline_s(2.0, 0.5) == 1.5     # age already spent
+    assert effective_deadline_s(2.0, None) == 2.0
+    assert effective_deadline_s(1.0, 5.0) == 0.0     # exhausted, not fresh
+    # hostile/corrupt ages are ignored, never trusted into a negative
+    # or bonus budget
+    assert effective_deadline_s(2.0, -3.0) == 2.0
+    assert effective_deadline_s(2.0, 1e9) == 2.0
+    assert effective_deadline_s(2.0, "junk") == 2.0
+
+
+def test_chunk_doc_codec_round_trip_and_corrupt_shape():
+    import numpy as np
+
+    value = {"n": 3, "nbytes": 4096,
+             "rid": np.array([0, 0, 1], np.int32),
+             "pos1": np.array([10, 20, 30], np.int32),
+             "end1": np.array([15, 25, 35], np.int32)}
+    doc = encode_chunk_doc(value)
+    back = decode_chunk_doc(doc)
+    assert back["n"] == 3 and back["nbytes"] == 4096
+    assert back["records"] == []                 # records never hop
+    for k in ("rid", "pos1", "end1"):
+        assert back[k].tolist() == value[k].tolist()
+    # a short column is CORRUPT at decode time, not an index error later
+    bad = dict(doc, n=5)
+    with pytest.raises(CorruptDataError):
+        decode_chunk_doc(bad)
+    # the quarantine marker (n=0 AND nbytes=0) survives the hop
+    empty = decode_chunk_doc(encode_chunk_doc(
+        {"n": 0, "nbytes": 0,
+         "rid": np.zeros(0, np.int32), "pos1": np.zeros(0, np.int32),
+         "end1": np.zeros(0, np.int32)}))
+    assert empty["n"] == 0 and empty["nbytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the serve.peer chaos point + per-peer breakers
+# ---------------------------------------------------------------------------
+
+def _mini_fleet(peer_ports=None, clock=None, **cfg_kw):
+    peers = ",".join(f"p{i}=127.0.0.1:{p}"
+                     for i, p in enumerate(peer_ports or [1]))
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG, serve_replica_id="self", serve_peers=peers,
+        **cfg_kw)
+    return Fleet(cfg, clock=clock or time.monotonic)
+
+
+def test_chaos_point_serve_peer_is_known_and_fires_before_dial():
+    from hadoop_bam_tpu.resilience.chaos import KNOWN_POINTS
+    assert "serve.peer" in KNOWN_POINTS
+
+    resilience.reset()
+    fleet = _mini_fleet()
+    with fault_points_on("serve.peer",
+                         [PointFault("transient", count=1000)]):
+        with pytest.raises(TransientIOError):
+            fleet._peer_call("p0", {"op": "heartbeat"}, timeout_s=0.1)
+    with fault_points_on("serve.peer",
+                         [PointFault("disconnect", count=1000)]):
+        with pytest.raises(ConnectionResetError):
+            fleet._peer_call("p0", {"op": "heartbeat"}, timeout_s=0.1)
+    resilience.reset()
+
+
+def test_injected_peer_faults_feed_the_peer_breaker_and_fallback():
+    """The observation contract: chaos at serve.peer exercises exactly
+    the breaker + fallback stack a real peer fault would."""
+    resilience.reset()
+    fleet = _mini_fleet(breaker_failure_threshold=2.0)
+    key = ("f", (0, 10), "iv")
+    with fault_points_on("serve.peer",
+                         [PointFault("transient", count=1000)]):
+        with pytest.raises(TransientIOError):
+            fleet.fetch_chunk("/nope.bam", key, 0, 10)
+        with pytest.raises(TransientIOError):
+            fleet.fetch_chunk("/nope.bam", key, 0, 10)
+    states = resilience.registry().states()
+    dom = states["serve/peer/p0"]
+    assert dom["failures_total"] >= 2
+    assert dom["state"] == OPEN
+    assert fleet.peer_fetch_failed == 2
+    # with the breaker OPEN the peer is not even dialed: candidates are
+    # exhausted instantly and the caller falls back to local decode
+    with pytest.raises(TransientIOError, match="unavailable"):
+        fleet.fetch_chunk("/nope.bam", key, 0, 10)
+    resilience.reset()
+
+
+def test_heartbeat_breaker_opens_then_heals_through_half_open_probe():
+    """The rejoin contract end to end on one process: a dead peer's
+    breaker opens (heartbeats ARE the failure source), membership
+    evicts it on the injected clock, and after the peer comes back the
+    heartbeat doubles as the half-open probe that heals the breaker
+    BEFORE query traffic flows."""
+    clk = FakeClock()
+    resilience.reset(clock=clk)
+    port = _free_port()
+    fleet = _mini_fleet(peer_ports=[port], clock=clk,
+                        breaker_failure_threshold=2.0,
+                        breaker_cooldown_s=5.0,
+                        fleet_suspicion_s=1.0, fleet_eviction_s=3.0)
+    # nobody listening: each round dials, fails, feeds the breaker
+    fleet.heartbeat_round()
+    clk.advance(1.5)
+    fleet.heartbeat_round()
+    states = resilience.registry().states()
+    assert states["serve/peer/p0"]["state"] == OPEN
+    assert fleet.membership.states()["peers"]["p0"]["state"] == SUSPECT
+    clk.advance(2.0)
+    fleet.heartbeat_round()                      # breaker OPEN: no dial
+    assert fleet.membership.states()["peers"]["p0"]["state"] == EVICTED
+    assert fleet.degraded()                      # 1 of 2 visible
+    # the peer comes back: a fake JSONL responder on the same port
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(4)
+
+    def responder():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            with c:
+                f = c.makefile("rw", encoding="utf-8", newline="\n")
+                if f.readline():
+                    f.write(json.dumps({"ok": True}) + "\n")
+                    f.flush()
+
+    t = threading.Thread(target=responder, daemon=True)
+    t.start()
+    try:
+        fleet.heartbeat_round()                  # still cooling down
+        assert resilience.registry().states()["serve/peer/p0"]["state"] \
+            == OPEN
+        clk.advance(5.1)                         # cooldown elapses
+        fleet.heartbeat_round()                  # half-open probe = hb
+        states = resilience.registry().states()
+        assert states["serve/peer/p0"]["state"] == CLOSED
+        assert states["serve/peer/p0"]["healed_total"] == 1
+        assert fleet.membership.states()["peers"]["p0"]["state"] == ALIVE
+        assert fleet.membership.rejoins_total == 1
+        assert not fleet.degraded()
+    finally:
+        srv.close()
+        t.join(2.0)
+    resilience.reset()
+
+
+# ---------------------------------------------------------------------------
+# hedged peer-fetch: first result wins past the decaying-p95 deadline
+# ---------------------------------------------------------------------------
+
+def test_hedge_races_next_ranked_replica_first_result_wins():
+    resilience.reset()
+    fleet = _mini_fleet(peer_ports=[1, 2], fleet_hedge_min_s=0.02)
+    for _ in range(16):                          # warm the p95
+        fleet.latency.observe(0.005)
+    assert fleet.latency.soft_deadline_s() is not None
+
+    def fake_timed(pid, doc, timeout_s):
+        if pid == "p0":
+            time.sleep(0.5)                      # the straggler primary
+            return {"who": "p0"}
+        return {"who": "p1"}
+
+    fleet._timed_call = fake_timed
+    t0 = time.perf_counter()
+    resp = fleet._fetch_hedged(["p0", "p1"], {"op": "chunk"})
+    took = time.perf_counter() - t0
+    assert resp["who"] == "p1"                   # the hedge won
+    assert fleet.hedges == 1 and fleet.hedge_wins == 1
+    assert took < 0.45                           # did not wait out p0
+    resilience.reset()
+
+
+def test_hedge_errors_fall_through_to_next_owner():
+    resilience.reset()
+    fleet = _mini_fleet(peer_ports=[1, 2])
+
+    calls = []
+
+    def fake_timed(pid, doc, timeout_s):
+        calls.append(pid)
+        if pid == "p0":
+            raise TransientIOError("p0 is sick")
+        return {"who": pid}
+
+    fleet._timed_call = fake_timed
+    assert fleet._fetch_hedged(["p0", "p1"], {})["who"] == "p1"
+    assert calls == ["p0", "p1"]
+    fleet._timed_call = lambda *a: (_ for _ in ()).throw(
+        TransientIOError("all sick"))
+    with pytest.raises(TransientIOError, match="every owner"):
+        fleet._fetch_hedged(["p0", "p1"], {})
+    resilience.reset()
+
+
+# ---------------------------------------------------------------------------
+# two in-process replicas over real TCP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def two_replicas(fleet_bam):
+    resilience.reset()
+    p1, p2 = _free_port(), _free_port()
+    peers = f"r1=127.0.0.1:{p1},r2=127.0.0.1:{p2}"
+    loops, servers, threads = [], [], []
+    for rid, port in (("r1", p1), ("r2", p2)):
+        cfg = dataclasses.replace(
+            DEFAULT_CONFIG, serve_replica_id=rid, serve_peers=peers,
+            fleet_replication=1, fleet_heartbeat_s=0.1,
+            serve_prefetch=False)
+        loop = ServeLoop(config=cfg)
+        loop.start()
+        srv = make_tcp_server(loop, host="127.0.0.1", port=port)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        loops.append(loop)
+        servers.append(srv)
+        threads.append(t)
+    try:
+        yield loops, (p1, p2)
+    finally:
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        for loop in loops:
+            loop.stop()
+        for t in threads:
+            t.join(5.0)
+        resilience.reset()
+
+
+def test_fleet_peer_fetch_matches_oracle_and_splits_ownership(
+        two_replicas, fleet_bam):
+    loops, _ports = two_replicas
+    want = _oracle_counts(fleet_bam)
+    res1 = loops[0].query(fleet_bam, _REGIONS)
+    res2 = loops[1].query(fleet_bam, _REGIONS)
+    assert [r.count for r in res1] == want
+    assert [r.count for r in res2] == want
+    # replication=1 over 2 replicas: each owns a strict subset, so BOTH
+    # sides peer-fetched something and served something for the other
+    f1, f2 = loops[0].fleet, loops[1].fleet
+    assert f1.peer_fetch_ok + f2.peer_fetch_ok > 0
+    assert f1.chunks_served + f2.chunks_served > 0
+    assert f1.peer_fetch_ok == f2.chunks_served
+    assert f2.peer_fetch_ok == f1.chunks_served
+    assert f1.peer_fetch_failed == f2.peer_fetch_failed == 0
+    # provenance rides the results
+    assert all(r.extra["replica"] == "r1" for r in res1)
+    assert any(r.extra.get("peer_chunks") for r in res1 + res2)
+    assert not any(r.extra.get("degraded") for r in res1 + res2)
+
+
+def test_fleet_records_mode_stays_local_and_byte_identical(
+        two_replicas, fleet_bam):
+    loops, _ports = two_replicas
+    engine = QueryEngine()
+    oracle = engine.query_records(
+        [QueryRequest(fleet_bam, r) for r in _REGIONS[:2]])
+    before = loops[0].fleet.peer_fetch_ok
+    res = loops[0].query(fleet_bam, _REGIONS[:2], want_records=True)
+    for out, want in zip(res, oracle):
+        assert [r.to_line() for r in out.records] == \
+            [r.to_line() for r in want.records]
+    # records mode never peer-fetches (materialization is local)
+    assert loops[0].fleet.peer_fetch_ok == before
+
+
+def test_fleet_wire_ops_and_trace_replica_stamping(two_replicas,
+                                                   fleet_bam):
+    loops, (p1, p2) = two_replicas
+    want = _oracle_counts(fleet_bam, [_REGIONS[0]])
+    # a client request with a trace id: the reply echoes the SAME id
+    # (the adopted hop contract) and names the answering replica
+    doc = _wire(p1, {"id": 1, "path": fleet_bam, "region": _REGIONS[0],
+                     "trace": "trace-abc123"})
+    assert doc["trace"] == "trace-abc123"
+    assert doc["replica"] == "r1"
+    assert doc["results"][0]["count"] == want[0]
+    assert doc["results"][0]["replica"] == "r1"
+    # heartbeat op: the sender is observed, the reply names the replica
+    hb = _wire(p2, {"op": "heartbeat", "from": "r1", "id": 9})
+    assert hb["ok"] is True and hb["replica"] == "r2"
+    # fleet op: membership + per-peer breakers + counters
+    fl = _wire(p1, {"op": "fleet", "id": 10})["fleet"]
+    assert fl["replica_id"] == "r1"
+    assert fl["membership"]["peers"]["r2"]["state"] == ALIVE
+    assert fl["peer_breakers"]["r2"]["state"] == CLOSED
+    # chunk op errors are wire-taxonomy classified
+    bad = _wire(p1, {"op": "chunk", "id": 11})
+    assert bad["kind"] == "plan"
+    # health carries the fleet view
+    h = _wire(p1, {"op": "health", "id": 12})["health"]
+    assert h["fleet"]["replica_id"] == "r1"
+
+
+def test_wire_deadline_reanchors_not_refreshes(two_replicas, fleet_bam):
+    loops, (p1, _p2) = two_replicas
+    # a request whose budget the PRIOR hops already spent: deadline_s
+    # minus enqueue_age_s leaves ~nothing — the replica must shed it as
+    # a deadline miss (transient, retryable) instead of re-anchoring to
+    # a fresh budget
+    doc = _wire(p1, {"id": 1, "path": fleet_bam, "region": _REGIONS[0],
+                     "deadline_s": 5.0, "enqueue_age_s": 4.9999999})
+    assert doc["kind"] == "transient"
+    assert "deadline" in doc["error"]
+    # the same request with its age intact is answerable
+    ok = _wire(p1, {"id": 2, "path": fleet_bam, "region": _REGIONS[0],
+                    "deadline_s": 30.0, "enqueue_age_s": 0.5})
+    assert ok["results"][0]["count"] == _oracle_counts(
+        fleet_bam, [_REGIONS[0]])[0]
+
+
+def test_degraded_partition_serves_with_flag_instead_of_erroring(
+        fleet_bam):
+    """A replica that lost quorum keeps serving what it can, marked
+    ``extra.degraded`` — partition behavior, not an outage."""
+    resilience.reset()
+    clk = FakeClock()
+    # a 3-member fleet where both peers are dead ports: no quorum once
+    # they age out on the injected clock
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG, serve_replica_id="solo",
+        serve_peers=(f"solo=127.0.0.1:1,pa=127.0.0.1:{_free_port()},"
+                     f"pb=127.0.0.1:{_free_port()}"),
+        fleet_replication=1, fleet_suspicion_s=0.5, fleet_eviction_s=1.0,
+        serve_prefetch=False)
+    fleet = Fleet(cfg, clock=clk)
+    fleet.heartbeat_round()
+    clk.advance(2.0)
+    fleet.heartbeat_round()
+    assert fleet.degraded()
+    with ServeLoop(config=cfg, fleet=fleet) as loop:
+        res = loop.query(fleet_bam, _REGIONS)
+        assert [r.count for r in res] == _oracle_counts(fleet_bam)
+        assert all(r.extra["degraded"] is True for r in res)
+        assert all(r.extra["replica"] == "solo" for r in res)
+    assert fleet.degraded_serves > 0
+    assert fleet.peer_fetch_ok == 0              # degraded: all local
+    resilience.reset()
+
+
+# ---------------------------------------------------------------------------
+# fleet ops views: hbam fleet, hbam top --endpoints
+# ---------------------------------------------------------------------------
+
+def test_hbam_fleet_and_top_endpoints_render_live_fleet(
+        two_replicas, fleet_bam, capsys):
+    from hadoop_bam_tpu.tools import cli
+
+    loops, (p1, p2) = two_replicas
+    loops[0].query(fleet_bam, _REGIONS)          # live traffic
+    rc = cli.main(["fleet", "--port", str(p1)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "replica=r1" in out and "r2" in out
+    assert "breaker=closed" in out
+    assert "peer_fetch_ok=" in out
+
+    rc = cli.main(["fleet", "--port", str(p2), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["replica_id"] == "r2"
+
+    # the fleet table: one row per replica + aggregates, DOWN rows for
+    # unreachable endpoints instead of a failed frame
+    dead = _free_port()
+    rc = cli.main(["top", "--endpoints",
+                   f"127.0.0.1:{p1},127.0.0.1:{p2},127.0.0.1:{dead}",
+                   "--once", "--timeout", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "r1" in out and "r2" in out
+    assert "DOWN" in out
+    assert "up=2/3" in out
+    assert "cross_replica_tile_rate=" in out
+
+
+def test_top_requires_port_or_endpoints(capsys):
+    from hadoop_bam_tpu.tools import cli
+
+    assert cli.main(["top", "--once"]) == 2
+    assert "--endpoints" in capsys.readouterr().err
+    assert cli.main(["top", "--endpoints", "garbage", "--once"]) == 2
+
+
+def test_serve_verb_validates_fleet_flags(capsys):
+    from hadoop_bam_tpu.tools import cli
+
+    assert cli.main(["serve", "--peers", "a=127.0.0.1:1",
+                     "--port", "0"]) == 2
+    assert "--replica-id" in capsys.readouterr().err
+    assert cli.main(["serve", "--peers", "a=127.0.0.1:1",
+                     "--replica-id", "a"]) == 2
+    assert "--port" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the REAL failover test: SIGKILL a replica subprocess mid-load
+# ---------------------------------------------------------------------------
+
+_REPLICA_SCRIPT = """
+    import dataclasses, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.serve import ServeLoop, make_tcp_server
+
+    rid, port, peers, warm = sys.argv[1], int(sys.argv[2]), \\
+        sys.argv[3], sys.argv[4]
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG, serve_replica_id=rid, serve_peers=peers,
+        fleet_replication=1, fleet_heartbeat_s=0.15,
+        fleet_suspicion_s=0.6, fleet_eviction_s=1.5,
+        breaker_cooldown_s=0.5, breaker_failure_threshold=2.0,
+        serve_prefetch=False)
+    with ServeLoop(config=cfg) as loop:
+        loop.engine._file_meta(warm)
+        server = make_tcp_server(loop, host="127.0.0.1", port=port)
+        print("READY", flush=True)
+        server.serve_forever()
+"""
+
+
+def _spawn_replica(rid, port, peers, warm):
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(textwrap.dedent(_REPLICA_SCRIPT))
+        script = f.name
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return script, subprocess.Popen(
+        [sys.executable, script, rid, str(port), peers, warm],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+
+
+def _await_replica(port, deadline_s=120.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            doc = _wire(port, {"op": "health", "id": 1}, timeout=2.0)
+            if doc.get("health", {}).get("status"):
+                return
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise AssertionError(f"replica on port {port} never became healthy")
+
+
+def _query_with_retry(port, path, region, retries=3):
+    """The documented client contract: one retry on transport-level
+    failure is allowed; an error DOC or exhausted retries is a
+    client-visible failure."""
+    last = None
+    for _ in range(retries):
+        try:
+            doc = _wire(port, {"id": 1, "path": path, "region": region},
+                        timeout=30.0)
+        except (OSError, ValueError) as e:
+            last = str(e)
+            time.sleep(0.2)
+            continue
+        if "error" in doc:
+            return None, f"error doc: {doc}"
+        return doc, None
+    return None, f"transport: {last}"
+
+
+def test_sigkill_failover_eviction_and_halfopen_rejoin(fleet_bam):
+    """Kill one replica of a live 2-replica fleet with SIGKILL:
+
+    - every client request against the surviving replica still answers,
+      byte-identical to the single-replica oracle (zero client-visible
+      failures after the allowed retry);
+    - the dead replica is EVICTED within the suspicion/eviction window;
+    - the restarted replica REJOINS through half-open breaker probes
+      and serves again.
+    """
+    want = _oracle_counts(fleet_bam)
+    p1, p2 = _free_port(), _free_port()
+    peers = f"r1=127.0.0.1:{p1},r2=127.0.0.1:{p2}"
+    s1, proc1 = _spawn_replica("r1", p1, peers, fleet_bam)
+    s2, proc2 = _spawn_replica("r2", p2, peers, fleet_bam)
+    procs = [proc1, proc2]
+    try:
+        _await_replica(p1)
+        _await_replica(p2)
+        failures = []
+
+        def drive(port, tag):
+            for i, region in enumerate(_REGIONS):
+                doc, err = _query_with_retry(port, fleet_bam, region)
+                if err is not None:
+                    failures.append((tag, region, err))
+                elif doc["results"][0]["count"] != want[i]:
+                    failures.append((tag, region, "count mismatch",
+                                     doc["results"][0]["count"]))
+
+        drive(p1, "warm-r1")                     # both replicas warm;
+        drive(p2, "warm-r2")                     # peer fetch is live
+        fl = _wire(p1, {"op": "fleet", "id": 1})["fleet"]
+        assert fl["peer_fetch_ok"] + fl["chunks_served"] > 0
+
+        # ---- SIGKILL r2 mid-load -------------------------------------
+        proc2.kill()                             # SIGKILL, not TERM
+        proc2.wait(timeout=30)
+        assert proc2.returncode == -signal.SIGKILL
+        # the survivor answers every request through the kill: peer
+        # fetches fail onto the local-decode fallback, never the client
+        t_kill = time.monotonic()
+        for _ in range(3):
+            drive(p1, "during-kill")
+        assert failures == [], failures
+
+        # ---- eviction within the window ------------------------------
+        evicted_at = None
+        while time.monotonic() - t_kill < 20.0:
+            fl = _wire(p1, {"op": "fleet", "id": 1})["fleet"]
+            if fl["membership"]["peers"]["r2"]["state"] == "evicted":
+                evicted_at = time.monotonic() - t_kill
+                break
+            time.sleep(0.2)
+        assert evicted_at is not None, "r2 never evicted"
+        # window: eviction_s (1.5) + heartbeat jitter + poll slack
+        assert evicted_at < 15.0
+        assert fl["degraded"] is True            # 1 of 2 visible
+        breaker = fl["peer_breakers"]["r2"]
+        assert breaker["opened_total"] >= 1      # heartbeats tripped it
+        drive(p1, "post-evict")
+        assert failures == [], failures
+
+        # ---- rejoin through half-open probes -------------------------
+        s2b, proc2 = _spawn_replica("r2", p2, peers, fleet_bam)
+        procs[1] = proc2
+        _await_replica(p2)
+        rejoined = False
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0:
+            fl = _wire(p1, {"op": "fleet", "id": 1})["fleet"]
+            st = fl["membership"]["peers"]["r2"]["state"]
+            brk = fl["peer_breakers"]["r2"]
+            if st == "alive" and brk["state"] == "closed":
+                rejoined = True
+                break
+            time.sleep(0.2)
+        assert rejoined, f"r2 never rejoined: {fl}"
+        assert fl["peer_breakers"]["r2"]["healed_total"] >= 1
+        assert fl["membership"]["rejoins_total"] >= 1
+        assert fl["degraded"] is False
+        drive(p1, "post-rejoin")
+        drive(p2, "rejoined-r2")
+        assert failures == [], failures
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass
+        for sp in (s1, s2):
+            if os.path.exists(sp):
+                os.unlink(sp)

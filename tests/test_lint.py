@@ -264,6 +264,49 @@ def stream(records):
         assert lint_sources({mod: good}, only=["taxonomy"]) == [], mod
 
 
+def test_et_scope_covers_fleet_boundaries():
+    """ISSUE 16 scope extension: the fleet modules are policy
+    boundaries twice over — the error class decides whether a peer
+    answer feeds that peer's circuit breaker (PLAN never does) AND what
+    ``error_kind`` the peer sees on the wire.  A bare builtin raised
+    there misroutes both."""
+    bad = '''
+def answer(resp):
+    if "cols" not in resp:
+        raise ValueError("peer answered without columns")
+'''
+    for mod in ("hadoop_bam_tpu/serve/fleet.py",
+                "hadoop_bam_tpu/serve/membership.py"):
+        findings = lint_sources({mod: bad}, only=["taxonomy"])
+        assert rules_of(findings) == {"ET301"}, mod
+
+
+def test_et_fleet_clean_twin_passes():
+    """The classified version of the same fleet boundary code is
+    clean: CorruptDataError for bad peer bytes, PlanError for a
+    misconfigured roster, TransientIOError for a dead peer."""
+    good = '''
+from hadoop_bam_tpu.utils.errors import (
+    CorruptDataError, PlanError, TransientIOError,
+)
+
+def answer(resp):
+    if "cols" not in resp:
+        raise CorruptDataError("peer answered without columns")
+
+def roster(spec):
+    if not spec:
+        raise PlanError("a fleet needs a non-empty peer roster")
+
+def dial(ok):
+    if not ok:
+        raise TransientIOError("peer closed the connection; retry")
+'''
+    for mod in ("hadoop_bam_tpu/serve/fleet.py",
+                "hadoop_bam_tpu/serve/membership.py"):
+        assert lint_sources({mod: good}, only=["taxonomy"]) == [], mod
+
+
 def test_et_classified_raises_pass():
     findings = lint_sources({"hadoop_bam_tpu/formats/bgzf.py": '''
 from hadoop_bam_tpu.utils.errors import CorruptDataError, PlanError
