@@ -1,6 +1,6 @@
 """hbam-lint: repo-native static analysis (``python -m hadoop_bam_tpu lint``).
 
-Five AST analyzers over correctness regimes generic linters cannot see:
+AST analyzers over correctness regimes generic linters cannot see:
 
 - ``trace_safety`` (TS1xx) — host Python inside JAX-traced code
 - ``lockstep``     (CL2xx) — collectives off the uniform control path
@@ -21,9 +21,16 @@ Five AST analyzers over correctness regimes generic linters cannot see:
   the mesh sort: publication renames outside the blessed/journaled
   commit helpers, non-idempotent (random/pid/time-derived) temp names
   that resume can neither verify nor sweep
+- ``threadsafety`` (TH1xx/LK2xx) — thread-topology races and lock
+  discipline on the shared interprocedural engine
+  (``analysis/callgraph.py``): unguarded cross-thread writes,
+  check-then-act outside a guard, lock-order cycles
 
 Findings carry file:line, rule id and severity; ``analysis/baseline.json``
 suppresses accepted legacy findings so CI fails only on regressions.
+``analysis/lintcache.py`` short-circuits a full re-run when nothing in
+the tree (or the analyzers) changed; ``--format json|sarif`` emits
+machine-readable findings for CI annotation.
 """
 from hadoop_bam_tpu.analysis.core import (  # noqa: F401
     Baseline, Finding, Project, analyzers, lint_main, run_analyzers,

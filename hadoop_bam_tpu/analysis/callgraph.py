@@ -1,0 +1,958 @@
+"""Shared interprocedural engine for the hbam-lint analyzers.
+
+Three analyzers need more than single-function AST walks: trace safety
+(TS1xx) propagates tracer-ness along project-internal calls, the obs
+rules (OB6xx) need to know which nested functions a dispatcher hands to
+the decode pool, and the thread-safety rules (TH1xx/LK2xx) need the
+whole thread topology — which functions run on which threads, what
+shared state each can reach, and which locks are held on the way.
+This module is the one place that machinery lives:
+
+- ``ModuleIndex``: per-module function/import/alias index (extracted
+  from ``trace_safety``'s private ``_ModuleIndex``).
+- ``InterproceduralWorklist``: the generic (module path, qualname) →
+  param-set propagation fixpoint that trace safety's taint pass runs on,
+  including cross-module ``import`` key resolution and positional
+  (``#N``) argument markers.
+- ``CallGraphEngine``: call resolution (lexical names, ``self.m()``
+  methods, dotted imports), **thread-root discovery**
+  (``threading.Thread(target=...)`` — including the ``ctx.run``
+  and ``lambda: ctx.run(f)`` indirections the repo uses to carry
+  contextvars onto worker threads — executor/pool ``submit``/``map``
+  callables, ``add_done_callback``, and the named ``handle_stream``
+  TCP-handler root), per-root reachability, shared-state access
+  collection (``self`` attributes, module globals, closure cells), and
+  interprocedural **guard inference**: the set of locks provably held
+  at every access, combining lexical ``with <lock>:`` context with an
+  intersection-over-call-sites entry-guard fixpoint.
+
+The engine is deliberately conservative in both directions that matter
+for an empty-baseline gate: unresolvable calls (dynamic dispatch,
+callables in variables) silently end a reachability edge rather than
+guessing, and accesses through receivers other than ``self`` are
+skipped rather than alias-analyzed — precision over recall, so the
+repo gate stays actionable.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import (
+    Callable, Dict, FrozenSet, Iterator, List, Optional, Sequence, Set,
+    Tuple,
+)
+
+from hadoop_bam_tpu.analysis.astutil import (
+    FuncInfo, collect_functions, dotted_name, enclosing_function,
+    import_aliases, last_segment, resolve_name,
+)
+from hadoop_bam_tpu.analysis.core import Project
+
+# (module path, qualname) — the identity every interprocedural pass keys on
+FuncKey = Tuple[str, str]
+
+# Access / lock identities.  Tuples, not classes, so they hash and sort:
+#   ('attr',    class qualname, attr)          self.X on a known class
+#   ('global',  module path, name)             module-level variable
+#   ('closure', module path, owner qualname, name)   cell of an enclosing fn
+#   ('local',   module path, owner qualname, name)   function-local (locks)
+AccessId = Tuple[str, ...]
+
+# -- shared vocabulary -------------------------------------------------------
+
+# dispatcher entry points that hand a callable to the shared decode pool
+# (used by obsrules' OB602 and by thread-root discovery)
+POOL_DISPATCHERS = {"_iter_windowed", "submit", "pool_submit", "map"}
+
+# constructors whose instances ARE locks for guard purposes: holding one
+# in a `with` block establishes mutual exclusion
+_LOCK_CONSTRUCTORS = {"Lock", "RLock", "Condition"}
+
+# constructors whose instances are internally thread-safe: mutating them
+# without a guard is fine (their own locking is the guard)
+_THREADSAFE_CONSTRUCTORS = {
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "Event",
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Barrier", "ContextVar", "local", "Thread", "Timer",
+}
+
+# container-mutating method names: receiver.m(...) writes the receiver
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "move_to_end", "rotate",
+}
+
+# module-level functions that mutate their first argument
+_MUTATOR_FUNCS = {"heappush", "heappop", "heapify", "heappushpop",
+                  "heapreplace"}
+
+# functions with this name are thread roots by convention: each TCP
+# connection gets its own ThreadingTCPServer handler thread running them
+NAMED_ROOTS = {"handle_stream"}
+
+
+# ---------------------------------------------------------------------------
+# per-module index (extracted from trace_safety._ModuleIndex)
+# ---------------------------------------------------------------------------
+
+class ModuleIndex:
+    """Function table + import aliases for one parsed module."""
+
+    def __init__(self, module, numpy_modules: Sequence[str] = ("numpy",)):
+        self.module = module
+        self.top, self.every = collect_functions(module.tree, module.path)
+        self.aliases = import_aliases(module.tree)
+        # local names referring to numpy the module
+        self.np_names = {local for local, target in self.aliases.items()
+                         if target.split(".")[0] in numpy_modules}
+        self.from_imports = {
+            local: target for local, target in self.aliases.items()
+            if "." in target}
+        self.by_qualname: Dict[str, FuncInfo] = {
+            fi.qualname: fi for fi in self.every}
+        # names assigned at module top level (module globals)
+        self.global_names: Set[str] = set()
+        for node in module.tree.body:
+            for name in _stored_names(node):
+                self.global_names.add(name)
+        self._locals: Dict[str, Set[str]] = {}
+
+    def locals_of(self, fi: FuncInfo) -> Set[str]:
+        """Names bound directly in ``fi``'s body (params + assignments,
+        minus ``global``/``nonlocal`` declarations), excluding nested
+        function bodies."""
+        got = self._locals.get(fi.qualname)
+        if got is not None:
+            return got
+        names: Set[str] = set(fi.params())
+        a = fi.node.args
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+        escaped: Set[str] = set()
+        for node in _walk_no_nested(fi.node):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                escaped.update(node.names)
+            else:
+                names.update(_stored_names(node))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fi.node:
+                names.add(node.name)
+            elif isinstance(node, ast.ClassDef):
+                names.add(node.name)
+        got = names - escaped
+        self._locals[fi.qualname] = got
+        return got
+
+
+def _walk_no_nested(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``fn``'s body without descending into nested function defs."""
+    yield fn
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            yield node
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _stored_names(node: ast.AST) -> Set[str]:
+    """Bare names a single statement binds (no attribute/subscript)."""
+    out: Set[str] = set()
+
+    def targets_of(n: ast.AST) -> Iterator[ast.AST]:
+        if isinstance(n, ast.Assign):
+            yield from n.targets
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr,
+                            ast.For)):
+            yield n.target
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    yield item.optional_vars
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            yield ast.Name(id=n.name, ctx=ast.Store())
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for a in n.names:
+                if a.name != "*":
+                    yield ast.Name(id=a.asname or a.name.split(".")[0],
+                                   ctx=ast.Store())
+
+    def flatten(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                flatten(e)
+        elif isinstance(t, ast.Starred):
+            flatten(t.value)
+
+    for t in targets_of(node):
+        flatten(t)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reachability helpers shared with obsrules (migrated from there)
+# ---------------------------------------------------------------------------
+
+def iter_func_defs(tree: ast.AST) -> Iterator[ast.AST]:
+    """Every FunctionDef/AsyncFunctionDef under ``tree`` (incl. nested)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def direct_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes within ``fn`` but not within a nested function def."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def pooled_callee_names(fn: ast.AST) -> Set[str]:
+    """Names of functions ``fn`` hands to the decode pool: arguments of
+    ``_iter_windowed`` / ``submit`` / ``pool_submit`` / ``.map`` calls."""
+    names: Set[str] = set()
+    for call in ast.walk(fn):
+        if not isinstance(call, ast.Call):
+            continue
+        f = call.func
+        fname = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if fname not in POOL_DISPATCHERS:
+            continue
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# generic interprocedural worklist (extracted from trace_safety.analyze)
+# ---------------------------------------------------------------------------
+
+class InterproceduralWorklist:
+    """(module path, qualname) → parameter-set propagation fixpoint.
+
+    A *checker* callback analyzes one function under its current param
+    set and returns the parameter sets it induces on its callees, keyed
+    by FuncKey — or by ``("import", "dotted.target")`` for cross-module
+    calls, whose parameter names may be positional markers (``"#0"``)
+    resolved here against the callee's real signature.  The worklist
+    re-enqueues any function whose set grew (monotone, so it
+    terminates)."""
+
+    def __init__(self, project: Project,
+                 indices: Dict[str, ModuleIndex]):
+        self.project = project
+        self.indices = indices
+        self.info_of: Dict[FuncKey, Tuple[ModuleIndex, FuncInfo]] = {}
+        for idx in indices.values():
+            for fi in idx.every:
+                self.info_of[(idx.module.path, fi.qualname)] = (idx, fi)
+        self.taint_of: Dict[FuncKey, Set[str]] = {}
+        self.work: List[FuncKey] = []
+
+    def add_taint(self, key: FuncKey, params: Set[str]) -> None:
+        if key not in self.info_of:
+            return
+        cur = self.taint_of.setdefault(key, set())
+        if not params <= cur:
+            cur.update(params)
+            if key not in self.work:
+                self.work.append(key)
+
+    def resolve_import_key(self, target: str) -> Optional[FuncKey]:
+        """'hadoop_bam_tpu.ops.unpack_bam.unpack_fixed_fields' ->
+        (module path, top-level qualname) when in scope."""
+        mod, _, name = target.rpartition(".")
+        m = self.project.by_dotted.get(mod)
+        if m is None or m.path not in self.indices:
+            return None
+        idx = self.indices[m.path]
+        if name in idx.top:
+            return (m.path, name)
+        return None
+
+    def run(self, check: Callable[[ModuleIndex, FuncInfo, Set[str]],
+                                  Dict[Tuple[str, str], Set[str]]],
+            max_rounds: int = 10000) -> None:
+        rounds = 0
+        while self.work and rounds < max_rounds:
+            rounds += 1
+            key = self.work.pop()
+            idx, fi = self.info_of[key]
+            callee_taints = check(idx, fi, self.taint_of.get(key, set()))
+            for callee_key, params in callee_taints.items():
+                if callee_key[0] == "import":
+                    resolved = self.resolve_import_key(callee_key[1])
+                    if resolved is None:
+                        continue
+                    # positional markers -> real parameter names
+                    _, cfi = self.info_of[resolved]
+                    cparams = cfi.params()
+                    real: Set[str] = set()
+                    for p in params:
+                        if p.startswith("#"):
+                            i = int(p[1:])
+                            if i < len(cparams):
+                                real.add(cparams[i])
+                        else:
+                            real.add(p)
+                    self.add_taint(resolved, real)
+                else:
+                    self.add_taint(callee_key, params)
+
+
+# ---------------------------------------------------------------------------
+# thread topology
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    """One function that runs on its own thread (or pool/handler thread).
+
+    ``name`` is the stable human identity used in findings (sorted and
+    deduped); ``key`` the entry function; ``kind`` how it was spawned."""
+    name: str
+    key: FuncKey
+    kind: str              # thread | pool | callback | handler | client
+    path: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    """One read/write of shared state with the locks held at the site."""
+    kind: str              # "read" | "write"
+    target: AccessId
+    func: FuncKey
+    path: str
+    line: int
+    guards: FrozenSet[AccessId]
+
+
+@dataclasses.dataclass(frozen=True)
+class Acquisition:
+    """One ``with <lock>:`` entry with the locks already held outside."""
+    lock: AccessId
+    func: FuncKey
+    path: str
+    line: int
+    held: FrozenSet[AccessId]
+
+
+class CallGraphEngine:
+    """Call resolution, thread roots, reachability and guard inference
+    over the modules selected by ``scope``."""
+
+    def __init__(self, project: Project, scope: Sequence[str]):
+        self.project = project
+        self.indices: Dict[str, ModuleIndex] = {
+            m.path: ModuleIndex(m) for m in project.select(scope)}
+        self.info_of: Dict[FuncKey, Tuple[ModuleIndex, FuncInfo]] = {}
+        for idx in self.indices.values():
+            for fi in idx.every:
+                self.info_of[(idx.module.path, fi.qualname)] = (idx, fi)
+        self._callees: Dict[FuncKey, List[FuncKey]] = {}
+        self._lock_ids: Optional[Set[AccessId]] = None
+        self._safe_ids: Optional[Set[AccessId]] = None
+        self._accesses: Dict[FuncKey, List[Access]] = {}
+        self._acquisitions: Dict[FuncKey, List[Acquisition]] = {}
+        self._entry_guards: Optional[Dict[FuncKey, FrozenSet[AccessId]]] \
+            = None
+        self._roots: Optional[List[ThreadRoot]] = None
+
+    # -- identity resolution ------------------------------------------------
+
+    def class_prefix(self, fi: FuncInfo) -> Optional[str]:
+        """'Fleet' for qualname 'Fleet.start' when it looks like a
+        method (first parameter named self); None otherwise."""
+        if "." not in fi.qualname:
+            return None
+        params = fi.params()
+        if not params or params[0] != "self":
+            return None
+        return fi.qualname.rpartition(".")[0]
+
+    def resolve_value_id(self, idx: ModuleIndex, fi: FuncInfo,
+                         node: ast.AST) -> Optional[AccessId]:
+        """The shared-state identity a Name/Attribute refers to, or None
+        when it is unresolvable / not shared-shaped."""
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                cls = self.class_prefix(fi)
+                if cls is not None:
+                    return ("attr", cls, node.attr)
+            return None
+        if isinstance(node, ast.Name):
+            name = node.id
+            scope: Optional[FuncInfo] = fi
+            while scope is not None:
+                if name in idx.locals_of(scope):
+                    if scope is fi:
+                        return ("local", idx.module.path, scope.qualname,
+                                name)
+                    return ("closure", idx.module.path, scope.qualname,
+                            name)
+                scope = scope.parent
+            if name in idx.global_names:
+                return ("global", idx.module.path, name)
+        return None
+
+    def resolve_func_ref(self, idx: ModuleIndex, ctx: Optional[FuncInfo],
+                         node: ast.AST) -> Optional[FuncKey]:
+        """Resolve a *reference* to a project function: a bare name
+        (lexically), ``self._method``, or a ``from``-imported name."""
+        if isinstance(node, ast.Name):
+            fi = resolve_name(node.id, ctx, idx.top)
+            if fi is not None:
+                return (idx.module.path, fi.qualname)
+            target = idx.from_imports.get(node.id)
+            if target:
+                return self._resolve_import(target)
+            return None
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and ctx is not None:
+                cls = self.class_prefix(ctx)
+                if cls is not None:
+                    qn = f"{cls}.{node.attr}"
+                    if (idx.module.path, qn) in self.info_of:
+                        return (idx.module.path, qn)
+            target = dotted_name(node)
+            if target:
+                head = target.split(".")[0]
+                alias = idx.aliases.get(head)
+                if alias:
+                    full = alias + target[len(head):]
+                    return self._resolve_import(full)
+        return None
+
+    def _resolve_import(self, target: str) -> Optional[FuncKey]:
+        mod, _, name = target.rpartition(".")
+        m = self.project.by_dotted.get(mod)
+        if m is None or m.path not in self.indices:
+            return None
+        idx = self.indices[m.path]
+        if name in idx.top:
+            return (m.path, name)
+        return None
+
+    def resolve_call(self, idx: ModuleIndex, ctx: Optional[FuncInfo],
+                     call: ast.Call) -> Optional[FuncKey]:
+        return self.resolve_func_ref(idx, ctx, call.func)
+
+    # -- call graph ---------------------------------------------------------
+
+    def callees_of(self, key: FuncKey) -> List[FuncKey]:
+        got = self._callees.get(key)
+        if got is not None:
+            return got
+        idx, fi = self.info_of[key]
+        out: List[FuncKey] = []
+        for node in _walk_no_nested(fi.node):
+            if isinstance(node, ast.Call):
+                callee = self.resolve_call(idx, fi, node)
+                if callee is not None and callee != key:
+                    out.append(callee)
+        self._callees[key] = out
+        return out
+
+    def reachable(self, entries: Sequence[FuncKey]) -> Set[FuncKey]:
+        seen: Set[FuncKey] = set()
+        stack = [k for k in entries if k in self.info_of]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            stack.extend(self.callees_of(key))
+        return seen
+
+    # -- thread roots -------------------------------------------------------
+
+    def _thread_target(self, idx: ModuleIndex, ctx: Optional[FuncInfo],
+                       call: ast.Call) -> Optional[FuncKey]:
+        """The function a ``threading.Thread(...)`` will run, looking
+        through the repo's contextvar-carrying indirections:
+        ``Thread(target=ctx.run, args=(f, ...))`` and
+        ``Thread(target=lambda: ctx.run(f))``."""
+        target = None
+        args_kw = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "args":
+                args_kw = kw.value
+        if target is None and call.args:
+            target = call.args[0]
+        if target is None:
+            return None
+        if isinstance(target, ast.Lambda):
+            body = target.body
+            if isinstance(body, ast.Call):
+                # lambda: ctx.run(f)  ->  f ;  lambda: f()  ->  f
+                if last_segment(body.func) == "run" and body.args:
+                    return self.resolve_func_ref(idx, ctx, body.args[0])
+                return self.resolve_func_ref(idx, ctx, body.func)
+            return None
+        if last_segment(target) == "run" and args_kw is not None \
+                and isinstance(args_kw, (ast.Tuple, ast.List)) \
+                and args_kw.elts:
+            # Thread(target=ctx.run, args=(f, ...))
+            return self.resolve_func_ref(idx, ctx, args_kw.elts[0])
+        return self.resolve_func_ref(idx, ctx, target)
+
+    def thread_roots(self) -> List[ThreadRoot]:
+        """Every discovered thread entry point, deduped by entry
+        function (two spawn sites of the same loop are one root)."""
+        if self._roots is not None:
+            return self._roots
+        found: Dict[FuncKey, ThreadRoot] = {}
+
+        def note(key: Optional[FuncKey], kind: str, idx: ModuleIndex,
+                 node: ast.AST) -> None:
+            if key is None or key in found or key not in self.info_of:
+                return
+            short = key[0].split("/", 1)[-1]
+            found[key] = ThreadRoot(
+                name=f"{short}:{key[1]}", key=key, kind=kind,
+                path=idx.module.path,
+                line=getattr(node, "lineno", 1))
+
+        for idx in self.indices.values():
+            for node in ast.walk(idx.module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                seg = last_segment(node.func)
+                ctx = enclosing_function(idx.every, node)
+                if seg == "Thread":
+                    note(self._thread_target(idx, ctx, node), "thread",
+                         idx, node)
+                elif seg == "Timer" and len(node.args) >= 2:
+                    note(self.resolve_func_ref(idx, ctx, node.args[1]),
+                         "thread", idx, node)
+                elif seg == "submit" and isinstance(node.func,
+                                                    ast.Attribute):
+                    # executor.submit(f, ...) / pool.submit(ctx.run,
+                    # _timed_task, f, ...): any argument that resolves
+                    # to a project function may run on a pool thread
+                    for arg in node.args:
+                        key = self.resolve_func_ref(idx, ctx, arg)
+                        note(key, "pool", idx, node)
+                elif seg in ("submit", "pool_submit", "_iter_windowed") \
+                        and isinstance(node.func, ast.Name):
+                    for arg in node.args:
+                        key = self.resolve_func_ref(idx, ctx, arg)
+                        note(key, "pool", idx, node)
+                elif seg == "map" and isinstance(node.func, ast.Attribute) \
+                        and node.args:
+                    note(self.resolve_func_ref(idx, ctx, node.args[0]),
+                         "pool", idx, node)
+                elif seg == "add_done_callback" and node.args:
+                    note(self.resolve_func_ref(idx, ctx, node.args[0]),
+                         "callback", idx, node)
+            for fi in idx.every:
+                if fi.name in NAMED_ROOTS:
+                    note((idx.module.path, fi.qualname), "handler", idx,
+                         fi.node)
+        self._roots = sorted(found.values(), key=lambda r: r.name)
+        return self._roots
+
+    def client_entries(self) -> List[FuncKey]:
+        """The public surface: top-level functions and methods a caller
+        thread invokes directly.  They form ONE implicit 'client' root —
+        a single API-driving thread — so two public methods writing the
+        same attribute is not, by itself, a cross-thread conflict."""
+        root_keys = {r.key for r in self.thread_roots()}
+        out: List[FuncKey] = []
+        for idx in self.indices.values():
+            for fi in idx.every:
+                key = (idx.module.path, fi.qualname)
+                if key in root_keys:
+                    continue
+                if fi.parent is not None:      # nested: not an API surface
+                    continue
+                name = fi.name
+                if name.startswith("__") and name.endswith("__"):
+                    if name in ("__init__", "__new__", "__del__"):
+                        continue
+                elif name.startswith("_"):
+                    continue
+                out.append(key)
+        return out
+
+    # -- locks, safety, accesses --------------------------------------------
+
+    def _scan_constructed(self) -> Tuple[Set[AccessId], Set[AccessId]]:
+        lock_ids: Set[AccessId] = set()
+        safe_ids: Set[AccessId] = set()
+
+        def classify(seg: Optional[str], tid: Optional[AccessId]) -> None:
+            if tid is None:
+                return
+            if seg in _LOCK_CONSTRUCTORS:
+                lock_ids.add(tid)
+            if seg in _THREADSAFE_CONSTRUCTORS:
+                safe_ids.add(tid)
+
+        for idx in self.indices.values():
+            # module-level constructions: _LOCK = threading.Lock()
+            for node in idx.module.tree.body:
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                seg = last_segment(node.value.func)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        classify(seg, ("global", idx.module.path, t.id))
+            for fi in idx.every:
+                for node in _walk_no_nested(fi.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if not isinstance(node.value, ast.Call):
+                        continue
+                    seg = last_segment(node.value.func)
+                    for t in node.targets:
+                        classify(seg, self.resolve_value_id(idx, fi, t))
+        return lock_ids, safe_ids
+
+    @property
+    def lock_ids(self) -> Set[AccessId]:
+        if self._lock_ids is None:
+            self._lock_ids, self._safe_ids = self._scan_constructed()
+        return self._lock_ids
+
+    @property
+    def safe_ids(self) -> Set[AccessId]:
+        if self._safe_ids is None:
+            self._lock_ids, self._safe_ids = self._scan_constructed()
+        return self._safe_ids
+
+    def _base_id(self, idx: ModuleIndex, fi: FuncInfo,
+                 node: ast.AST) -> Optional[AccessId]:
+        """Identity of the object a store/mutation ultimately lands in:
+        peel subscripts and trailing attributes down to ``self.X`` or a
+        bare name (``self._peers[pid].last = t`` mutates ``self._peers``).
+        """
+        while True:
+            if isinstance(node, ast.Subscript):
+                node = node.value
+                continue
+            if isinstance(node, ast.Attribute):
+                got = self.resolve_value_id(idx, fi, node)
+                if got is not None:
+                    return got
+                node = node.value
+                continue
+            break
+        if isinstance(node, ast.Name):
+            return self.resolve_value_id(idx, fi, node)
+        return None
+
+    def _collect_accesses(self, key: FuncKey) -> Tuple[List[Access],
+                                                       List[Acquisition]]:
+        idx, fi = self.info_of[key]
+        path = idx.module.path
+        accesses: List[Access] = []
+        acqs: List[Acquisition] = []
+        in_init = fi.name == "__init__"
+
+        def note_write(node: ast.AST, target: ast.AST,
+                       guards: FrozenSet[AccessId]) -> None:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for e in target.elts:
+                    note_write(node, e, guards)
+                return
+            if isinstance(target, ast.Starred):
+                note_write(node, target.value, guards)
+                return
+            if isinstance(target, ast.Name):
+                # bare-name store: a write only when it escapes the
+                # function (module global via `global`, or nonlocal)
+                tid = self.resolve_value_id(idx, fi, target)
+            else:
+                tid = self._base_id(idx, fi, target)
+            if tid is None or tid[0] == "local":
+                return
+            if in_init and tid[0] == "attr":
+                return     # pre-publication: object not yet shared
+            accesses.append(Access(
+                "write", tid, key, path, getattr(node, "lineno", 1),
+                guards))
+
+        def note_read(node: ast.AST,
+                      guards: FrozenSet[AccessId]) -> None:
+            tid = self.resolve_value_id(idx, fi, node)
+            if tid is None or tid[0] == "local":
+                return
+            accesses.append(Access(
+                "read", tid, key, path, getattr(node, "lineno", 1),
+                guards))
+
+        def visit(node: ast.AST, guards: FrozenSet[AccessId]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fi.node:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_guards = guards
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, (ast.Name, ast.Attribute)):
+                        lid = self.resolve_value_id(idx, fi, expr)
+                        if lid is not None and lid in self.lock_ids:
+                            acqs.append(Acquisition(
+                                lid, key, path, node.lineno, new_guards))
+                            new_guards = new_guards | {lid}
+                for child in node.body:
+                    visit(child, new_guards)
+                for item in node.items:
+                    visit(item.context_expr, guards)
+                return
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    note_write(node, t, guards)
+                visit(node.value, guards)
+                return
+            if isinstance(node, ast.AugAssign):
+                note_write(node, node.target, guards)
+                visit(node.value, guards)
+                return
+            if isinstance(node, ast.AnnAssign):
+                if node.value is not None:
+                    note_write(node, node.target, guards)
+                    visit(node.value, guards)
+                return
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    note_write(node, t, guards)
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                seg = last_segment(f)
+                if isinstance(f, ast.Attribute) \
+                        and seg in _MUTATOR_METHODS:
+                    note_write(node, f.value, guards)
+                elif seg in _MUTATOR_FUNCS and node.args:
+                    note_write(node, node.args[0], guards)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, guards)
+                return
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                note_read(node, guards)
+                # keep walking: chained attributes read their base too
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                note_read(node, guards)
+            for child in ast.iter_child_nodes(node):
+                visit(child, guards)
+
+        for stmt in fi.node.body:
+            visit(stmt, frozenset())
+        return accesses, acqs
+
+    def accesses_of(self, key: FuncKey) -> List[Access]:
+        if key not in self._accesses:
+            self._accesses[key], self._acquisitions[key] = \
+                self._collect_accesses(key)
+        return self._accesses[key]
+
+    def acquisitions_of(self, key: FuncKey) -> List[Acquisition]:
+        if key not in self._acquisitions:
+            self._accesses[key], self._acquisitions[key] = \
+                self._collect_accesses(key)
+        return self._acquisitions[key]
+
+    # -- interprocedural guard inference ------------------------------------
+
+    def entry_guards(self) -> Dict[FuncKey, FrozenSet[AccessId]]:
+        """Locks provably held at EVERY call of each function: the
+        intersection over all resolvable call sites of (caller's entry
+        guards ∪ locks lexically held at the site).  Roots and client
+        entries start at ∅; unreached functions stay at ⊤ (None here),
+        reported as ∅ by the getter so they never launder a guard."""
+        if self._entry_guards is not None:
+            return self._entry_guards
+        TOP = None
+        entry: Dict[FuncKey, Optional[FrozenSet[AccessId]]] = {
+            k: TOP for k in self.info_of}
+        work: List[FuncKey] = []
+
+        def lower(key: FuncKey, guards: FrozenSet[AccessId]) -> None:
+            cur = entry.get(key)
+            if cur is None:
+                entry[key] = guards
+            else:
+                new = cur & guards
+                if new == cur:
+                    return
+                entry[key] = new
+            if key not in work:
+                work.append(key)
+
+        for r in self.thread_roots():
+            lower(r.key, frozenset())
+        for key in self.client_entries():
+            lower(key, frozenset())
+
+        rounds = 0
+        while work and rounds < 100000:
+            rounds += 1
+            key = work.pop()
+            base = entry[key] or frozenset()
+            idx, fi = self.info_of[key]
+            for node in _walk_no_nested(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self.resolve_call(idx, fi, node)
+                if callee is None or callee == key:
+                    continue
+                site = base | self._lexical_guards_at(key, node)
+                lower(callee, site)
+        self._entry_guards = {
+            k: (v if v is not None else frozenset())
+            for k, v in entry.items()}
+        return self._entry_guards
+
+    def _lexical_guards_at(self, key: FuncKey,
+                           node: ast.AST) -> FrozenSet[AccessId]:
+        """Locks lexically held at ``node`` inside function ``key``."""
+        idx, fi = self.info_of[key]
+        target_line = getattr(node, "lineno", None)
+        if target_line is None:
+            return frozenset()
+        held: Set[AccessId] = set()
+        for stmt in ast.walk(fi.node):
+            if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+                continue
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            if not (stmt.lineno <= target_line <= end):
+                continue
+            for item in stmt.items:
+                expr = item.context_expr
+                if isinstance(expr, (ast.Name, ast.Attribute)):
+                    lid = self.resolve_value_id(idx, fi, expr)
+                    if lid is not None and lid in self.lock_ids:
+                        held.add(lid)
+        return frozenset(held)
+
+    def closure_escapes_to_thread(self, tid: AccessId) -> bool:
+        """A closure cell is per-invocation of its owning function, so
+        it is cross-thread state only when some thread root's entry
+        function is lexically nested inside the owner — the spawn is
+        what hands the cell to another thread.  (Two roots that each
+        *call* the owner get two distinct cells.)  Non-closure ids are
+        always shareable."""
+        if tid[0] != "closure":
+            return True
+        _, path, owner, _name = tid
+        prefix = owner + "."
+        return any(r.key[0] == path and r.key[1].startswith(prefix)
+                   for r in self.thread_roots())
+
+    def effective_guards(self, access: Access) -> FrozenSet[AccessId]:
+        """Lexical guards at the access ∪ guards held at function entry."""
+        return access.guards | self.entry_guards().get(access.func,
+                                                       frozenset())
+
+    # -- per-root access summaries ------------------------------------------
+
+    def root_accesses(self) -> Dict[str, List[Access]]:
+        """Root name -> accesses of every function reachable from it,
+        including the synthetic 'client' root for the public surface."""
+        out: Dict[str, List[Access]] = {}
+        for r in self.thread_roots():
+            acc: List[Access] = []
+            for key in sorted(self.reachable([r.key])):
+                acc.extend(self.accesses_of(key))
+            out[r.name] = acc
+        client: List[Access] = []
+        for key in sorted(self.reachable(self.client_entries())):
+            client.extend(self.accesses_of(key))
+        out["client"] = client
+        return out
+
+    # -- lock-order graph ---------------------------------------------------
+
+    def lock_order_edges(self) -> Dict[Tuple[AccessId, AccessId],
+                                       Tuple[str, int]]:
+        """(held lock, acquired lock) -> one representative (path, line).
+        Edges combine lexical nesting with interprocedural entry guards:
+        acquiring B while A is held anywhere orders A before B."""
+        entry = self.entry_guards()
+        reach: Set[FuncKey] = set()
+        for r in self.thread_roots():
+            reach |= self.reachable([r.key])
+        reach |= self.reachable(self.client_entries())
+        edges: Dict[Tuple[AccessId, AccessId], Tuple[str, int]] = {}
+        for key in sorted(reach):
+            base = entry.get(key, frozenset())
+            for acq in self.acquisitions_of(key):
+                held = base | acq.held
+                for h in held:
+                    if h == acq.lock:
+                        continue
+                    edges.setdefault((h, acq.lock), (acq.path, acq.line))
+        return edges
+
+
+def find_lock_cycles(edges: Dict[Tuple[AccessId, AccessId],
+                                 Tuple[str, int]]
+                     ) -> List[List[AccessId]]:
+    """Elementary cycles in the lock-order digraph (each reported once,
+    rotated to start at its smallest lock, sorted for determinism)."""
+    graph: Dict[AccessId, Set[AccessId]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: Dict[Tuple[AccessId, ...], List[AccessId]] = {}
+
+    def dfs(start: AccessId, node: AccessId,
+            path: List[AccessId], on_path: Set[AccessId]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = list(path)
+                i = cyc.index(min(cyc))
+                rot = tuple(cyc[i:] + cyc[:i])
+                cycles.setdefault(rot, list(rot))
+            elif nxt not in on_path and nxt > start:
+                # only explore nodes > start: each cycle found exactly
+                # once, from its smallest node
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return [cycles[k] for k in sorted(cycles)]
+
+
+def format_access_id(aid: AccessId) -> str:
+    """Human-stable rendering used in findings: 'Fleet.self._lock',
+    'utils/pools.py::_BG_QUEUE', 'staging.py::stream.errs'."""
+    kind = aid[0]
+    if kind == "attr":
+        return f"{aid[1]}.self.{aid[2]}"
+    if kind == "global":
+        return f"{aid[1]}::{aid[2]}"
+    if kind in ("closure", "local"):
+        return f"{aid[1]}::{aid[2]}.{aid[3]}"
+    return repr(aid)

@@ -160,7 +160,7 @@ def analyzers() -> Dict[str, Analyzer]:
     from hadoop_bam_tpu.analysis import (  # noqa: F401
         decodepath, devicesync, feedpath, jobsafety, layout, lockstep,
         obsrules, planroute, querycache, servebounds, taxonomy,
-        trace_safety, writepath,
+        threadsafety, trace_safety, writepath,
     )
     return dict(_REGISTRY)
 
@@ -261,7 +261,8 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                     "decode-path copy discipline (DP7xx), serving-tier "
                     "cache bounds (SV8xx), write-path atomicity/"
                     "parallelism (WR10x), plane-routing discipline "
-                    "(PL101)")
+                    "(PL101), thread-topology races and lock ordering "
+                    "(TH1xx/LK2xx)")
     p.add_argument("--root", default=None,
                    help="package directory to analyze (default: the "
                         "installed hadoop_bam_tpu package)")
@@ -269,7 +270,8 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                    metavar="ANALYZER",
                    help="run one analyzer (trace_safety, lockstep, "
                         "taxonomy, layout, feedpath, querycache, obs, "
-                        "decodepath, servebounds, writepath); repeatable")
+                        "decodepath, servebounds, writepath, "
+                        "threadsafety); repeatable")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline file (default: analysis/baseline.json)")
     p.add_argument("--no-baseline", action="store_true",
@@ -279,6 +281,14 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
                         "file and exit 0")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print baseline-suppressed findings")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text", dest="fmt",
+                   help="output format: human text (default, "
+                        "byte-stable), a JSON findings document, or "
+                        "SARIF 2.1.0 for CI annotation")
+    p.add_argument("--no-cache", action="store_true",
+                   help="always re-parse and re-analyze, ignoring the "
+                        "findings cache (.hbam-lint-cache.json)")
     args = p.parse_args(argv)
 
     known = sorted(analyzers())
@@ -287,10 +297,28 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
             # fail CLOSED: a typo'd --only must not run zero analyzers
             # and report a green lint
             p.error(f"unknown analyzer {name!r}; choose from {known}")
-    project = Project.load(root=args.root)
-    if not project.modules:
-        p.error(f"no Python modules found under --root {args.root!r}")
-    findings = run_analyzers(project, only=args.only)
+
+    # findings cache: sound only as a whole-run short-circuit (several
+    # analyzers are interprocedural), so a stat-digest of the entire
+    # tree + the analyzer sources gates replay; any drift -> full run
+    from hadoop_bam_tpu.analysis import lintcache
+    findings: Optional[List[Finding]] = None
+    n_mod = 0
+    digest = None if args.no_cache \
+        else lintcache.compute_digest(args.root, only=args.only)
+    if digest is not None:
+        cached = lintcache.load(lintcache.default_cache_path(), digest)
+        if cached is not None:
+            findings, n_mod = cached
+    if findings is None:
+        project = Project.load(root=args.root)
+        if not project.modules:
+            p.error(f"no Python modules found under --root {args.root!r}")
+        n_mod = len(project.modules)
+        findings = run_analyzers(project, only=args.only)
+        if digest is not None:
+            lintcache.store(lintcache.default_cache_path(), digest,
+                            findings, n_mod)
 
     if args.update_baseline:
         Baseline.from_findings(findings).save(args.baseline)
@@ -302,6 +330,20 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         unsup, sup, stale = Baseline.load(args.baseline).apply(findings)
 
+    if args.fmt == "json":
+        doc = {"tool": "hbam-lint", "version": 1,
+               "findings": [f.to_dict() for f in unsup],
+               "suppressed": [f.to_dict() for f in sup]
+               if args.show_suppressed else [],
+               "summary": {"modules": n_mod, "findings": len(findings),
+                           "suppressed": len(sup),
+                           "unsuppressed": len(unsup)}}
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 1 if unsup else 0
+    if args.fmt == "sarif":
+        print(json.dumps(_sarif_doc(unsup), indent=2, sort_keys=True))
+        return 1 if unsup else 0
+
     for f in unsup:
         print(f.render())
     if args.show_suppressed:
@@ -311,7 +353,31 @@ def lint_main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"note: stale baseline entry {e.get('fingerprint')} "
               f"({e.get('rule')} {e.get('path')}) — no longer reported; "
               f"run --update-baseline to burn it down")
-    n_mod = len(project.modules)
     print(f"hbam-lint: {n_mod} modules, {len(findings)} finding(s), "
           f"{len(sup)} suppressed, {len(unsup)} unsuppressed")
     return 1 if unsup else 0
+
+
+def _sarif_doc(findings: Sequence[Finding]) -> Dict[str, object]:
+    """Minimal SARIF 2.1.0 document for CI annotation surfaces."""
+    rules = sorted({f.rule for f in findings})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "hbam-lint",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error" if f.severity == "error" else "warning",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line},
+                }}],
+                "partialFingerprints": {"hbamLint/v1": f.fingerprint},
+            } for f in findings],
+        }],
+    }

@@ -1,0 +1,151 @@
+"""Findings cache for ``hbam lint`` — skip re-parsing an unchanged tree.
+
+The tier-1 gate runs the full analyzer suite on every test run; with 14
+analyzers (several interprocedural) over ~150 modules that is pure
+recomputation whenever nothing changed.  Because several analyzers are
+interprocedural, per-file finding reuse would be UNSOUND — editing one
+module can create or kill findings in another — so the cache is
+all-or-nothing: a digest over every source file's ``(path, mtime_ns,
+size)`` plus the analyzer sources themselves.  Digest match ⇒ replay
+the stored findings without parsing anything; any drift ⇒ full re-run.
+
+The cache file lives next to the current working directory by default
+(``.hbam-lint-cache.json``, git-ignored — the same convention as
+``.pytest_cache``) and is keyed by (root, analyzer selection), keeping
+a small LRU of entries so ``--only`` runs don't evict the full-suite
+entry.  Failures to read or write the cache are silently ignored:
+caching must never change lint results or exit codes, only wall time.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hadoop_bam_tpu.analysis.core import Finding
+
+CACHE_VERSION = 1
+_MAX_ENTRIES = 8
+
+
+def default_cache_path() -> str:
+    return os.environ.get("HBAM_LINT_CACHE") \
+        or os.path.join(os.getcwd(), ".hbam-lint-cache.json")
+
+
+def _resolve_root(root: Optional[str], package: str) -> Optional[str]:
+    """Mirror Project.load's root resolution exactly — the cache digest
+    must cover the same tree the analyzers would parse."""
+    if root is None:
+        try:
+            import hadoop_bam_tpu
+        except ImportError:                      # pragma: no cover
+            return None
+        root = os.path.dirname(os.path.abspath(hadoop_bam_tpu.__file__))
+    root = os.path.abspath(root)
+    if os.path.basename(root) != package \
+            and os.path.isdir(os.path.join(root, package)):
+        root = os.path.join(root, package)
+    return root
+
+
+def _stat_lines(root: str) -> Optional[List[str]]:
+    lines: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__" and not d.startswith(".")]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            try:
+                st = os.stat(full)
+            except OSError:
+                return None
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            lines.append(f"{rel}\x00{st.st_mtime_ns}\x00{st.st_size}")
+    return lines
+
+
+def compute_digest(root: Optional[str],
+                   only: Optional[Sequence[str]] = None,
+                   package: str = "hadoop_bam_tpu") -> Optional[str]:
+    """Stat-level fingerprint of (analyzed tree, analyzer sources,
+    analyzer selection); None when anything cannot be statted."""
+    tree_root = _resolve_root(root, package)
+    if tree_root is None or not os.path.isdir(tree_root):
+        return None
+    h = hashlib.sha256()
+    h.update(f"v{CACHE_VERSION}\x00{sorted(only or ())!r}\x00".encode())
+    tree_lines = _stat_lines(tree_root)
+    if tree_lines is None:
+        return None
+    for line in tree_lines:
+        h.update(line.encode())
+        h.update(b"\n")
+    # analyzer sources: when --root points away from the installed
+    # package, the analyzers executing here are NOT part of the walked
+    # tree — fingerprint them separately so editing a rule invalidates
+    h.update(b"--analyzers--\n")
+    analysis_dir = os.path.dirname(os.path.abspath(__file__))
+    analysis_lines = _stat_lines(analysis_dir)
+    if analysis_lines is None:
+        return None
+    for line in analysis_lines:
+        h.update(line.encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def load(path: str, digest: str
+         ) -> Optional[Tuple[List[Finding], int]]:
+    """(findings, module count) stored under ``digest``, or None."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("version") != CACHE_VERSION:
+            return None
+        entry = doc.get("entries", {}).get(digest)
+        if entry is None:
+            return None
+        findings = [Finding(rule=str(e["rule"]),
+                            severity=str(e["severity"]),
+                            path=str(e["path"]), line=int(e["line"]),
+                            message=str(e["message"]))
+                    for e in entry["findings"]]
+        return findings, int(entry["n_modules"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def store(path: str, digest: str, findings: Sequence[Finding],
+          n_modules: int) -> None:
+    doc: Dict[str, object] = {"version": CACHE_VERSION, "entries": {}}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            got = json.load(f)
+        if got.get("version") == CACHE_VERSION \
+                and isinstance(got.get("entries"), dict):
+            doc = got
+    except (OSError, ValueError):
+        pass
+    entries = doc["entries"]
+    assert isinstance(entries, dict)
+    entries.pop(digest, None)
+    entries[digest] = {
+        "n_modules": int(n_modules),
+        "findings": [{"rule": f.rule, "severity": f.severity,
+                      "path": f.path, "line": f.line,
+                      "message": f.message} for f in findings],
+    }
+    while len(entries) > _MAX_ENTRIES:
+        # dict order is insertion order: evict the oldest entry
+        entries.pop(next(iter(entries)))
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass

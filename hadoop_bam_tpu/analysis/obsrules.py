@@ -39,8 +39,13 @@ Scope: ``parallel/``, ``query/``, ``ops/`` (the pipeline hot paths).
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Optional, Set
+from typing import Iterator, List
 
+from hadoop_bam_tpu.analysis.callgraph import (
+    direct_calls as _direct_children_calls,
+    iter_func_defs as _func_defs,
+    pooled_callee_names as _pooled_callee_names,
+)
 from hadoop_bam_tpu.analysis.core import Finding, Project, register
 
 SCOPE = ("hadoop_bam_tpu/parallel", "hadoop_bam_tpu/query",
@@ -62,25 +67,6 @@ _CLOCK_CALLS = {"perf_counter", "time"}
 _METRICS_FEEDERS = {"metrics", "observe", "add_wall", "timer",
                     "wall_timer", "span", "current_metrics", "_account",
                     "hist_summary"}
-_POOL_DISPATCHERS = {"_iter_windowed", "submit", "pool_submit", "map"}
-
-
-def _func_defs(tree: ast.AST) -> Iterator[ast.AST]:
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
-
-
-def _direct_children_calls(fn: ast.AST) -> Iterator[ast.Call]:
-    """Call nodes within ``fn`` but not within a nested function def."""
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if isinstance(node, ast.Call):
-            yield node
-        stack.extend(ast.iter_child_nodes(node))
 
 
 def _is_clock_call(call: ast.Call) -> bool:
@@ -128,25 +114,6 @@ def _uses_wall_primitive(fn: ast.AST) -> bool:
                                                              "span"):
             return True
     return False
-
-
-def _pooled_callee_names(fn: ast.AST) -> Set[str]:
-    """Names of nested functions this function hands to the decode
-    pool: arguments of _iter_windowed / submit / pool_submit / .map /
-    .submit calls."""
-    names: Set[str] = set()
-    for call in ast.walk(fn):
-        if not isinstance(call, ast.Call):
-            continue
-        f = call.func
-        fname = f.id if isinstance(f, ast.Name) else (
-            f.attr if isinstance(f, ast.Attribute) else "")
-        if fname not in _POOL_DISPATCHERS:
-            continue
-        for arg in call.args:
-            if isinstance(arg, ast.Name):
-                names.add(arg.id)
-    return names
 
 
 def _references_trace(fn: ast.AST) -> bool:

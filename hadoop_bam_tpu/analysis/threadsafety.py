@@ -1,0 +1,183 @@
+"""TH1xx/LK2xx — thread-topology races and lock discipline.
+
+The runtime is deeply multithreaded: the serve dispatcher, the staging
+packer, the parallel-BGZF committer, the fleet heartbeat, per-connection
+TCP handler threads and the shared decode pool all touch shared state.
+TSan polices the native layer at runtime; these rules police the Python
+layer statically, on the interprocedural engine in ``callgraph.py``:
+thread roots are discovered from the spawn sites themselves, each
+root's reachable read/write set over ``self`` attributes, module
+globals and closure cells is computed, and ``with <lock>:`` guards are
+tracked across calls (a helper only ever invoked under a lock counts as
+guarded — the intersection-over-call-sites entry-guard fixpoint).
+
+Rules (scope: ``serve/``, ``parallel/``, ``write/``, ``jobs/``,
+``resilience/``, ``utils/pools.py``):
+
+- TH101 unguarded cross-thread write: shared state written from ≥2
+  thread roots (the public API surface counts as one implicit 'client'
+  root) where at least one write site holds no lock.  Objects that are
+  internally thread-safe (``queue.Queue``, ``threading.Event``, locks
+  themselves, ...) and ``__init__``-time writes (pre-publication) are
+  exempt.
+- TH102 check-then-act outside a guard: a membership/emptiness test on
+  shared multi-root state followed by a write to it inside the same
+  ``if`` body, with no lock held at the *check* — the classic TOCTOU
+  (guarding only the write does not make the decision atomic).
+- LK201 lock-order cycle: two locks acquired in opposite nesting
+  orders somewhere in the thread topology (lexically or via calls) —
+  a static deadlock candidate.  Fix by acquiring in one global order.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from hadoop_bam_tpu.analysis.callgraph import (
+    Access, AccessId, CallGraphEngine, find_lock_cycles, format_access_id,
+)
+from hadoop_bam_tpu.analysis.core import Finding, Project, register
+
+SCOPE = ("hadoop_bam_tpu/serve", "hadoop_bam_tpu/parallel",
+         "hadoop_bam_tpu/write", "hadoop_bam_tpu/jobs",
+         "hadoop_bam_tpu/resilience", "hadoop_bam_tpu/utils/pools.py")
+
+
+def _roots_phrase(names: List[str]) -> str:
+    return ", ".join(f"'{n}'" for n in sorted(names))
+
+
+def _th101(eng: CallGraphEngine,
+           root_acc: Dict[str, List[Access]]) -> List[Finding]:
+    writers: Dict[AccessId, Dict[str, List[Access]]] = {}
+    for rname, accs in root_acc.items():
+        for a in accs:
+            if a.kind != "write" or a.target in eng.safe_ids:
+                continue
+            if not eng.closure_escapes_to_thread(a.target):
+                continue
+            writers.setdefault(a.target, {}).setdefault(rname, []) \
+                .append(a)
+
+    findings: List[Finding] = []
+    for tid in sorted(writers):
+        by_root = writers[tid]
+        if len(by_root) < 2:
+            continue
+        root_names = sorted(by_root)
+        seen_sites: Set[Tuple[str, int]] = set()
+        for rname in root_names:
+            for a in by_root[rname]:
+                if eng.effective_guards(a):
+                    continue
+                site = (a.path, a.line)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                findings.append(Finding(
+                    rule="TH101", severity="error", path=a.path,
+                    line=a.line,
+                    message=f"unguarded write to {format_access_id(tid)}"
+                            f", which is written from multiple threads "
+                            f"({_roots_phrase(root_names)}) — hold one "
+                            "lock around every write (a helper called "
+                            "only under a lock counts as guarded)"))
+    return findings
+
+
+def _membership_container(test: ast.AST) -> List[ast.AST]:
+    """Expressions whose membership/emptiness the test inspects:
+    ``k in S`` / ``k not in S`` comparators, and ``not S``."""
+    out: List[ast.AST] = []
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    out.append(comp)
+        elif isinstance(node, ast.UnaryOp) \
+                and isinstance(node.op, ast.Not) \
+                and isinstance(node.operand, (ast.Name, ast.Attribute)):
+            out.append(node.operand)
+    return out
+
+
+def _th102(eng: CallGraphEngine,
+           root_acc: Dict[str, List[Access]]) -> List[Finding]:
+    accessors: Dict[AccessId, Set[str]] = {}
+    for rname, accs in root_acc.items():
+        for a in accs:
+            accessors.setdefault(a.target, set()).add(rname)
+
+    all_keys: Set = set()
+    for r in eng.thread_roots():
+        all_keys |= eng.reachable([r.key])
+    all_keys |= eng.reachable(eng.client_entries())
+
+    entry = eng.entry_guards()
+    findings: List[Finding] = []
+    for key in sorted(all_keys):
+        idx, fi = eng.info_of[key]
+        writes = [a for a in eng.accesses_of(key) if a.kind == "write"]
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.If):
+                continue
+            guards = entry.get(key, frozenset()) \
+                | eng._lexical_guards_at(key, node)
+            if guards:
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            for container in _membership_container(node.test):
+                tid = eng.resolve_value_id(idx, fi, container)
+                if tid is None or tid[0] == "local" \
+                        or tid in eng.safe_ids \
+                        or not eng.closure_escapes_to_thread(tid):
+                    continue
+                if len(accessors.get(tid, ())) < 2:
+                    continue
+                if any(a.target == tid
+                       and node.lineno < a.line <= end
+                       for a in writes):
+                    findings.append(Finding(
+                        rule="TH102", severity="error",
+                        path=idx.module.path, line=node.lineno,
+                        message="check-then-act on shared "
+                                f"{format_access_id(tid)} outside a "
+                                "guard: the test and the write inside "
+                                "this branch are not atomic across "
+                                "threads — hold the lock around both "
+                                "(guarding only the write leaves the "
+                                "decision racy)"))
+                    break
+    return findings
+
+
+def _lk201(eng: CallGraphEngine) -> List[Finding]:
+    edges = eng.lock_order_edges()
+    findings: List[Finding] = []
+    for cycle in find_lock_cycles(edges):
+        ring = cycle + cycle[:1]
+        order = " -> ".join(format_access_id(lid) for lid in ring)
+        path, line = edges[(cycle[0], cycle[1] if len(cycle) > 1
+                            else cycle[0])]
+        findings.append(Finding(
+            rule="LK201", severity="error", path=path, line=line,
+            message=f"lock-order cycle {order}: these locks are "
+                    "acquired in conflicting nesting orders across the "
+                    "thread topology — a static deadlock candidate; "
+                    "pick one global acquisition order"))
+    return findings
+
+
+@register("threadsafety")
+def analyze(project: Project) -> List[Finding]:
+    eng = CallGraphEngine(project, SCOPE)
+    if not eng.thread_roots():
+        # single-threaded scope: nothing is shared across threads, and
+        # LK201 cannot deadlock one thread using `with` (re-entry of a
+        # plain Lock hangs, but that is not an ORDER cycle)
+        return []
+    root_acc = eng.root_accesses()
+    findings = _th101(eng, root_acc)
+    findings.extend(_th102(eng, root_acc))
+    findings.extend(_lk201(eng))
+    return findings

@@ -32,9 +32,11 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from hadoop_bam_tpu.analysis.astutil import (
-    FuncInfo, collect_functions, const_str_tuple, dotted_name,
-    enclosing_function, import_aliases, last_segment, match_args_to_params,
-    resolve_name,
+    FuncInfo, const_str_tuple, dotted_name, enclosing_function,
+    last_segment, match_args_to_params, resolve_name,
+)
+from hadoop_bam_tpu.analysis.callgraph import (
+    InterproceduralWorklist, ModuleIndex as _ModuleIndex,
 )
 from hadoop_bam_tpu.analysis.core import Finding, Project, register
 
@@ -50,19 +52,6 @@ _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _CONCRETIZE = {"int", "float", "bool", "complex"}
 # numpy entry points that materialize device data on host
 _NUMPY_MODULES = {"numpy"}
-
-
-class _ModuleIndex:
-    def __init__(self, module):
-        self.module = module
-        self.top, self.every = collect_functions(module.tree, module.path)
-        self.aliases = import_aliases(module.tree)
-        # local names referring to numpy the module
-        self.np_names = {local for local, target in self.aliases.items()
-                         if target.split(".")[0] in _NUMPY_MODULES}
-        self.from_imports = {
-            local: target for local, target in self.aliases.items()
-            if "." in target}
 
 
 def _is_jit_callee(node: ast.AST) -> bool:
@@ -328,75 +317,31 @@ class _FunctionChecker:
 def analyze(project: Project) -> List[Finding]:
     indices: Dict[str, _ModuleIndex] = {}
     for m in project.select(SCOPE):
-        indices[m.path] = _ModuleIndex(m)
+        indices[m.path] = _ModuleIndex(m, numpy_modules=_NUMPY_MODULES)
 
-    # worklist over (module path, qualname) -> tracer-param set
-    taint_of: Dict[Tuple[str, str], Set[str]] = {}
-    info_of: Dict[Tuple[str, str], Tuple[_ModuleIndex, FuncInfo]] = {}
-    for idx in indices.values():
-        for fi in idx.every:
-            info_of[(idx.module.path, fi.qualname)] = (idx, fi)
-
-    work: List[Tuple[str, str]] = []
-
-    def add_taint(key: Tuple[str, str], params: Set[str]) -> None:
-        if key not in info_of:
-            return
-        cur = taint_of.setdefault(key, set())
-        if not params <= cur:
-            cur.update(params)
-            if key not in work:
-                work.append(key)
-
+    # worklist over (module path, qualname) -> tracer-param set; the
+    # generic engine owns enqueueing, import-key resolution and the
+    # positional-marker (#N) -> parameter-name mapping
+    wl = InterproceduralWorklist(project, indices)
     for idx in indices.values():
         for fi, params in _find_roots(idx):
-            add_taint((idx.module.path, fi.qualname), params)
-
-    def resolve_import_key(target: str) -> Optional[Tuple[str, str]]:
-        """'hadoop_bam_tpu.ops.unpack_bam.unpack_fixed_fields' ->
-        (module path, top-level qualname) when in scope."""
-        mod, _, name = target.rpartition(".")
-        m = project.by_dotted.get(mod)
-        if m is None or m.path not in indices:
-            return None
-        idx = indices[m.path]
-        if name in idx.top:
-            return (m.path, name)
-        return None
+            wl.add_taint((idx.module.path, fi.qualname), params)
 
     findings: List[Finding] = []
     # dedup WITHOUT the message: a closure statement seen both under its
     # parent's walk and its own enqueued pass reports once
     seen: Set[Tuple[str, int, str]] = set()
-    rounds = 0
-    while work and rounds < 10000:
-        rounds += 1
-        key = work.pop()
-        idx, fi = info_of[key]
-        checker = _FunctionChecker(idx, fi, taint_of.get(key, set()))
+
+    def check(idx: _ModuleIndex, fi: FuncInfo,
+              taints: Set[str]) -> Dict[Tuple[str, str], Set[str]]:
+        checker = _FunctionChecker(idx, fi, taints)
         checker.check()
         for f in checker.findings:
             k = (f.path, f.line, f.rule)
             if k not in seen:
                 seen.add(k)
                 findings.append(f)
-        for callee_key, params in checker.callee_taints.items():
-            if callee_key[0] == "import":
-                resolved = resolve_import_key(callee_key[1])
-                if resolved is None:
-                    continue
-                # positional markers -> real parameter names
-                _, cfi = info_of[resolved]
-                cparams = cfi.params()
-                real: Set[str] = set()
-                for p in params:
-                    if p.startswith("#"):
-                        i = int(p[1:])
-                        if i < len(cparams):
-                            real.add(cparams[i])
-                    else:
-                        real.add(p)
-                add_taint(resolved, real)
-            else:
-                add_taint(callee_key, params)
+        return checker.callee_taints
+
+    wl.run(check)
     return findings

@@ -774,6 +774,10 @@ def cmd_lint(args) -> int:
         fwd.append("--update-baseline")
     if args.show_suppressed:
         fwd.append("--show-suppressed")
+    if args.format != "text":
+        fwd += ["--format", args.format]
+    if args.no_cache:
+        fwd.append("--no-cache")
     return lint_main(fwd)
 
 
@@ -1509,20 +1513,28 @@ def build_parser() -> argparse.ArgumentParser:
                              "(ET3xx), layout contracts (LC4xx), "
                              "observability discipline (OB6xx), serving "
                              "cache bounds (SV8xx), write-path atomicity "
-                             "(WR10x); exits non-zero on unsuppressed "
-                             "findings")
+                             "(WR10x), thread-safety/lock order "
+                             "(TH1xx/LK2xx); exits non-zero on "
+                             "unsuppressed findings")
     ln.add_argument("--root", default=None,
                     help="package directory to analyze")
     ln.add_argument("--only", action="append", metavar="ANALYZER",
                     help="run one analyzer (trace_safety, lockstep, "
                          "taxonomy, layout, feedpath, querycache, obs, "
-                         "decodepath, servebounds, writepath); repeatable")
+                         "decodepath, servebounds, threadsafety, "
+                         "writepath); repeatable")
     ln.add_argument("--baseline", default=None,
                     help="baseline file (default analysis/baseline.json)")
     ln.add_argument("--no-baseline", action="store_true")
     ln.add_argument("--update-baseline", action="store_true",
                     help="accept all current findings into the baseline")
     ln.add_argument("--show-suppressed", action="store_true")
+    ln.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
+                    help="findings output format (json/sarif for CI "
+                         "annotation; text stays byte-stable)")
+    ln.add_argument("--no-cache", action="store_true",
+                    help="ignore the lint findings cache")
     ln.set_defaults(fn=cmd_lint, uses_device=False)
 
     ch = sub.add_parser(

@@ -69,6 +69,12 @@ class ParallelBGZFWriter:
         self.bytes_out = 0
         self.data_end_coffset = 0   # set at close (before the EOF block)
         self._closed = False
+        # orders committer-side bookkeeping (_err, _coffset, bytes_out,
+        # _block_coffs) against producer-thread readers: _check_err polls
+        # _err mid-write, and close/resolve_voffsets read the offsets the
+        # committer thread produced.  Never contended on the hot path —
+        # the committer is the only writer in flight.
+        self._mu = threading.Lock()
         self._err: Optional[BaseException] = None
         if max_inflight is not None and max_inflight < 0:
             raise PlanError(f"max_inflight must be >= 0, "
@@ -162,10 +168,12 @@ class ParallelBGZFWriter:
 
     def _commit(self, block: bytes) -> None:
         with METRICS.span("write.commit_wall"):
-            self._block_coffs.append(self._coffset)
+            with self._mu:
+                self._block_coffs.append(self._coffset)
             self._sink.write(block)
-        self._coffset += len(block)
-        self.bytes_out += len(block)
+        with self._mu:
+            self._coffset += len(block)
+            self.bytes_out += len(block)
         METRICS.count("write.bytes_out", len(block))
         METRICS.count("write.blocks_out")
 
@@ -176,19 +184,24 @@ class ParallelBGZFWriter:
                 return
             try:
                 block = fut.result()
-                if self._err is None:
+                with self._mu:
+                    poisoned = self._err is not None
+                if not poisoned:
                     self._commit(block)
             except BaseException as e:  # noqa: BLE001 — crosses threads
                 # keep draining (and releasing permits) so the producer
                 # never wedges on the semaphore; the first error wins
-                if self._err is None:
-                    self._err = e
+                with self._mu:
+                    if self._err is None:
+                        self._err = e
             finally:
                 self._sem.release()
 
     def _check_err(self) -> None:
-        if self._err is not None:
-            raise self._err
+        with self._mu:
+            err = self._err
+        if err is not None:
+            raise err
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -205,20 +218,23 @@ class ParallelBGZFWriter:
             if self._committer is not None:
                 self._q.put(_SENTINEL)
                 self._committer.join()
-        if self._err is not None:
+        with self._mu:
             err, self._err = self._err, None
+        if err is not None:
             raise err
         self.data_end_coffset = self._coffset
         # end sentinel: payload positions at exactly end-of-data resolve
         # to the normalized (next-block) virtual offset, matching the
         # serial writer's tell_voffset at a block boundary
         self._block_starts.append(self._submitted)
-        self._block_coffs.append(self._coffset)
+        with self._mu:
+            self._block_coffs.append(self._coffset)
         if self._write_eof:
             with METRICS.span("write.commit_wall"):
                 self._sink.write(bgzf.EOF_BLOCK)
-            self._coffset += len(bgzf.EOF_BLOCK)
-            self.bytes_out += len(bgzf.EOF_BLOCK)
+            with self._mu:
+                self._coffset += len(bgzf.EOF_BLOCK)
+                self.bytes_out += len(bgzf.EOF_BLOCK)
             METRICS.count("write.bytes_out", len(bgzf.EOF_BLOCK))
 
     @property

@@ -1,0 +1,312 @@
+"""Unit tests for the shared interprocedural engine
+(``analysis/callgraph.py``): thread-root discovery, call resolution,
+guard inference (lexical + entry-guard fixpoint), closure-escape
+reasoning and lock-order cycle detection.
+
+The engine underlies both the TS1xx taint rules and the TH1xx/LK2xx
+thread-safety rules, so its behavior is pinned here independently of
+any one analyzer (the analyzer-level corpus lives in test_lint.py).
+"""
+import pytest
+
+from hadoop_bam_tpu.analysis.callgraph import (
+    CallGraphEngine, find_lock_cycles, format_access_id,
+)
+from hadoop_bam_tpu.analysis.core import Project
+
+pytestmark = pytest.mark.lint
+
+SCOPE = ("hadoop_bam_tpu/serve",)
+
+
+def engine(sources, scope=SCOPE):
+    return CallGraphEngine(Project.from_sources(sources), scope)
+
+
+# ---------------------------------------------------------------------------
+# thread-root discovery
+# ---------------------------------------------------------------------------
+
+_SPAWNS = '''
+import contextvars
+import threading
+
+
+def tick():
+    pass
+
+
+def pump():
+    pass
+
+
+def fire():
+    pass
+
+
+def work(x):
+    pass
+
+
+def mapper(x):
+    pass
+
+
+def done(fut):
+    pass
+
+
+def handle_stream(conn):
+    pass
+
+
+def spawn(pool, executor, fut):
+    ctx = contextvars.copy_context()
+    threading.Thread(target=ctx.run, args=(tick,), daemon=True).start()
+    threading.Thread(target=lambda: ctx.run(pump), daemon=True).start()
+    threading.Timer(5.0, fire).start()
+    pool.submit(work, 1)
+    executor.map(mapper, [1])
+    fut.add_done_callback(done)
+
+
+class Loop:
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+'''
+
+
+def test_thread_root_discovery_all_spawn_forms():
+    eng = engine({"hadoop_bam_tpu/serve/mod.py": _SPAWNS})
+    got = {(r.key[1], r.kind) for r in eng.thread_roots()}
+    assert got == {
+        ("tick", "thread"),            # Thread(target=ctx.run, args=(f,))
+        ("pump", "thread"),            # Thread(target=lambda: ctx.run(f))
+        ("fire", "thread"),            # Timer(interval, f)
+        ("work", "pool"),              # pool.submit(f, ...)
+        ("mapper", "pool"),            # executor.map(f, items)
+        ("done", "callback"),          # fut.add_done_callback(f)
+        ("handle_stream", "handler"),  # named TCP handler root
+        ("Loop._run", "thread"),       # Thread(target=self._method)
+    }
+    assert all(r.name == f"serve/mod.py:{r.key[1]}"
+               for r in eng.thread_roots())
+
+
+def test_client_entries_exclude_roots_and_private_helpers():
+    eng = engine({"hadoop_bam_tpu/serve/mod.py": _SPAWNS})
+    got = {k[1] for k in eng.client_entries()}
+    # public surface only: root targets, _helpers and nested functions
+    # are all excluded from the synthetic 'client' root
+    assert got == {"spawn", "Loop.start"}
+
+
+def test_scope_selects_modules():
+    eng = engine({
+        "hadoop_bam_tpu/serve/a.py": "def f():\n    pass\n",
+        "hadoop_bam_tpu/formats/b.py": "def g():\n    pass\n",
+    })
+    assert set(eng.indices) == {"hadoop_bam_tpu/serve/a.py"}
+
+
+def test_reachable_follows_calls_across_modules():
+    eng = engine({
+        "hadoop_bam_tpu/serve/a.py": '''
+from hadoop_bam_tpu.serve.b import helper
+
+
+def entry():
+    helper()
+''',
+        "hadoop_bam_tpu/serve/b.py": '''
+def helper():
+    _deep()
+
+
+def _deep():
+    pass
+''',
+    })
+    got = eng.reachable([("hadoop_bam_tpu/serve/a.py", "entry")])
+    assert ("hadoop_bam_tpu/serve/b.py", "helper") in got
+    assert ("hadoop_bam_tpu/serve/b.py", "_deep") in got
+
+
+# ---------------------------------------------------------------------------
+# guard inference
+# ---------------------------------------------------------------------------
+
+_GUARDS = '''
+import threading
+
+_LOCK = threading.Lock()
+_N = 0
+
+
+def _bump():
+    global _N
+    _N += 1
+
+
+def add():
+    with _LOCK:
+        _bump()
+
+
+def sub():
+    with _LOCK:
+        _bump()
+
+
+def _spawn():
+    threading.Thread(target=_loop).start()
+
+
+def _loop():
+    while True:
+        add()
+'''
+
+
+def test_entry_guard_intersection_over_call_sites():
+    path = "hadoop_bam_tpu/serve/g.py"
+    eng = engine({path: _GUARDS})
+    lock = ("global", path, "_LOCK")
+    # every resolvable call site of _bump (add, sub — lexically; _loop
+    # -> add — via the fixpoint) holds _LOCK, so _bump's write to _N is
+    # guarded at entry with no `with` of its own
+    assert eng.entry_guards()[(path, "_bump")] == frozenset({lock})
+
+
+def test_entry_guard_dropped_by_one_unguarded_call_site():
+    path = "hadoop_bam_tpu/serve/g.py"
+    src = _GUARDS + '''
+
+def reset():
+    _bump()
+'''
+    eng = engine({path: src})
+    assert eng.entry_guards()[(path, "_bump")] == frozenset()
+
+
+def test_effective_guards_on_write_accesses():
+    path = "hadoop_bam_tpu/serve/g.py"
+    eng = engine({path: _GUARDS})
+    lock = ("global", path, "_LOCK")
+    writes = [a for a in eng.accesses_of((path, "_bump"))
+              if a.kind == "write"
+              and a.target == ("global", path, "_N")]
+    assert writes, "the global AugAssign under `global` must register"
+    assert all(eng.effective_guards(a) == frozenset({lock})
+               for a in writes)
+
+
+# ---------------------------------------------------------------------------
+# closure-escape reasoning
+# ---------------------------------------------------------------------------
+
+_CLOSURE = '''
+import threading
+
+
+def owner():
+    buf = []
+
+    def _worker():
+        buf.append(1)
+
+    threading.Thread(target=_worker).start()
+    return buf
+
+
+def other():
+    buf = []
+    buf.append(2)
+    return buf
+'''
+
+
+def test_closure_escape_requires_nested_spawn():
+    path = "hadoop_bam_tpu/serve/c.py"
+    eng = engine({path: _CLOSURE})
+    # owner hands its cell to a thread spawned INSIDE itself: shared
+    assert eng.closure_escapes_to_thread(("closure", path, "owner",
+                                          "buf"))
+    # other's cell is per-invocation; no nested spawn, never shared
+    assert not eng.closure_escapes_to_thread(("closure", path, "other",
+                                              "buf"))
+    # non-closure identities are always shareable
+    assert eng.closure_escapes_to_thread(("attr", "Fleet", "_n"))
+    assert eng.closure_escapes_to_thread(("global", path, "_N"))
+
+
+# ---------------------------------------------------------------------------
+# lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_find_lock_cycles_unit():
+    a = ("attr", "C", "_a")
+    b = ("attr", "C", "_b")
+    c = ("attr", "C", "_c")
+    assert find_lock_cycles({}) == []
+    assert find_lock_cycles({(a, b): ("p", 1), (b, c): ("p", 2)}) == []
+    assert find_lock_cycles({(a, b): ("p", 1),
+                             (b, a): ("p", 2)}) == [[a, b]]
+    # 3-cycle reported once, rotated to start at its smallest lock
+    assert find_lock_cycles({(b, c): ("p", 1), (c, a): ("p", 2),
+                             (a, b): ("p", 3)}) == [[a, b, c]]
+
+
+_LK_INTER = '''
+import threading
+
+
+class P:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._a:
+            self._inner()
+
+    def _inner(self):
+        with self._b:
+            pass
+
+    def poke(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+
+def test_lock_order_edges_cross_function():
+    path = "hadoop_bam_tpu/serve/lk.py"
+    eng = engine({path: _LK_INTER})
+    a = ("attr", "P", "_a")
+    b = ("attr", "P", "_b")
+    edges = eng.lock_order_edges()
+    # a->b comes only from the INTERPROCEDURAL hold: _inner acquires _b
+    # while _a is held at its sole call site; b->a is lexical in poke
+    assert (a, b) in edges and (b, a) in edges
+    assert find_lock_cycles(edges) == [[a, b]]
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def test_format_access_id():
+    assert format_access_id(("attr", "Fleet", "_mu")) == "Fleet.self._mu"
+    assert format_access_id(
+        ("global", "hadoop_bam_tpu/utils/pools.py", "_BG_QUEUE")
+    ) == "hadoop_bam_tpu/utils/pools.py::_BG_QUEUE"
+    assert format_access_id(
+        ("closure", "hadoop_bam_tpu/serve/c.py", "owner", "buf")
+    ) == "hadoop_bam_tpu/serve/c.py::owner.buf"

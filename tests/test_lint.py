@@ -93,6 +93,60 @@ def host_oracle(x):            # never traced: host NumPy is fine here
     assert findings == []
 
 
+def test_ts_findings_pinned_across_engine_extraction():
+    """TS1xx now runs on the shared interprocedural engine
+    (``analysis/callgraph.py``); this pins rule, path, line, message,
+    severity AND fingerprint so the extraction stays observably
+    identical (fingerprints feed the baseline contract)."""
+    findings = lint_sources({
+        "hadoop_bam_tpu/ops/bad.py": '''
+import jax
+import numpy as np
+
+@jax.jit
+def f(x, n):
+    if x > 0:
+        x = x + 1
+    for i in range(n):
+        x = x + i
+    y = np.asarray(x)
+    return x.item()
+''',
+        "hadoop_bam_tpu/parallel/bad.py": '''
+from hadoop_bam_tpu.parallel.mesh import shard_map
+
+def make_step(mesh):
+    def per_device(tile, count):
+        return helper(tile)
+    return shard_map(per_device, mesh=mesh, in_specs=(), out_specs=())
+
+def helper(t):
+    return t.tolist()
+''',
+    }, only=["trace_safety"])
+    got = [(f.rule, f.path, f.line, f.message, f.severity, f.fingerprint)
+           for f in findings]
+    assert got == [
+        ("TS102", "hadoop_bam_tpu/ops/bad.py", 7,
+         "data-dependent Python branch on a traced value; use jnp.where "
+         "/ lax.cond (in traced function 'f')", "error",
+         "9b285a92eb74ecba"),
+        ("TS103", "hadoop_bam_tpu/ops/bad.py", 9,
+         "Python loop over a traced value; use lax control flow or "
+         "vectorize (in traced function 'f')", "error",
+         "c1b5129827abde42"),
+        ("TS104", "hadoop_bam_tpu/ops/bad.py", 11,
+         "host NumPy call 'np.asarray' on a traced value; use jnp "
+         "(in traced function 'f')", "error", "3e9860b427381ca6"),
+        ("TS101", "hadoop_bam_tpu/ops/bad.py", 12,
+         ".item() forces a host sync on a traced value (in traced "
+         "function 'f')", "error", "cc4fe5181e8ea137"),
+        ("TS101", "hadoop_bam_tpu/parallel/bad.py", 10,
+         ".tolist() forces a host sync on a traced value (in traced "
+         "function 'helper')", "error", "045e954f117b94e5"),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # collective lockstep (CL2xx)
 # ---------------------------------------------------------------------------
@@ -1301,6 +1355,278 @@ def test_pl_scope_excludes_plan_and_config():
 
 
 # ---------------------------------------------------------------------------
+# thread-topology races & lock discipline (TH1xx/LK2xx)
+# ---------------------------------------------------------------------------
+
+_TH101_BAD = '''
+import threading
+
+
+class Fleet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            self._count += 1           # TH101: heartbeat side, no lock
+
+    def bump(self):
+        self._count += 1               # TH101: client side, no lock
+'''
+
+_TH101_GOOD = '''
+import threading
+
+
+class Fleet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._count += 1
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+'''
+
+
+def test_th101_seeded_cross_thread_writes_fire():
+    findings = lint_sources(
+        {"hadoop_bam_tpu/serve/bad.py": _TH101_BAD},
+        only=["threadsafety"])
+    assert rules_of(findings) == {"TH101"}
+    assert len(findings) == 2          # both unguarded write sites
+    assert all("Fleet.self._count" in f.message for f in findings)
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_th101_clean_twin_locked_writes_pass():
+    assert lint_sources({"hadoop_bam_tpu/serve/good.py": _TH101_GOOD},
+                        only=["threadsafety"]) == []
+
+
+def test_th101_helper_called_only_under_lock_is_guarded():
+    # the entry-guard fixpoint: every call site of _record holds the
+    # lock, so its write is guarded even with no lexical `with` inside
+    findings = lint_sources({"hadoop_bam_tpu/serve/entry.py": '''
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _record(self):
+        self._n += 1
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._record()
+
+    def add(self):
+        with self._lock:
+            self._record()
+'''}, only=["threadsafety"])
+    assert findings == []
+
+
+def test_th101_scope_excludes_formats():
+    # the identical race outside serve/parallel/write/jobs/resilience/
+    # utils/pools.py is not this analyzer's business
+    assert lint_sources({"hadoop_bam_tpu/formats/bad.py": _TH101_BAD},
+                        only=["threadsafety"]) == []
+
+
+def test_th_no_thread_roots_means_no_findings():
+    # single-threaded scope: nothing is cross-thread, whole analyzer
+    # stands down (the 'client' root alone can never conflict)
+    assert lint_sources({"hadoop_bam_tpu/serve/calm.py": '''
+N = 0
+
+
+def bump():
+    global N
+    N += 1
+
+
+def reset():
+    global N
+    N = 0
+'''}, only=["threadsafety"]) == []
+
+
+_TH102_BAD = '''
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen = {}
+        self._t = threading.Thread(target=self._sweep, daemon=True)
+
+    def _sweep(self):
+        with self._lock:
+            self._seen.clear()
+
+    def put(self, k, v):
+        if k not in self._seen:        # TH102: the decision is unlocked
+            with self._lock:
+                self._seen[k] = v
+
+    def drain(self):
+        if not self._seen:             # TH102: emptiness probe, unlocked
+            with self._lock:
+                self._seen.update({})
+'''
+
+_TH102_GOOD = '''
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen = {}
+        self._t = threading.Thread(target=self._sweep, daemon=True)
+
+    def _sweep(self):
+        with self._lock:
+            self._seen.clear()
+
+    def put(self, k, v):
+        with self._lock:
+            if k not in self._seen:
+                self._seen[k] = v
+
+    def drain(self):
+        with self._lock:
+            if not self._seen:
+                self._seen.update({})
+'''
+
+
+def test_th102_check_then_act_fires():
+    # note every WRITE here is lock-guarded — TH101 stays silent; the
+    # defect is purely the unlocked decision (classic TOCTOU)
+    findings = lint_sources({"hadoop_bam_tpu/serve/bad.py": _TH102_BAD},
+                            only=["threadsafety"])
+    assert rules_of(findings) == {"TH102"}
+    assert len(findings) == 2
+    assert all("Cache.self._seen" in f.message for f in findings)
+
+
+def test_th102_clean_twin_atomic_check_passes():
+    assert lint_sources({"hadoop_bam_tpu/serve/good.py": _TH102_GOOD},
+                        only=["threadsafety"]) == []
+
+
+_LK201_BAD = '''
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def poke(self):
+        with self._b:
+            with self._a:               # LK201: opposite nesting order
+                pass
+'''
+
+_LK201_GOOD = '''
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def poke(self):
+        with self._a:
+            with self._b:               # same global order: fine
+                pass
+'''
+
+
+def test_lk201_lock_order_cycle_fires():
+    findings = lint_sources({"hadoop_bam_tpu/serve/bad.py": _LK201_BAD},
+                            only=["threadsafety"])
+    assert rules_of(findings) == {"LK201"}
+    [f] = findings
+    assert "Pair.self._a -> Pair.self._b -> Pair.self._a" in f.message
+
+
+def test_lk201_clean_twin_single_order_passes():
+    assert lint_sources({"hadoop_bam_tpu/serve/good.py": _LK201_GOOD},
+                        only=["threadsafety"]) == []
+
+
+def test_th101_parallel_bgzf_prefix_pattern_regression():
+    """Both directions of the in-PR fix: the PRE-fix shape of
+    write/parallel_bgzf.py (committer thread and close() racing on
+    _err with no lock) must keep firing, and the shipped module (now
+    serialized through _mu) must stay clean."""
+    findings = lint_sources({"hadoop_bam_tpu/write/bad.py": '''
+import threading
+
+
+class Writer:
+    def __init__(self):
+        self._err = None
+        self._t = threading.Thread(target=self._commit_loop, daemon=True)
+
+    def _commit_loop(self):
+        try:
+            self._commit()
+        except Exception as e:
+            if self._err is None:
+                self._err = e
+
+    def _commit(self):
+        pass
+
+    def close(self):
+        err, self._err = self._err, None
+        if err is not None:
+            raise err
+'''}, only=["threadsafety"])
+    assert rules_of(findings) == {"TH101"}
+    assert len(findings) == 2
+    assert all("Writer.self._err" in f.message for f in findings)
+
+    repo = run_analyzers(Project.load(), only=["threadsafety"])
+    assert repo == []
+
+
+# ---------------------------------------------------------------------------
 # the CI gate: the repo itself lints clean
 # ---------------------------------------------------------------------------
 
@@ -1329,3 +1655,94 @@ def test_lint_cli_exit_codes(tmp_path, capsys):
     assert lint_main(["--root", root, "--baseline", bl]) == 0
     out = capsys.readouterr().out
     assert "ET301" in out and "1 suppressed" in out
+
+
+# ---------------------------------------------------------------------------
+# output formats & the findings cache
+# ---------------------------------------------------------------------------
+
+def _seed_bad_tree(tmp_path):
+    """One-module tree with a single ET301 finding at line 2."""
+    pkg = tmp_path / "hadoop_bam_tpu" / "split"
+    pkg.mkdir(parents=True)
+    (pkg / "planners.py").write_text(
+        "def f(n):\n    raise ValueError('x')\n")
+    return str(tmp_path / "hadoop_bam_tpu"), str(tmp_path / "bl.json")
+
+
+def test_lint_format_json(tmp_path, capsys):
+    from hadoop_bam_tpu.analysis.core import lint_main
+    root, bl = _seed_bad_tree(tmp_path)
+    rc = lint_main(["--root", root, "--baseline", bl,
+                    "--format", "json", "--no-cache"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["tool"] == "hbam-lint"
+    [f] = doc["findings"]
+    assert f["rule"] == "ET301"
+    assert f["path"].endswith("planners.py")
+    assert f["line"] == 2
+    assert f["severity"] == "error"
+    assert len(f["fingerprint"]) == 16
+    assert doc["summary"]["unsuppressed"] == 1
+
+
+def test_lint_format_sarif(tmp_path, capsys):
+    from hadoop_bam_tpu.analysis.core import lint_main
+    root, bl = _seed_bad_tree(tmp_path)
+    rc = lint_main(["--root", root, "--baseline", bl,
+                    "--format", "sarif", "--no-cache"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "hbam-lint"
+    assert run["tool"]["driver"]["rules"] == [{"id": "ET301"}]
+    [res] = run["results"]
+    assert res["ruleId"] == "ET301"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("planners.py")
+    assert loc["region"]["startLine"] == 2
+    assert "hbamLint/v1" in res["partialFingerprints"]
+
+
+def test_lint_format_json_suppressed_exit_zero(tmp_path, capsys):
+    from hadoop_bam_tpu.analysis.core import lint_main
+    root, bl = _seed_bad_tree(tmp_path)
+    assert lint_main(["--root", root, "--baseline", bl,
+                      "--update-baseline", "--no-cache"]) == 0
+    capsys.readouterr()
+    assert lint_main(["--root", root, "--baseline", bl,
+                      "--format", "json", "--no-cache"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"] == []
+    assert doc["summary"]["suppressed"] == 1
+
+
+def test_lint_cache_replay_and_invalidation(tmp_path, capsys,
+                                            monkeypatch):
+    from hadoop_bam_tpu.analysis.core import lint_main
+    cache = tmp_path / "cache.json"
+    monkeypatch.setenv("HBAM_LINT_CACHE", str(cache))
+    root, bl = _seed_bad_tree(tmp_path)
+
+    assert lint_main(["--root", root, "--baseline", bl]) == 1
+    out_cold = capsys.readouterr().out
+    assert cache.exists()
+
+    # warm replay: byte-identical report and exit code off the digest
+    assert lint_main(["--root", root, "--baseline", bl]) == 1
+    assert capsys.readouterr().out == out_cold
+
+    # any tree drift invalidates: fixing the file flips the exit code
+    fixed = tmp_path / "hadoop_bam_tpu" / "split" / "planners.py"
+    fixed.write_text("def f(n):\n    return n\n")
+    assert lint_main(["--root", root, "--baseline", bl]) == 0
+    capsys.readouterr()
+
+    # --no-cache neither reads nor writes the cache file
+    stamp = cache.stat().st_mtime_ns
+    assert lint_main(["--root", root, "--baseline", bl,
+                      "--no-cache"]) == 0
+    assert cache.stat().st_mtime_ns == stamp

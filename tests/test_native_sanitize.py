@@ -21,7 +21,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The subprocess body: build fixtures in memory and push them through every
 # native entry point (inflate, CRC, record walks, packed/payload walks,
 # deflate, rANS 4x8 + Nx16, DEFLATE tokenize).  Multi-threaded calls are
-# explicit so ASan sees the pthread paths.
+# explicit so ASan sees the pthread paths.  It then drives the two
+# Python-threaded planes TSan should watch end to end: the staging
+# packer (FeedPipeline's pack thread racing the dispatch consumer over
+# reused ring slots) and a two-replica serving fleet over real TCP
+# (handler threads + heartbeat + decode pool + peer fetch).
 DRIVER = r"""
 import io, random, sys
 import numpy as np
@@ -124,6 +128,82 @@ try:
     raise AssertionError("truncated ITF8 did not raise")
 except ValueError:
     pass
+
+# staging packer: the FeedPipeline's background pack thread races the
+# dispatching consumer over reused ring slots — drive it with a host
+# dispatch so the sanitizer watches the lease/release handoff itself
+from hadoop_bam_tpu.parallel.staging import FeedPipeline, TileSpec
+specs = (TileSpec((4,), np.uint8, 0), TileSpec((), np.int32, 0))
+spans = []
+total_rows = 0
+for i in range(40):
+    n = rng.randint(1, 30)
+    total_rows += n
+    spans.append((np.full((n, 4), i % 251, np.uint8),
+                  np.arange(n, dtype=np.int32)))
+fp = FeedPipeline(3, 16, specs, block_n=4, ring_slots=2,
+                  dispatch_depth=2)
+seen = []
+fp.feed(iter(spans), lambda arrays, counts: seen.append(int(counts.sum())))
+assert sum(seen) == total_rows, (sum(seen), total_rows)
+
+# serve/fleet peer fetch: two in-process replicas over real TCP.  Each
+# side runs TCP handler threads, the heartbeat loop and the shared
+# decode pool, and replication=1 over two replicas forces peer fetches
+# — the whole fleet thread topology drives the native decode at once.
+import dataclasses, os, socket, tempfile, threading
+from hadoop_bam_tpu.config import DEFAULT_CONFIG
+from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+from hadoop_bam_tpu.serve import ServeLoop, make_tcp_server
+from hadoop_bam_tpu.split.bai import write_bai
+
+tmpdir = tempfile.mkdtemp()
+bam_path = os.path.join(tmpdir, "f.bam")
+with open(bam_path, "wb") as fh:
+    fh.write(raw)
+write_bai(bam_path)
+regions = ["chr1:1-2000", "chr1:2001-4100"]
+oracle = [len(r.records) for r in QueryEngine().query_records(
+    [QueryRequest(bam_path, rg) for rg in regions])]
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+p1, p2 = _free_port(), _free_port()
+peer_spec = f"r1=127.0.0.1:{p1},r2=127.0.0.1:{p2}"
+loops, servers, sthreads = [], [], []
+for rid, port in (("r1", p1), ("r2", p2)):
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG, serve_replica_id=rid, serve_peers=peer_spec,
+        fleet_replication=1, fleet_heartbeat_s=0.1,
+        serve_prefetch=False)
+    loop = ServeLoop(config=cfg)
+    loop.start()
+    srv = make_tcp_server(loop, host="127.0.0.1", port=port)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    loops.append(loop)
+    servers.append(srv)
+    sthreads.append(t)
+try:
+    counts1 = [r.count for r in loops[0].query(bam_path, regions)]
+    counts2 = [r.count for r in loops[1].query(bam_path, regions)]
+    assert counts1 == counts2 == oracle, (counts1, counts2, oracle)
+    fl1, fl2 = loops[0].fleet, loops[1].fleet
+    assert fl1.peer_fetch_ok + fl2.peer_fetch_ok > 0
+    assert fl1.peer_fetch_failed == fl2.peer_fetch_failed == 0
+finally:
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+    for loop in loops:
+        loop.stop()
+    for t in sthreads:
+        t.join(5.0)
 print("SANITIZED-OK")
 """
 
@@ -139,26 +219,51 @@ def _san_runtime(lib):
         else None
 
 
+# Races/interceptor noise inside the uninstrumented jax/numpy runtime
+# libraries (XLA's Eigen thread pool handing buffers to numpy memcpy,
+# MLIR thread-local cache teardown) are theirs, not ours: suppress by
+# module so findings in native/hbam_native.cpp still fail the test.
+_TSAN_SUPPRESSIONS = """\
+race:xla_extension.so
+race:libjaxlib_mlir_capi.so
+race:_mlir.so
+race:_multiarray_umath
+called_from_lib:xla_extension.so
+called_from_lib:libjaxlib_mlir_capi.so
+"""
+
+
 @pytest.mark.parametrize("mode,lib,marker", [
     ("address", "libasan.so", "AddressSanitizer"),
     ("thread", "libtsan.so", "ThreadSanitizer"),
 ])
-def test_native_sanitized_clean(mode, lib, marker):
+def test_native_sanitized_clean(mode, lib, marker, tmp_path):
     runtime = _san_runtime(lib)
     if runtime is None:
         pytest.skip(f"g++/{lib} not available")
+    # preload libstdc++ WITH the sanitizer runtime: the interceptors
+    # resolve __cxa_throw at startup, before jaxlib's pybind modules
+    # (which throw C++ exceptions) are dlopened — without it ASan
+    # aborts on "real___cxa_throw != 0" the first time jax raises
+    stdcxx = _san_runtime("libstdc++.so.6")
+    preload = f"{runtime} {stdcxx}" if stdcxx else runtime
+    supp = tmp_path / "tsan.supp"
+    supp.write_text(_TSAN_SUPPRESSIONS)
     env = dict(os.environ)
     env.update({
         "HBAM_NATIVE_SANITIZE": mode,
-        "LD_PRELOAD": runtime,
+        "LD_PRELOAD": preload,
         # CPython itself "leaks" interned objects; only instrument our .so's
         # heap errors, overflows, and races with the preloaded runtime.
         "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
         # CPython's own lock usage is not what we're testing — disable the
-        # deadlock detector; data races in the .so's threaded batch loops
-        # still abort via halt_on_error
+        # deadlock detector and mutex-misuse reports (the libgcc unwinder
+        # and XLA's pool trip bogus ones from uninstrumented code); data
+        # races in the .so's threaded batch loops still abort via
+        # halt_on_error
         "TSAN_OPTIONS": "detect_deadlocks=0:report_signal_unsafe=0:"
-                        "halt_on_error=1",
+                        "report_mutex_bugs=0:halt_on_error=1:"
+                        f"suppressions={supp}",
         "JAX_PLATFORMS": "cpu",
     })
     proc = subprocess.run([sys.executable, "-c", DRIVER], cwd=REPO, env=env,
