@@ -1798,6 +1798,186 @@ def bench_device_inflate(path: str):
 
 
 # ---------------------------------------------------------------------------
+# 4b. device decode plane families (round 21): payload / variant / cold serve
+# ---------------------------------------------------------------------------
+
+def bench_device_planes(path: str):
+    """The round-21 contract row: the token-feed device plane extended
+    past flagstat to three more families, one arm each —
+
+    - ``seq_stats``: segmented seq/qual payload projections unpacked
+      on-mesh vs the host driver, same pinned span subset both arms;
+    - ``variant``: BCF variant stats (device fixed-prefix unpack +
+      grouped GT dosage gathers over the resolved mesh buffer) vs the
+      host columnar decoder;
+    - ``serve_cold``: a cold region_serve pass whose tiles are built
+      entirely on-device (serve/tiles.device_build_chunk) vs a cold
+      host-built pass over the same distinct windows.
+
+    Every arm asserts value identity against its host oracle IN-RUN (a
+    parity break fails the row instead of reporting plausible rates)
+    and reports the device arm's pipeline.host_decode_wall share — the
+    structural claim is that the new routes keep host record decode off
+    the critical path (~0; payload span fixups may contribute epsilon).
+    Same 1-core XLA:CPU caveat as the device_inflate row: this pins
+    overlap structure and plane correctness, not TPU speedup."""
+    import dataclasses as _dc
+
+    import jax
+
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.formats.bamio import read_bam_header
+    from hadoop_bam_tpu.parallel.pipeline import (
+        DEVICE_PLANE_SPAN_BYTES, seq_stats_file,
+    )
+    from hadoop_bam_tpu.parallel.variant_pipeline import variant_stats_file
+    from hadoop_bam_tpu.split.planners import plan_spans_cached
+    from hadoop_bam_tpu.utils import native as nat
+    from hadoop_bam_tpu.utils.metrics import METRICS
+
+    metric = "device_plane_families_records_per_sec"
+    if not nat.available():
+        return {"metric": metric, "error": "native tokenizer unavailable"}
+    cfg_dev = _dc.replace(DEFAULT_CONFIG, inflate_backend="device")
+
+    def match(a, b):
+        """Counts exact, float reductions within device/host
+        reduce-order jitter (f32 tile partials vs f64 host sums)."""
+        if set(a) != set(b):
+            return False
+        for k in a:
+            va, vb = a[k], b[k]
+            if isinstance(va, (int, np.integer)):
+                if int(va) != int(vb):
+                    return False
+            elif not np.allclose(np.asarray(va, np.float64),
+                                 np.asarray(vb, np.float64),
+                                 rtol=1e-5, atol=1e-8):
+                return False
+        return True
+
+    def race(run_dev, run_host):
+        """Interleaved best-of-2 of both arms; returns (best walls,
+        device-arm host_decode_wall share at its best run)."""
+        best = {"device": float("inf"), "host": float("inf")}
+        share = {}
+        for _ in range(2):
+            for arm, run in (("device", run_dev), ("host", run_host)):
+                METRICS.reset()
+                t0 = time.perf_counter()
+                run()
+                dt = time.perf_counter() - t0
+                if dt < best[arm]:
+                    best[arm] = dt
+                    w = METRICS.snapshot()["wall_timers"]
+                    share[arm] = (float(w.get("pipeline.host_decode_wall",
+                                              0.0)) / max(dt, 1e-9))
+        return best, share
+
+    # --- payload arm: seq_stats over the pinned ~6 MiB span subset ---
+    bam = _scaling_fixture(path)
+    header, _ = read_bam_header(bam)
+    n_spans = max(len(jax.devices()),
+                  int(np.ceil(os.path.getsize(bam)
+                              / DEVICE_PLANE_SPAN_BYTES)))
+    spans = list(plan_spans_cached(bam, header, DEFAULT_CONFIG,
+                                   num_spans=n_spans))
+    budget = 6 << 20
+    take, acc = [], 0
+    for s in spans:
+        take.append(s)
+        acc += s.compressed_size
+        if acc >= budget:
+            break
+
+    def seq_dev():
+        return seq_stats_file(bam, header=header, spans=take,
+                              config=cfg_dev)
+
+    def seq_host():
+        return seq_stats_file(bam, header=header, spans=take)
+
+    dev_stats = seq_dev()                    # warmup: resolve/unpack jit
+    host_stats = seq_host()
+    if not match(dev_stats, host_stats):
+        return {"metric": metric,
+                "error": "seq_stats device plane parity break vs host"}
+    n_records = int(host_stats["n_reads"])
+    sbest, sshare = race(seq_dev, seq_host)
+    seq_arm = {
+        "device_records_per_sec": round(n_records / sbest["device"], 1),
+        "host_records_per_sec": round(n_records / sbest["host"], 1),
+        "host_decode_share": round(sshare["device"], 4),
+        "identical_to_host": True,
+        "records": n_records, "spans": len(take)}
+
+    # --- variant arm: BCF stats, whole-file both planes ---
+    bcfp = build_bcf_fixture()
+
+    def var_dev():
+        return variant_stats_file(bcfp, config=cfg_dev)
+
+    def var_host():
+        return variant_stats_file(bcfp)
+
+    vd, vh = var_dev(), var_host()           # warmup + parity
+    if not match(vd, vh):
+        return {"metric": metric,
+                "error": "variant device plane parity break vs host"}
+    n_variants = int(vh["n_variants"])
+    vbest, vshare = race(var_dev, var_host)
+    var_arm = {
+        "device_variants_per_sec": round(n_variants / vbest["device"], 1),
+        "host_variants_per_sec": round(n_variants / vbest["host"], 1),
+        "host_decode_share": round(vshare["device"], 4),
+        "identical_to_host": True, "variants": n_variants}
+
+    # --- serve arm: one cold pass per plane over the distinct windows ---
+    from hadoop_bam_tpu.serve import ServeLoop
+
+    bam_q, regions = _region_query_fixture(path)
+    # 16 distinct windows bound the XLA:CPU device-walk cost of the cold
+    # pass on this 1-core host; identity and metering pin the same way
+    windows = sorted(set(regions))[:16]
+    counts, serve_arm = {}, {}
+    for arm, cfg in (("device", _dc.replace(cfg_dev,
+                                            serve_prefetch=False)),
+                     ("host", _dc.replace(DEFAULT_CONFIG,
+                                          serve_prefetch=False))):
+        with ServeLoop(config=cfg) as loop:
+            METRICS.reset()
+            t0 = time.perf_counter()
+            res = loop.query(bam_q, windows)
+            dt = time.perf_counter() - t0
+            snap = METRICS.snapshot()
+        counts[arm] = [r.count for r in res]
+        serve_arm[f"{arm}_queries_per_sec"] = round(len(windows) / dt, 1)
+        if arm == "device":
+            serve_arm["host_decode_share"] = round(
+                float(snap["wall_timers"].get(
+                    "pipeline.host_decode_wall", 0.0)) / max(dt, 1e-9), 4)
+            serve_arm["device_tile_builds"] = int(
+                snap["counters"].get("serve.device_tile_builds", 0))
+    if counts["device"] != counts["host"]:
+        return {"metric": metric,
+                "error": "cold serve device tiles parity break vs host"}
+    serve_arm["identical_counts"] = True
+    serve_arm["regions"] = len(windows)
+
+    rate = seq_arm["device_records_per_sec"]
+    return {"metric": metric, "value": rate, "unit": "records/s",
+            "vs_baseline": round(
+                rate / max(seq_arm["host_records_per_sec"], 1e-9), 3),
+            "seq_stats": seq_arm, "variant": var_arm,
+            "serve_cold": serve_arm,
+            "note": ("round-21 device plane families: per-arm host-oracle "
+                     "identity asserted in-run; host_decode_share is the "
+                     "device arm's pipeline.host_decode_wall / wall.  "
+                     "1-core XLA:CPU caveat: overlap structure, not TPU "
+                     "speedup — the 'device' here IS the host CPU")}
+
+
+# ---------------------------------------------------------------------------
 # 5. FASTQ reads/s (device payload stats driver)
 # ---------------------------------------------------------------------------
 
@@ -2743,6 +2923,8 @@ def main() -> None:
                    "split_guess_p50_ms_per_boundary", est_s=10)
     _run_component(lambda: bench_device_inflate(path),
                    "device_inflate_records_per_sec", est_s=150.0)
+    _run_component(lambda: bench_device_planes(path),
+                   "device_plane_families_records_per_sec", est_s=150.0)
     _run_component(lambda: bench_fused_decode(path),
                    "fused_decode_records_per_sec", est_s=30)
     _run_component(lambda: bench_fault_resilience(path),

@@ -33,7 +33,11 @@ from hadoop_bam_tpu.analysis.astutil import last_segment
 from hadoop_bam_tpu.analysis.core import Finding, Project, register
 
 SCOPE = ("hadoop_bam_tpu/ops/inflate_device.py",
-         "hadoop_bam_tpu/parallel/pipeline.py")
+         "hadoop_bam_tpu/parallel/pipeline.py",
+         # round 21: the plane grew the variant and cold-serve-tile
+         # families — their drivers carry the same discipline
+         "hadoop_bam_tpu/parallel/variant_pipeline.py",
+         "hadoop_bam_tpu/serve/tiles.py")
 
 # host-boundary functions whose contract IS a host copy
 EXEMPT_FUNCTIONS = ("inflate_span_device",)

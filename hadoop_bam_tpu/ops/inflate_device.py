@@ -266,6 +266,187 @@ def resolve_walk_fields(tokens: jax.Array, n_tokens: jax.Array,
     return cols, valid, n_all, tail, bad
 
 
+# Per-record CIGAR word capacity of the serve-tile device walk.  Reads
+# with more ops than this (ultra-long split alignments) make the whole
+# chunk fall back to the host build — flagged via ``over``, never
+# silently truncated, because end1 derived from a truncated CIGAR would
+# be WRONG (a value fault, not a capacity fault).  64 ops covers >99.9%
+# of real short/long-read alignments while keeping the gather tile
+# [R, 64, 4] bytes.
+DEVICE_TILE_CIGAR_CAP = 64
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_len", "seq_stride", "qual_stride"))
+def resolve_walk_payload(tokens: jax.Array, n_tokens: jax.Array,
+                         isize: jax.Array, start: jax.Array,
+                         stop: jax.Array, max_len: int, seq_stride: int,
+                         qual_stride: int):
+    """Device decode step for the variable-length payload family:
+    resolve + pack + record walk + FIXED_FIELDS gather + segmented
+    seq/qual extraction — the inflated bytes never leave the device.
+
+    The variable-length sections are flattened by the same trick the
+    record walk uses: the walk's pointer-doubling offsets give each
+    record's start, the fixed columns give the per-record seq offset
+    (``PREFIX + l_read_name + 4*n_cigar``), and one segmented gather per
+    stream lifts the packed 4-bit bases and quals into the padded
+    ``[R, stride]`` tiles ops/seq_pallas consumes (same stride/truncation
+    convention as the host packer ``decode_span_payload_host``).
+
+    Returns (cols, seq, qual, valid, n_all, tail, bad); ``bad`` also
+    folds in the payload-bounds fault the host walker raises as
+    ``ValueError("malformed BAM record chain")`` — a record whose seq or
+    qual section overruns its own block_size."""
+    B, P = tokens.shape
+    R = records_cap(B, P)
+    blk_bytes = resolve_tokens(tokens, n_tokens, P)
+    buf, total = _pack_contiguous(blk_bytes, isize)
+    offs, n_all, tail, bad = _walk_records_device(buf, total, start, stop, R)
+    L = B * P
+    idx = jnp.clip(
+        offs[:, None] + jnp.arange(PREFIX, dtype=jnp.int32)[None, :],
+        0, L - 1)
+    cols = unpack_fixed_fields_tile(buf[idx])
+    valid = jnp.arange(R, dtype=jnp.int32) < jnp.minimum(n_all, R)
+    l_seq = cols["l_seq"]
+    seq_off = offs + PREFIX + cols["l_read_name"] + 4 * cols["n_cigar"]
+    nb = (jnp.maximum(l_seq, 0) + 1) // 2
+    pay_bad = valid & (
+        (l_seq < 0)
+        | ((seq_off - offs) + nb + jnp.maximum(l_seq, 0)
+           > 4 + cols["block_size"]))
+    bad = jnp.maximum(bad, jnp.any(pay_bad).astype(jnp.int32))
+    use = jnp.where(valid, jnp.clip(l_seq, 0, max_len), 0)
+    half = (use + 1) // 2
+    js = jnp.arange(seq_stride, dtype=jnp.int32)[None, :]
+    seq = jnp.where(
+        js < half[:, None],
+        buf[jnp.clip(seq_off[:, None] + js, 0, L - 1)], jnp.uint8(0))
+    jq = jnp.arange(qual_stride, dtype=jnp.int32)[None, :]
+    qual = jnp.where(
+        jq < use[:, None],
+        buf[jnp.clip(seq_off[:, None] + nb[:, None] + jq, 0, L - 1)],
+        jnp.uint8(0))
+    return cols, seq, qual, valid, n_all, tail, bad
+
+
+@functools.partial(jax.jit, static_argnames=("cigar_cap",))
+def resolve_walk_intervals(tokens: jax.Array, n_tokens: jax.Array,
+                           isize: jax.Array, start: jax.Array,
+                           stop: jax.Array,
+                           cigar_cap: int = DEVICE_TILE_CIGAR_CAP):
+    """Device decode step for the serve-tile family: resolve + pack +
+    record walk + the (rid, pos1, end1) interval columns the tile filter
+    consumes, with end1 derived from an on-device CIGAR walk.
+
+    Mirrors the host chunk decode (query/engine._decode_bam_chunk +
+    formats/bam.BamBatch.reference_span): reference span sums the op
+    lengths of M/D/N/=/X ops; '*'-CIGAR records fall back to l_seq;
+    pos1/end1 are 1-based and clamped to int32 max.  Records with more
+    than ``cigar_cap`` CIGAR ops raise the ``over`` flag — the driver
+    falls back to the host build for the whole chunk rather than serve a
+    wrong end1.
+
+    Returns (rid, pos1, end1, n_all, tail, bad, over); rows past the
+    owned count hold the tile pad values (rid -1, pos1/end1 0)."""
+    B, P = tokens.shape
+    R = records_cap(B, P)
+    blk_bytes = resolve_tokens(tokens, n_tokens, P)
+    buf, total = _pack_contiguous(blk_bytes, isize)
+    offs, n_all, tail, bad = _walk_records_device(buf, total, start, stop, R)
+    L = B * P
+    idx = jnp.clip(
+        offs[:, None] + jnp.arange(PREFIX, dtype=jnp.int32)[None, :],
+        0, L - 1)
+    cols = unpack_fixed_fields_tile(buf[idx])
+    valid = jnp.arange(R, dtype=jnp.int32) < jnp.minimum(n_all, R)
+    n_cigar = cols["n_cigar"]
+    l_seq = cols["l_seq"]
+    over = jnp.any(valid & (n_cigar > cigar_cap)).astype(jnp.int32)
+    cig_off = offs + PREFIX + cols["l_read_name"]
+    k = jnp.arange(cigar_cap, dtype=jnp.int32)[None, :]
+    widx = cig_off[:, None] + 4 * k
+    b0 = buf[jnp.clip(widx, 0, L - 1)].astype(jnp.uint32)
+    b1 = buf[jnp.clip(widx + 1, 0, L - 1)].astype(jnp.uint32)
+    b2 = buf[jnp.clip(widx + 2, 0, L - 1)].astype(jnp.uint32)
+    b3 = buf[jnp.clip(widx + 3, 0, L - 1)].astype(jnp.uint32)
+    word = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+    op = (word & 0xF).astype(jnp.int32)
+    oplen = (word >> 4).astype(jnp.int32)
+    consumes = ((op == 0) | (op == 2) | (op == 3) | (op == 7) | (op == 8))
+    act = k < jnp.minimum(n_cigar, cigar_cap)[:, None]
+    cig_span = jnp.sum(jnp.where(act & consumes, oplen, 0), axis=1)
+    ref_span = jnp.where(n_cigar > 0, cig_span, jnp.maximum(l_seq, 0))
+    imax = jnp.int32(2**31 - 1)
+    pos1 = jnp.minimum(cols["pos"], imax - 1) + 1
+    end1 = pos1 + jnp.minimum(jnp.maximum(ref_span, 1) - 1, imax - pos1)
+    rid = jnp.where(valid, cols["refid"], -1)
+    pos1 = jnp.where(valid, pos1, 0)
+    end1 = jnp.where(valid, end1, 0)
+    return rid, pos1, end1, n_all, tail, bad, over
+
+
+@jax.jit
+def variant_prefix_device(buf: jax.Array, starts: jax.Array):
+    """BCF fixed-prefix gather riding a resolved-bytes device buffer:
+    [L] u8 + [R] i32 record starts -> (chrom [R] i32, pos [R] i32,
+    1-based).  The same little-endian assembly formats/bcf_columns
+    applies to bytes 8..32 of each record (the 24-byte core after the
+    two length words); rows whose start is a pad (< 0) gather at 0 and
+    are masked by the caller's valid count."""
+    L = buf.shape[0]
+    idx = jnp.clip(
+        starts[:, None] + jnp.arange(8, 32, dtype=jnp.int32)[None, :],
+        0, L - 1)
+    tile = buf[idx].astype(jnp.uint32)
+
+    def _i32(o):
+        return (tile[:, o] | (tile[:, o + 1] << 8) | (tile[:, o + 2] << 16)
+                | (tile[:, o + 3] << 24)).astype(jnp.int32)
+
+    return _i32(0), _i32(4) + 1
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("width", "count", "n_sample"))
+def variant_gt_dosage_device(buf: jax.Array, gt_off: jax.Array,
+                             width: int, count: int, n_sample: int):
+    """Grouped GT gather -> per-sample ALT dosage for one (int width,
+    ploidy, n_sample) combo, on device: [L] u8 buffer + [R2] i32 GT data
+    offsets -> [R2, n_sample] i8 dosage.
+
+    Byte-for-byte the formats/bcf_columns._decode_columns GT semantics:
+    little-endian sign-extended ints, END_OF_VECTOR sentinel trims
+    ploidy, any MISSING allele (or allele value 0) makes the call
+    missing (-1), otherwise dosage = count of ALT alleles, saturated at
+    127.  One jit entry per combo — combos are a property of the file's
+    FORMAT layout, stable across spans."""
+    L = buf.shape[0]
+    R2 = gt_off.shape[0]
+    nbytes = width * count * n_sample
+    idx = jnp.clip(
+        gt_off[:, None] + jnp.arange(nbytes, dtype=jnp.int32)[None, :],
+        0, L - 1)
+    raw = buf[idx].astype(jnp.uint32).reshape(R2, n_sample, count, width)
+    shifts = (jnp.arange(width, dtype=jnp.uint32) * 8)[None, None, None, :]
+    w = jnp.sum(raw << shifts, axis=-1, dtype=jnp.uint32)
+    if width < 4:
+        sbit = jnp.uint32(1 << (8 * width - 1))
+        w = w & jnp.uint32((1 << (8 * width)) - 1)
+        g = (w ^ sbit).astype(jnp.int32) - sbit.astype(jnp.int32)
+    else:
+        g = w.astype(jnp.int32)
+    missing_val = -(1 << (8 * width - 1))
+    present = g != (missing_val + 1)          # END_OF_VECTOR sentinel
+    miss = present & (((g >> 1) == 0) | (g == missing_val))
+    alt = present & (((g >> 1) - 1) > 0)
+    d = jnp.where(
+        jnp.any(present, axis=2) & ~jnp.any(miss, axis=2),
+        jnp.sum(alt.astype(jnp.int32), axis=2), -1)
+    return jnp.minimum(d, 127).astype(jnp.int8)
+
+
 def inflate_span_device(raw: bytes, table: Optional[dict] = None,
                         chunk: int = 64, n_threads: int = 0,
                         check_crc: bool = False

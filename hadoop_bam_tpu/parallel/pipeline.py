@@ -1371,11 +1371,13 @@ def iter_payload_tile_groups(path: str, spans: Sequence[FileVirtualSpan],
     pool = decode_pool(config)
     window = max(1, prefetch) * decode_pool_size(config)
 
-    # the ONE routing decision (plan/executor.py): the payload family
-    # has no device plane (seq/qual are variable-length series the
-    # token-feed step doesn't pack), so "device" rides the host planes
-    # here, "zlib"/"native" are honored as asked, and chunk streaming
-    # follows the shared fused-stream gate
+    # the ONE routing decision (plan/executor.py), consumed here only
+    # for host_backend and fused streaming: the payload family's DEVICE
+    # route lives in _seq_stats_impl (which never reaches this
+    # generator on the device plane) — tensor_batches consumers always
+    # materialize host row tiles, so "device" rides the host planes in
+    # this generator, "zlib"/"native" are honored as asked, and chunk
+    # streaming follows the shared fused-stream gate
     decision = select_plane(SourceIR(path, "bam"), PAYLOAD_DAG, config,
                             intervals=intervals)
     host_backend = decision.host_backend
@@ -1855,6 +1857,36 @@ def _seq_stats_impl(path: str, mesh: Optional[Mesh] = None,
     assert cap % geometry.block_n == 0
     if header is None:
         header, _ = read_bam_header(path)
+
+    # the same plane wrapper as _flagstat_impl: THE routing decision
+    # (plan/executor.select_plane) with the ladder consulted last, the
+    # device plane tried first when selected, and demotable device
+    # faults falling through to the host path below with oracle-
+    # confirmed blame recorded only after the host run completes
+    intervals = parse_config_intervals(config, header)
+    ladder = decode_ladder(path, resolve_inflate_backend(config), config) \
+        if config.adaptive_planes else None
+    device_blame: Optional[BaseException] = None
+    decision = select_plane(SourceIR(path, "bam"), PAYLOAD_DAG, config,
+                            intervals=intervals, ladder=ladder)
+    if decision.plane == "device":
+        check_quarantine_gate(path, config)
+        try:
+            out = _seq_stats_device_plane(path, mesh, config, header,
+                                          geometry, spans, quarantine,
+                                          prefetch=prefetch)
+            if ladder is not None:
+                ladder.record_success("device")
+            quarantine_run_ok(path, config)
+            return out
+        except Exception as e:  # noqa: BLE001 — plane policy boundary
+            if ladder is None or not ladder.demotable("device", e):
+                raise
+            logger.warning("device decode plane failed (%s: %s); "
+                           "demoting to the host planes for %s",
+                           type(e).__name__, e, path)
+            device_blame = e
+
     if spans is None:
         span_bytes = 8 << 20
         src = as_byte_source(path)
@@ -1884,7 +1916,13 @@ def _seq_stats_impl(path: str, mesh: Optional[Mesh] = None,
             path, spans, geometry, n_dev, config, prefetch, header=header,
             quarantine=quarantine, balance=True, emit_fn=emit):
         pass
-    return _attach_quarantine(_payload_stats_result(totals), quarantine)
+    result = _attach_quarantine(_payload_stats_result(totals), quarantine)
+    if ladder is not None and device_blame is not None:
+        # oracle confirmation: the host planes completed where the
+        # device plane failed — blame the device domain (opens its
+        # breaker after repeated confirmations)
+        ladder.confirm_failure("device", device_blame)
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -2277,6 +2315,248 @@ def _flagstat_device_plane(path: str, mesh: Mesh, config: HBamConfig,
     return _attach_quarantine(
         {k: int(host[i]) for i, k in enumerate(FLAGSTAT_FIELDS)},
         quarantine)
+
+
+def make_device_seq_stats_step(mesh: Mesh, geometry: PayloadGeometry,
+                               axis: str = "data") -> Callable:
+    """Jitted sharded step over token chunks for the payload family:
+    (tokens [n, B, P] u32, n_tokens [n, B], isize [n, B], meta [n, 1, 2])
+    -> (psum'd f32 [2] / i32 [1+16] payload stat sums, per-device
+    n_all / tail / bad).  Resolve + pack + record walk + segmented
+    seq/qual gather + the fused Pallas payload kernel, all in one jitted
+    call — the inflated bytes and the payload tiles never exist on the
+    host."""
+    key = ("device_seq_stats", tuple(mesh.devices.flat), mesh.axis_names,
+           axis, geometry)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+
+    from hadoop_bam_tpu.ops.inflate_device import resolve_walk_payload
+    from hadoop_bam_tpu.ops.seq_pallas import seq_qual_stats
+
+    interpret = mesh.devices.flat[0].platform != "tpu"
+
+    def per_device(tokens, n_tokens, isize, meta):
+        tokens, n_tokens = tokens[0], n_tokens[0]
+        isize, meta = isize[0], meta[0]
+        cols, seq, qual, valid, n_all, tail, bad = resolve_walk_payload(
+            tokens, n_tokens, isize, meta[0, 0], meta[0, 1],
+            max_len=geometry.max_len, seq_stride=geometry.seq_stride,
+            qual_stride=geometry.qual_stride)
+        # same length rule as make_seq_stats_step (clipped low too: a
+        # corrupt negative l_seq must not reach the Pallas grid — the
+        # drain raises on the walk's bad flag before stats are used)
+        lengths = jnp.where(
+            valid, jnp.clip(cols["l_seq"], 0, geometry.max_len), 0)
+        # records_cap is a pow2 >= 16, block_n a pow2, so min divides
+        stats = seq_qual_stats(
+            seq, qual, lengths,
+            block_n=min(geometry.block_n, seq.shape[0]),
+            interpret=interpret)
+        fvec, ivec = _payload_stats_tail(stats, valid, axis)
+        return fvec, ivec, n_all[None], tail[None], bad[None]
+
+    fn = shard_map(per_device, mesh=mesh, in_specs=(P(axis),) * 4,
+                   out_specs=(P(), P(), P(axis), P(axis), P(axis)),
+                   check_vma=False)
+    step = jax.jit(fn)
+    _STEP_CACHE[key] = step
+    return step
+
+
+def _seq_stats_device_plane(path: str, mesh: Mesh, config: HBamConfig,
+                            header: SAMHeader,
+                            geometry: PayloadGeometry,
+                            spans: Optional[Sequence[FileVirtualSpan]],
+                            quarantine: Optional[QuarantineManifest],
+                            prefetch: int = 2) -> Dict[str, object]:
+    """seq_stats through the token-feed device decode plane — the same
+    overlap structure as ``_flagstat_device_plane`` (pool tokenize of
+    group k+1 under device resolve of group k, StagingRing in-flight
+    handles, one bulk scalar drain, host fixups for cut tails and
+    over-wide spans), with the payload step in place of the flagstat
+    reduce."""
+    from hadoop_bam_tpu.ops.inflate_device import records_cap
+    from hadoop_bam_tpu.ops.rans import _round_pow2
+    from hadoop_bam_tpu.utils import native
+    from hadoop_bam_tpu.utils.errors import CorruptDataError
+
+    if not native.available():
+        raise PlanError(
+            "inflate_backend='device' needs the native tokenizer "
+            "(hbam_deflate_tokenize_batch); native library unavailable")
+    n_dev = int(np.prod(mesh.devices.shape))
+    if spans is None:
+        src0 = as_byte_source(path)
+        n_spans = max(n_dev, int(np.ceil(src0.size
+                                         / DEVICE_PLANE_SPAN_BYTES)))
+        src0.close()
+        from hadoop_bam_tpu.split.planners import plan_spans_cached
+        with METRICS.span("bam.plan_wall", spans=n_spans):
+            spans = plan_spans_cached(path, header, config,
+                                      num_spans=n_spans)
+    spans = list(spans)
+    if quarantine is not None and quarantine.total_spans is None:
+        quarantine.total_spans = len(spans)
+    check_crc = bool(config.check_crc)
+    step = make_device_seq_stats_step(mesh, geometry)
+    sharding = NamedSharding(mesh, P("data"))
+    src = _resilient_source(path, config)
+    pool = decode_pool(config)
+    window = max(1, prefetch) * decode_pool_size(config)
+    ring_slots = int(config.feed_ring_slots)
+    ring_state: Dict[str, object] = {"ring": None, "B": 0, "P": 0}
+    cancel = threading.Event()
+    totals = _StatTotals()
+    pending: List[Tuple] = []          # (handles, chunks, records cap)
+
+    def get_ring(B: int, Pg: int) -> StagingRing:
+        ring = ring_state["ring"]
+        if ring is not None and B <= ring_state["B"] \
+                and Pg <= ring_state["P"]:
+            return ring
+        if ring is not None:
+            for slot in ring.slots:
+                if slot.in_flight is not None:
+                    _block_in_flight(slot.in_flight)
+                    slot.in_flight = None
+        ring_state["B"] = max(B, int(ring_state["B"]))
+        ring_state["P"] = max(Pg, int(ring_state["P"]))
+        ring_state["ring"] = StagingRing(
+            n_dev, int(ring_state["B"]),
+            [TileSpec((int(ring_state["P"]),), np.uint32),  # tokens
+             TileSpec((), np.int32),                        # n_tokens
+             TileSpec((), np.int32),                        # isize
+             TileSpec((2,), np.int32)],          # row 0: (start, stop)
+            slots=ring_slots)
+        return ring_state["ring"]
+
+    def decode(span):
+        def inner(s):
+            return _tokenize_span_tokens(src, s, check_crc)
+        with METRICS.timer("pipeline.host_decode"), \
+                METRICS.wall_timer("pipeline.host_decode_wall"), \
+                METRICS.span("bam.host_decode_wall"):
+            return decode_with_retry(inner, span, config,
+                                     quarantine=quarantine)
+
+    def dispatch_group(group: List[_TokenChunk]) -> None:
+        B = max(_round_pow2(c.used, 8) for c in group)
+        Pg = max(c.P for c in group)
+        slot = get_ring(B, Pg).lease(cancel)
+        if slot.in_flight is not None:
+            with METRICS.timer("pipeline.device_inflate"), \
+                    METRICS.span("bam.device_resolve_wall", wait=True), \
+                    METRICS.span("staging.transfer_wait"):
+                _block_in_flight(slot.in_flight)
+            slot.in_flight = None
+        tok, nt, isz, meta = slot.arrays
+        for dev in range(n_dev):
+            if dev < len(group):
+                c = group[dev]
+                tok[dev, :c.used, :c.P] = c.tokens
+                nt[dev, :c.used] = c.n_tokens
+                isz[dev, :c.used] = c.isize
+                if c.used < B:
+                    nt[dev, c.used:B] = 0
+                    isz[dev, c.used:B] = 0
+                meta[dev, 0, 0] = c.start
+                meta[dev, 0, 1] = c.stop
+            else:
+                nt[dev, :B] = 0
+                isz[dev, :B] = 0
+                meta[dev, 0] = 0
+        views = (tok[:, :B, :Pg], nt[:, :B], isz[:, :B], meta[:, :1])
+        chaos.fire("device.step", blocks=int(sum(c.used for c in group)))
+        with METRICS.timer("pipeline.device_inflate"), \
+                METRICS.span("bam.device_resolve_wall",
+                             blocks=int(sum(c.used for c in group))):
+            args = [jax.device_put(v, sharding) for v in views]
+            fvec, ivec, n_all, tails, bad = step(*args)
+            totals.add(fvec, ivec)
+        METRICS.count("pipeline.dispatch_bytes",
+                      sum(int(v.nbytes) for v in views))
+        # in-flight carries the step OUTPUTS: CPU device_put may
+        # zero-copy alias the contiguous ring-prefix views (see
+        # _flagstat_device_plane's dispatch for the full story)
+        slot.in_flight = (tuple(args), (fvec, ivec, n_all, tails, bad))
+        slot.release()
+        pending.append(((n_all, tails, bad), list(group),
+                        records_cap(B, Pg)))
+
+    group: List[_TokenChunk] = []
+    try:
+        for chunk in _iter_windowed(pool, spans, decode, window,
+                                    config=config):
+            if chunk is None:
+                continue
+            group.append(chunk)
+            if len(group) == n_dev:
+                dispatch_group(group)
+                group = []
+        if group:
+            dispatch_group(group)
+    finally:
+        cancel.set()
+
+    with METRICS.timer("pipeline.device_inflate"), \
+            METRICS.span("bam.device_resolve_wall", drain=True):
+        fetched = jax.device_get([p[0] for p in pending]) if pending \
+            else []
+    fix_spans: List[FileVirtualSpan] = []
+    n_records = 0
+    for (n_all, tails, bad), chunks, rec_cap in (
+            (f, p[1], p[2]) for f, p in zip(fetched, pending)):
+        for dev, c in enumerate(chunks):
+            if int(bad[dev]):
+                raise CorruptDataError(
+                    f"malformed BAM record chain in span {c.span}")
+            if int(n_all[dev]) > rec_cap:
+                raise CorruptDataError(
+                    f"record count {int(n_all[dev])} exceeds capacity "
+                    f"{rec_cap} in span {c.span}")
+            n_records += int(n_all[dev])
+            tail = int(tails[dev])
+            if tail < c.stop or c.used < c.n_blocks:
+                fix_spans.append(c.fixup_span(tail))
+    METRICS.count("pipeline.records", n_records)
+
+    if fix_spans:
+        # host fixup: cut/remainder records go through the ordinary
+        # payload host packer and the cached host payload step — the
+        # same stats semantics, so totals merge exactly
+        widths = (PREFIX, geometry.seq_stride, geometry.qual_stride)
+        host_step = make_seq_stats_step(mesh, geometry)
+
+        def fix_rows():
+            for fs in fix_spans:
+                def inner(s):
+                    return decode_span_payload_host(
+                        src, s, geometry, check_crc, "auto",
+                        header=header, config=config)[:3]
+                with METRICS.timer("pipeline.host_decode"), \
+                        METRICS.wall_timer("pipeline.host_decode_wall"), \
+                        METRICS.span("bam.host_decode_wall"):
+                    out = decode_with_retry(inner, fs, config,
+                                            quarantine=quarantine)
+                yield out if out is not None else tuple(
+                    np.empty((0, w), np.uint8) for w in widths)
+
+        fp = FeedPipeline(n_dev, geometry.tile_records,
+                          [TileSpec((w,), np.uint8) for w in widths],
+                          block_n=geometry.block_n, balance=True,
+                          config=config, fmt="bam")
+
+        def fix_dispatch(arrays, counts):
+            args = [jax.device_put(a, sharding) for a in arrays]
+            cc = jax.device_put(counts, sharding)
+            with METRICS.span("bam.kernel_wall"):
+                totals.add(*host_step(*args, cc))
+            return (*args, cc)
+
+        fp.feed(fix_rows(), fix_dispatch)
+
+    return _attach_quarantine(_payload_stats_result(totals), quarantine)
 
 
 def flagstat_file(path: str, mesh: Optional[Mesh] = None,

@@ -66,23 +66,52 @@ def seq_stats_plan(path: str, config: Optional[HBamConfig] = None,
         sink=SinkIR.of("seq_stats"))
 
 
-def variant_stats_plan(path: str, geometry=None) -> PlanIR:
+def variant_stats_plan(path: str, config: Optional[HBamConfig] = None,
+                       geometry=None) -> PlanIR:
     """VCF/BCF variant stats: pack (chrom, pos, flags, dosage) tiles,
-    reduce counts + allele frequency + per-sample call rates.  No
-    config parameter: nothing config-derived participates in the
-    variant family's plan identity (no interval gate, no device
-    plane)."""
+    reduce counts + allele frequency + per-sample call rates.
+
+    A BCF source compiled under the device backend routes its unpack
+    through the mesh (``variant_unpack_device``) — and that op is part
+    of the plan IDENTITY: a journaled job compiled for the device route
+    refuses to resume against a host-plane journal and vice versa
+    (``jobs.runner.plan_journal_params``), because the two routes
+    partition work differently (device-plane span grain vs the host
+    span plan)."""
+    from hadoop_bam_tpu.config import resolve_inflate_backend
+
+    cfg = config if config is not None else DEFAULT_CONFIG
     fmt = "bcf" if path.lower().endswith(".bcf") else "vcf"
     params = {}
     if geometry is not None:
         params = dict(n_samples=geometry.n_samples,
                       tile_records=geometry.tile_records)
+    ops = [op_node("variant_pack", **params)]
+    if fmt == "bcf" and resolve_inflate_backend(cfg) == "device":
+        ops.append(op_node("variant_unpack_device"))
+    ops.append(op_node("variant_stats_reduce"))
     return PlanIR(
         source=SourceIR(path, fmt),
         spans=SpansIR.auto(),
-        ops=(op_node("variant_pack", **params),
-             op_node("variant_stats_reduce")),
+        ops=tuple(ops),
         sink=SinkIR.of("variant_stats"))
+
+
+def serve_tile_plan(path: str, kind: str = "bam",
+                    start_voffset: int = 0,
+                    end_voffset: int = 0) -> PlanIR:
+    """One cold serve-tile build: decode a coalesced chunk's virtual-
+    offset range and pack the (rid, pos1, end1) interval tile the
+    region-serve filter consumes (serve/tiles.py).  The serving loop
+    consumes ``select_plane`` on this DAG directly (a tile build is not
+    an executor sink — the loop owns ring/cache placement); the builder
+    exists for the ``hbam explain serve-tile`` surface and the digest
+    contract."""
+    return PlanIR(
+        source=SourceIR(path, kind, role="chunk"),
+        spans=SpansIR.pin([(path, start_voffset, end_voffset)]),
+        ops=(op_node("chunk_decode"), op_node("tile_build")),
+        sink=SinkIR.of("serve_tiles"))
 
 
 def query_chunk_plan(path: str, kind: str, start_voffset: int,

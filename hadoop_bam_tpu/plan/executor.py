@@ -102,20 +102,35 @@ def host_backend_for(config: Optional[HBamConfig]) -> str:
     return "auto" if backend == "device" else backend
 
 
+# which op DAGs the token-feed device plane implements, per source
+# format — THE capability table (ROADMAP item 1).  An op anywhere in the
+# DAG from the format's set marks the whole DAG device-capable; the
+# reduce/sink op is the stable discriminator across the parameterized
+# builder DAGs and the minimal twins below.  Text VCF deliberately has
+# no row: the device plane rides the BGZF token feed, and text variant
+# lines have no gather-shaped record layout to unpack on-mesh.
+_DEVICE_DAGS = {
+    "bam": frozenset({"flagstat_reduce", "seq_stats_reduce",
+                      "tile_build"}),
+    "bcf": frozenset({"variant_unpack_device", "variant_stats_reduce"}),
+}
+
+
 def _device_capable(source: SourceIR, ops: Tuple[TensorOpIR, ...]) -> bool:
-    """Does the token-feed device plane implement this op DAG?  The
-    pilot is BAM flagstat (PR 9); new DAGs earn entries here as the
-    plane generalizes (ROADMAP item 1)."""
-    return (getattr(source, "fmt", None) == "bam"
-            and any(getattr(o, "op", None) == "flagstat_reduce"
-                    for o in ops))
+    """Does the token-feed device plane implement this op DAG?"""
+    fam = _DEVICE_DAGS.get(getattr(source, "fmt", None))
+    if not fam:
+        return False
+    return any(getattr(o, "op", None) in fam for o in ops)
 
 
-# canonical op DAGs of the in-repo BAM scan families (plan/builders.py
+# canonical op DAGs of the in-repo scan/serve families (plan/builders.py
 # carries the fully-parameterized versions; these minimal twins are what
 # the mesh-feed impls pass to select_plane when invoked directly)
 FLAGSTAT_DAG = (op_node("project"), op_node("flagstat_reduce"))
 PAYLOAD_DAG = (op_node("payload_pack"), op_node("seq_stats_reduce"))
+VARIANT_DAG = (op_node("variant_pack"), op_node("variant_stats_reduce"))
+SERVE_TILE_DAG = (op_node("chunk_decode"), op_node("tile_build"))
 
 
 def select_plane(source: SourceIR, ops: Tuple[TensorOpIR, ...],
@@ -163,7 +178,8 @@ def select_plane(source: SourceIR, ops: Tuple[TensorOpIR, ...],
     elif not _device_capable(source, ops):
         rejected.append(
             ("device", "no device decode plane for this op DAG "
-                       "(token-feed pilot: BAM flagstat)"))
+                       "(token-feed families: BAM flagstat/payload/"
+                       "serve-tile, BCF variant)"))
     elif intervals is not None:
         rejected.append(
             ("device", "interval filtering needs whole-span offsets "
@@ -241,9 +257,8 @@ def plane_report(config: Optional[HBamConfig] = None) -> Dict[str, Dict]:
     fams = {
         "flagstat": (SourceIR("<bam>", "bam"), FLAGSTAT_DAG),
         "payload": (SourceIR("<bam>", "bam"), PAYLOAD_DAG),
-        "variant": (SourceIR("<vcf>", "vcf"),
-                    (op_node("variant_pack"),
-                     op_node("variant_stats_reduce"))),
+        "variant": (SourceIR("<bcf>", "bcf"), VARIANT_DAG),
+        "serve": (SourceIR("<bam>", "bam"), SERVE_TILE_DAG),
     }
     return {name: select_plane(src, ops, cfg,
                                intervals=intervals).to_doc()

@@ -443,9 +443,24 @@ def cmd_explain(args) -> int:
     elif args.op == "seq-stats":
         plan = builders.seq_stats_plan(args.path, cfg)
     elif args.op == "vcf-stats":
-        plan = builders.variant_stats_plan(args.path)
+        # cfg matters here: the backend decides whether the BCF device
+        # unpack op joins the DAG (and therefore the digest)
+        plan = builders.variant_stats_plan(args.path, cfg)
     elif args.op == "cohort":
         plan = builders.cohort_plan(args.path, cfg)
+    elif args.op == "serve-tile":
+        if args.region:
+            # the realistic shape: resolve the region through the index
+            # and explain the FIRST coalesced chunk's tile build
+            from hadoop_bam_tpu.query.engine import QueryEngine
+            engine = QueryEngine(config=cfg)
+            meta = engine._file_meta(args.path)
+            _iv, ranges = engine._resolve(meta, args.region)
+            chunks = engine._coalesce(ranges, meta.kind)
+            s, e = chunks[0] if chunks else (0, 0)
+            plan = builders.serve_tile_plan(args.path, meta.kind, s, e)
+        else:
+            plan = builders.serve_tile_plan(args.path)
     else:  # query
         if not args.region:
             raise SystemExit("explain query needs --region")
@@ -1486,12 +1501,13 @@ def build_parser() -> argparse.ArgumentParser:
              "plane decision (which plane, and why each rejected "
              "plane failed its gate)")
     ex.add_argument("op", choices=["flagstat", "seq-stats", "vcf-stats",
-                                   "query", "cohort"])
+                                   "query", "cohort", "serve-tile"])
     ex.add_argument("path", help="input file (BAM/VCF/BCF) or cohort "
                                  "manifest JSON")
     ex.add_argument("--region", default=None,
-                    help="region for `explain query` (resolved through "
-                         "the file's genomic index into pinned chunks)")
+                    help="region for `explain query`/`explain "
+                         "serve-tile` (resolved through the file's "
+                         "genomic index into pinned chunks)")
     ex.add_argument("--intervals", default=None,
                     help="explain with hadoopbam.bam.intervals set "
                          "(gates the device plane and fused streaming)")

@@ -1,0 +1,301 @@
+"""Round-21 device decode plane families: payload (seq_stats), variant
+(BCF stats), and cold serve tiles, all on the token-feed mesh plane.
+
+Three contracts pinned per family (ISSUE round 21 acceptance):
+
+- **parity**: the device route produces value-identical results to the
+  host oracle on clean inputs, and the SAME outcome/error class under
+  byte-flip fuzz, CRC-footer flips, and truncation — never a different
+  answer, never a different failure taxonomy;
+- **demotion**: an injected ``device.step`` chaos fault demotes the run
+  through the PR-11 ladder to a byte-identical host result and charges
+  the device breaker only after the host run completes;
+- **metering**: a cold serve tile built on the device plane does zero
+  host record decode (``pipeline.host_decode_wall`` stays exactly 0).
+"""
+import dataclasses
+import os
+import random
+
+import numpy as np
+import pytest
+
+from hadoop_bam_tpu import resilience
+from hadoop_bam_tpu.config import DEFAULT_CONFIG
+from hadoop_bam_tpu.resilience import OPEN
+from hadoop_bam_tpu.resilience.chaos import PointFault, fault_points_on
+from hadoop_bam_tpu.utils import native
+from hadoop_bam_tpu.utils.errors import CORRUPT, classify_error
+from hadoop_bam_tpu.utils.metrics import MetricsContext
+
+from fixtures import make_header
+
+pytestmark = [
+    pytest.mark.device_inflate,
+    pytest.mark.skipif(not native.available(),
+                       reason="native tokenizer unavailable"),
+]
+
+
+def _dev_cfg(**kw):
+    base = dict(inflate_backend="device", retry_backoff_base_s=0.001,
+                retry_backoff_max_s=0.002)
+    base.update(kw)
+    return dataclasses.replace(DEFAULT_CONFIG, **base)
+
+
+def _host_cfg(**kw):
+    base = dict(retry_backoff_base_s=0.001, retry_backoff_max_s=0.002)
+    base.update(kw)
+    return dataclasses.replace(DEFAULT_CONFIG, **base)
+
+
+@pytest.fixture(scope="module")
+def bam(tmp_path_factory):
+    from test_serve import _write_bam
+
+    path = str(tmp_path_factory.mktemp("devplane") / "p.bam")
+    header = make_header(2)
+    _write_bam(path, header, 1200, seed=29)
+    return path, header
+
+
+@pytest.fixture(scope="module")
+def bcf(tmp_path_factory):
+    from test_bcf_columns import CROSS_LINES, _write_pair
+
+    tmp = tmp_path_factory.mktemp("devvar")
+    _vcf, bcf_path, header, _recs = _write_pair(tmp, CROSS_LINES * 8)
+    return bcf_path, header
+
+
+def _seq_stats(path, config=None):
+    from hadoop_bam_tpu.parallel.pipeline import seq_stats_file
+
+    kw = {"config": config} if config is not None else {}
+    return seq_stats_file(path, **kw)
+
+
+def _variant_stats(path, config=None):
+    from hadoop_bam_tpu.parallel.variant_pipeline import variant_stats_file
+
+    kw = {"config": config} if config is not None else {}
+    return variant_stats_file(path, **kw)
+
+
+def _close(a, b):
+    """Value parity between two stats dicts: counts exact, float
+    reductions within reduce-order jitter (the device plane folds f32
+    tile partials that the host sums in f64 — ~1e-6 relative)."""
+    if set(a) != set(b):
+        return False
+    for k in a:
+        va, vb = a[k], b[k]
+        if isinstance(va, (int, np.integer)):
+            if int(va) != int(vb):
+                return False
+        elif not np.allclose(np.asarray(va, np.float64),
+                             np.asarray(vb, np.float64),
+                             rtol=1e-5, atol=1e-8):
+            return False
+    return True
+
+
+def _outcome(fn):
+    try:
+        return ("ok", fn())
+    except Exception as e:  # noqa: BLE001 — taxonomy-class comparison
+        return ("err", classify_error(e))
+
+
+def _same(host, dev):
+    if host[0] != dev[0]:
+        return False
+    return host[1] == dev[1] if host[0] == "err" else _close(host[1], dev[1])
+
+
+# ---------------------------------------------------------------------------
+# clean parity
+# ---------------------------------------------------------------------------
+
+def test_seq_stats_device_matches_host(bam):
+    path, _h = bam
+    host = _seq_stats(path)
+    dev = _seq_stats(path, _dev_cfg())
+    assert _close(dev, host), (dev, host)
+    assert host["n_reads"] == 1200
+
+
+def test_variant_stats_device_matches_host(bcf):
+    path, _h = bcf
+    host = _variant_stats(path)
+    with MetricsContext() as m:
+        dev = _variant_stats(path, _dev_cfg())
+    assert _close(dev, host), (dev, host)
+    snap = m.snapshot()
+    # whole-span device route: zero host record decode on the clean run
+    assert snap.get("wall_timers", {}).get(
+        "pipeline.host_decode_wall", 0.0) == 0.0
+    assert "vcf.device_resolve_wall" in snap.get("wall_timers", {})
+
+
+# ---------------------------------------------------------------------------
+# byte-flip / CRC-flip / truncation fuzz: same outcome class both planes
+# ---------------------------------------------------------------------------
+
+def _fuzz_family(tmp_path, raw, suffix, run, n_flips, seed):
+    """Flip one byte at a time across the compressed container and run
+    the host and device arms; every position must yield the SAME
+    outcome — same values on success, same taxonomy class on failure.
+    A final truncated arm pins the cut-stream class too."""
+    rng = random.Random(seed)
+    positions = rng.sample(range(len(raw)), n_flips)
+    mismatches = []
+    for pos in positions:
+        bad = bytearray(raw)
+        bad[pos] ^= 0xFF
+        p = str(tmp_path / f"flip{pos}{suffix}")
+        with open(p, "wb") as f:
+            f.write(bytes(bad))
+        host = _outcome(lambda: run(p, _host_cfg()))
+        dev = _outcome(lambda: run(p, _dev_cfg()))
+        if not _same(host, dev):
+            mismatches.append((pos, host, dev))
+    assert not mismatches, mismatches
+    # truncation: cut mid-stream, both planes raise the same class
+    p = str(tmp_path / f"trunc{suffix}")
+    with open(p, "wb") as f:
+        f.write(raw[: len(raw) * 2 // 3])
+    host = _outcome(lambda: run(p, _host_cfg()))
+    dev = _outcome(lambda: run(p, _dev_cfg()))
+    assert _same(host, dev), (host, dev)
+    assert host[0] == "err"
+
+
+def test_payload_byte_flip_fuzz_same_outcome(bam, tmp_path):
+    path, _h = bam
+    raw = open(path, "rb").read()
+    _fuzz_family(tmp_path, raw, ".bam",
+                 lambda p, cfg: _seq_stats(p, cfg), n_flips=8, seed=17)
+
+
+def test_variant_byte_flip_fuzz_same_outcome(bcf, tmp_path):
+    path, _h = bcf
+    raw = open(path, "rb").read()
+    _fuzz_family(tmp_path, raw, ".bcf",
+                 lambda p, cfg: _variant_stats(p, cfg), n_flips=8, seed=19)
+
+
+@pytest.mark.parametrize("family", ["payload", "variant"])
+def test_crc_flip_same_outcome_both_planes(family, bam, bcf, tmp_path):
+    """CRC-footer damage (data bytes intact) keeps the planes in
+    lockstep per family contract: the BAM payload route honors
+    ``check_crc`` on both planes (invisible off, CORRUPT on); the
+    variant route folds CRCs unconditionally on both planes — the host
+    BGZF frame reader always verifies, so the device tokenize-time fold
+    is always on there too."""
+    from hadoop_bam_tpu.ops.inflate import block_table
+
+    path, run = ((bam[0], _seq_stats) if family == "payload"
+                 else (bcf[0], _variant_stats))
+    raw = open(path, "rb").read()
+    table = block_table(raw)
+    # flip the footer of the largest DATA block — block 0 holds the
+    # format header, whose reader folds CRCs unconditionally
+    idx = int(np.argmax(table["cdata_len"]))
+    foot = int(table["cdata_off"][idx] + table["cdata_len"][idx])
+    bad = bytearray(raw)
+    bad[foot] ^= 0xFF
+    p = str(tmp_path / f"crc_{family}")
+    with open(p, "wb") as f:
+        f.write(bytes(bad))
+    host = _outcome(lambda: run(p, _host_cfg()))
+    dev = _outcome(lambda: run(p, _dev_cfg()))
+    if family == "payload":
+        clean = run(path, _host_cfg())
+        assert _same(host, ("ok", clean)) and _same(dev, ("ok", clean))
+    else:
+        assert host == dev == ("err", CORRUPT)
+    host = _outcome(lambda: run(p, _host_cfg(check_crc=True)))
+    dev = _outcome(lambda: run(p, _dev_cfg(check_crc=True)))
+    assert host == dev == ("err", CORRUPT)
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos: every family demotes through the ladder to host parity
+# ---------------------------------------------------------------------------
+
+def test_payload_chaos_demotes_to_host_result(bam):
+    path, _h = bam
+    oracle = _seq_stats(path)
+    cfg = _dev_cfg(breaker_failure_threshold=1.0)
+    with fault_points_on("device.step", [PointFault("transient", count=1)]):
+        faulted = _seq_stats(path, cfg)
+    assert _close(faulted, oracle), (faulted, oracle)
+    key = f"decode/device/{os.path.abspath(path)}"
+    assert resilience.registry().states()[key]["state"] == OPEN
+
+
+def test_variant_chaos_demotes_to_host_result(bcf):
+    path, _h = bcf
+    oracle = _variant_stats(path)
+    cfg = _dev_cfg(breaker_failure_threshold=1.0)
+    with fault_points_on("device.step", [PointFault("transient", count=1)]):
+        faulted = _variant_stats(path, cfg)
+    assert _close(faulted, oracle), (faulted, oracle)
+    key = f"decode/device/{os.path.abspath(path)}"
+    assert resilience.registry().states()[key]["state"] == OPEN
+
+
+def test_serve_chaos_demotes_to_host_tiles(tmp_path):
+    from test_serve import _REGIONS, _oracle_counts, _write_bam
+
+    from hadoop_bam_tpu.serve import ServeLoop
+
+    path = str(tmp_path / "c.bam")
+    _write_bam(path, make_header(2), 2000, seed=31)
+    want, _ = _oracle_counts(path, _REGIONS)
+    cfg = _dev_cfg(serve_prefetch=False, breaker_failure_threshold=1.0)
+    with ServeLoop(config=cfg) as loop:
+        with fault_points_on("device.step",
+                             [PointFault("transient", count=1)]):
+            cold = loop.query(path, _REGIONS)
+        assert [r.count for r in cold] == want
+    key = f"decode/device/{os.path.abspath(path)}"
+    assert resilience.registry().states()[key]["state"] == OPEN
+
+
+# ---------------------------------------------------------------------------
+# cold serve tiles: device-built, zero host decode, warm hits intact
+# ---------------------------------------------------------------------------
+
+def test_serve_cold_device_tiles_zero_host_decode(tmp_path):
+    from test_serve import _REGIONS, _oracle_counts, _write_bam
+
+    from hadoop_bam_tpu.serve import ServeLoop
+
+    path = str(tmp_path / "s.bam")
+    _write_bam(path, make_header(2), 2500, seed=77)
+    want, oracle = _oracle_counts(path, _REGIONS)
+    cfg = _dev_cfg(serve_prefetch=False)
+    with ServeLoop(config=cfg) as loop:
+        with MetricsContext() as m:
+            cold = loop.query(path, _REGIONS)
+        assert [r.count for r in cold] == want
+        snap = m.snapshot()
+        # the round-21 pin: a cold miss on the device tile route does
+        # NO host inflate and NO host record walk at all
+        assert snap.get("wall_timers", {}).get(
+            "pipeline.host_decode_wall", 0.0) == 0.0
+        assert snap["counters"].get("serve.device_tile_builds", 0) > 0
+        assert snap["counters"].get("query.chunks_decoded", 0) == 0
+        # warm pass: resident device tiles serve every region
+        warm = loop.query(path, _REGIONS)
+        assert [r.count for r in warm] == want
+        assert all(r.tile_misses == 0 and r.tile_hits > 0 for r in warm)
+        # records mode stays on the host oracle plane, byte-identical
+        res = loop.query(path, _REGIONS[:2], want_records=True)
+        _, oracle2 = _oracle_counts(path, _REGIONS[:2])
+        for out, w in zip(res, oracle2):
+            assert ([r.to_line() for r in out.records]
+                    == [r.to_line() for r in w.records])

@@ -172,6 +172,55 @@ def test_resume_refuses_changed_input_identity(tmp_path):
                           **hdr)
 
 
+def test_plan_digest_refuses_device_host_route_swap(tmp_path):
+    """The decode ROUTE is plan identity: a journaled job compiled for
+    the BCF device variant route (round 21: ``variant_unpack_device`` in
+    the op DAG) refuses to resume against a host-plane journal, and vice
+    versa — the two routes partition work differently (device-plane span
+    grain vs the host span plan), so silently mixing them would
+    mis-stitch units."""
+    from hadoop_bam_tpu.jobs.runner import plan_journal_params
+    from hadoop_bam_tpu.plan import builders
+
+    bcf = str(tmp_path / "x.bcf")       # builders never open the file
+    host_plan = builders.variant_stats_plan(
+        bcf, dataclasses.replace(DEFAULT_CONFIG,
+                                 inflate_backend="native"))
+    dev_plan = builders.variant_stats_plan(
+        bcf, dataclasses.replace(DEFAULT_CONFIG,
+                                 inflate_backend="device"))
+    assert [o["op"] for o in dev_plan.to_doc()["ops"]] == [
+        "variant_pack", "variant_unpack_device", "variant_stats_reduce"]
+    assert "variant_unpack_device" not in [
+        o["op"] for o in host_plan.to_doc()["ops"]]
+    assert host_plan.digest() != dev_plan.digest()
+
+    jp, inputs, hdr = _mini_job(tmp_path)
+    host_hdr = {**hdr, "params": plan_journal_params(host_plan)}
+    JobJournal.resume(jp, inputs=inputs, **host_hdr)[0].close()
+    with pytest.raises(PlanError, match="refusing to resume"):
+        JobJournal.resume(
+            jp, inputs=inputs,
+            **{**hdr, "params": plan_journal_params(dev_plan)})
+    # and the mirror image: device journal, host resume
+    jp2 = jp + ".dev"
+    dev_hdr = {**hdr, "params": plan_journal_params(dev_plan)}
+    JobJournal.resume(jp2, inputs=inputs, **dev_hdr)[0].close()
+    with pytest.raises(PlanError, match="refusing to resume"):
+        JobJournal.resume(
+            jp2, inputs=inputs,
+            **{**hdr, "params": plan_journal_params(host_plan)})
+    # a text VCF compiles the SAME plan under either backend (no device
+    # row exists for it) — no spurious refusal on a config-only change
+    vcf = str(tmp_path / "x.vcf")
+    assert builders.variant_stats_plan(
+        vcf, dataclasses.replace(DEFAULT_CONFIG,
+                                 inflate_backend="native")).digest() == \
+        builders.variant_stats_plan(
+            vcf, dataclasses.replace(DEFAULT_CONFIG,
+                                     inflate_backend="device")).digest()
+
+
 def test_artifact_verification_and_sweep(tmp_path):
     a = tmp_path / "art1"
     a.write_bytes(b"payload")

@@ -1281,12 +1281,38 @@ def _device_plane_totals(pairs):
 
 
 def test_dv_outside_plane_not_scoped():
-    # same bad source off the device decode plane: silent
+    # same bad source off the device decode plane: silent (serve/loop.py
+    # stays unscoped — its record-filter loop reads per-chunk hit counts
+    # by design; the PLANE files are tiles.py and the pipelines)
     findings = lint_sources(
         {"hadoop_bam_tpu/ops/inflate.py": _DV_BAD,
-         "hadoop_bam_tpu/serve/tiles.py": _DV_BAD},
+         "hadoop_bam_tpu/serve/loop.py": _DV_BAD},
         only=["devicesync"])
     assert findings == []
+
+
+@pytest.mark.parametrize("path", [
+    "hadoop_bam_tpu/parallel/variant_pipeline.py",
+    "hadoop_bam_tpu/serve/tiles.py",
+])
+def test_dv_round21_families_are_scoped(path):
+    # the variant and cold-serve-tile device drivers joined the plane in
+    # round 21: the same seeded violations fire there...
+    findings = lint_sources({path: _DV_BAD}, only=["devicesync"])
+    assert rules_of(findings) == {"DV901"}
+    assert len(findings) == 3
+    # ...and the approved idioms stay silent
+    assert lint_sources({path: _DV_CLEAN}, only=["devicesync"]) == []
+
+
+def test_dv_live_plane_files_are_clean():
+    # the REAL driver sources hold the discipline they are linted for —
+    # baseline stays empty
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parents[1]
+    from hadoop_bam_tpu.analysis.devicesync import SCOPE
+    srcs = {rel: (root / rel).read_text() for rel in SCOPE}
+    assert lint_sources(srcs, only=["devicesync"]) == []
 
 
 # ---------------------------------------------------------------------------

@@ -145,13 +145,77 @@ def test_select_device_rejected_by_skip_bad_spans():
 
 
 def test_select_device_rejected_for_non_device_dag():
-    d = select_plane(_FLAG_SRC, _PAYLOAD_OPS,
+    # the query chunk-columns DAG (chunk_decode alone, host predicate
+    # columns) has no device route
+    d = select_plane(SourceIR("x.bam", "bam", role="chunk"),
+                     (op_node("chunk_decode"),),
                      _cfg(inflate_backend="device"))
     assert d.plane == "native"
     assert "op DAG" in _rejected(d)["device"]
-    # but the payload family keeps fused streaming when eligible
+    # but the non-device planes keep fused streaming when eligible
     from hadoop_bam_tpu.ops.inflate import fused_available
     assert d.stream_fused == fused_available()
+
+
+def test_select_device_families_round21():
+    """The round-21 families pass the device gate: BAM payload, BCF
+    variant, BAM serve-tile — and their near-misses reject with the
+    capability reason."""
+    cfg = _cfg(inflate_backend="device")
+    # payload (seq_stats) on BAM
+    assert select_plane(_FLAG_SRC, _PAYLOAD_OPS, cfg).plane == "device"
+    # variant on BCF
+    vops = (op_node("variant_pack"), op_node("variant_stats_reduce"))
+    assert select_plane(SourceIR("x.bcf", "bcf"), vops,
+                        cfg).plane == "device"
+    # serve-tile on BAM (chunk role)
+    sops = (op_node("chunk_decode"), op_node("tile_build"))
+    assert select_plane(SourceIR("x.bam", "bam", role="chunk"), sops,
+                        cfg).plane == "device"
+    # text VCF deliberately has NO device row: the token feed needs the
+    # BGZF container and the BCF binary layout
+    d = select_plane(SourceIR("x.vcf", "vcf"), vops, cfg)
+    assert d.plane == "native"
+    assert "op DAG" in _rejected(d)["device"]
+    # a CRAM source can never ride the BGZF token feed either
+    d2 = select_plane(SourceIR("x.cram", "cram"), _PAYLOAD_OPS, cfg)
+    assert "op DAG" in _rejected(d2)["device"]
+
+
+@pytest.mark.parametrize("src,ops", [
+    (SourceIR("x.bam", "bam"),
+     (op_node("payload_pack"), op_node("seq_stats_reduce"))),
+    (SourceIR("x.bcf", "bcf"),
+     (op_node("variant_pack"), op_node("variant_stats_reduce"))),
+    (SourceIR("x.bam", "bam", role="chunk"),
+     (op_node("chunk_decode"), op_node("tile_build"))),
+])
+def test_select_round21_families_share_the_gate_matrix(src, ops):
+    """Every new family rejects through the SAME gates as flagstat:
+    intervals, skip_bad_spans, open breaker — reason strings included
+    (the `hbam explain` surface)."""
+    d = select_plane(src, ops, _cfg(inflate_backend="device"),
+                     intervals=[()])
+    assert d.plane != "device"
+    assert "whole-span offsets" in _rejected(d)["device"]
+
+    d = select_plane(src, ops, _cfg(inflate_backend="device",
+                                    skip_bad_spans=True))
+    assert d.plane != "device"
+    assert "quarantine" in _rejected(d)["device"]
+
+    class OpenLadder:
+        def allow_plane(self, plane):
+            return False
+
+    d = select_plane(src, ops, _cfg(inflate_backend="device"),
+                     ladder=OpenLadder())
+    assert d.plane != "device"
+    assert "breaker" in _rejected(d)["device"]
+
+    d = select_plane(src, ops, _cfg(inflate_backend="native"))
+    assert d.plane == "native"
+    assert "inflate_backend" in _rejected(d)["device"]
 
 
 def test_select_device_rejected_by_open_breaker():
@@ -204,10 +268,13 @@ def test_select_fused_off_by_config():
 def test_plane_report_families():
     from hadoop_bam_tpu.plan.executor import plane_report
     rep = plane_report(_cfg(inflate_backend="native"))
-    assert set(rep) == {"flagstat", "payload", "variant"}
+    assert set(rep) == {"flagstat", "payload", "variant", "serve"}
     for fam in rep.values():
         assert fam["plane"] in ("device", "native", "zlib")
         assert isinstance(fam["rejected"], dict)
+    # under the device backend every family routes device
+    dev = plane_report(_cfg(inflate_backend="device"))
+    assert all(f["plane"] == "device" for f in dev.values())
 
 
 # ---------------------------------------------------------------------------
