@@ -2179,6 +2179,75 @@ def bench_sort_write(path: str):
                      "byte identity, not a ratio")}
 
 
+def bench_mkdup(path: str):
+    """Fused preprocessing row (prep/): read -> mesh sort exchange ->
+    markdup -> indexed write as ONE pass (`hbam mkdup`) vs the staged
+    equivalent (mesh sort to disk, then the serial markdup oracle
+    re-reading it).  Value is output MB/s of the fused arm;
+    ``stage_wall_shares`` splits its wall across the three stage spans;
+    the identity flag byte-compares the fused output against the serial
+    oracle run on the SAME input (the prep/ validation contract —
+    staged-arm bytes can differ on score ties, its input order is
+    already sorted)."""
+    import shutil
+    import tempfile
+
+    from hadoop_bam_tpu.parallel.mesh_sort import sort_bam_mesh
+    from hadoop_bam_tpu.prep import markdup_bam_mesh, markdup_bam_oracle
+    from hadoop_bam_tpu.utils.metrics import MetricsContext
+
+    n_slice = min(BENCH_RECORDS, int(os.environ.get("BENCH_SORT_RECORDS",
+                                                    "100000")))
+    src = os.path.join(BENCH_DIR, f"bench_sort_{n_slice}.bam")
+    if not os.path.exists(src):
+        bench_sort(path)                 # builds the shuffled fixture
+    tmp = tempfile.mkdtemp(prefix="hbam_bench_mkdup_")
+    try:
+        fused_out = os.path.join(tmp, "fused.bam")
+        with MetricsContext() as m:
+            def fused_run():
+                return markdup_bam_mesh(src, fused_out)
+            n, dt = _median_time(fused_run)
+        snap = m.snapshot()
+        dups = int(snap["counters"].get("prep.duplicates_marked", 0))
+        runs = _MEDIAN_REPS + 1
+        shares = {
+            stage: round(min(1.0, float(
+                snap["wall_timers"].get(f"prep.{stage}_wall", 0.0))
+                / runs / max(dt, 1e-9)), 4)
+            for stage in ("sort", "markdup", "write")}
+
+        sorted_out = os.path.join(tmp, "sorted.bam")
+        staged_out = os.path.join(tmp, "staged.bam")
+
+        def staged_run():
+            sort_bam_mesh(src, sorted_out)
+            return markdup_bam_oracle(sorted_out, staged_out)
+        bn, bdt = _median_time(staged_run)
+        assert n == bn
+
+        oracle_out = os.path.join(tmp, "oracle.bam")
+        markdup_bam_oracle(src, oracle_out)
+        identical = open(fused_out, "rb").read() == open(
+            oracle_out, "rb").read()
+        out_bytes = os.path.getsize(fused_out)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    meas = out_bytes / dt / 1e6
+    base = out_bytes / bdt / 1e6
+    return {"metric": "mkdup_mb_per_sec",
+            "value": round(meas, 2), "unit": "MB/s",
+            "vs_staged": round(meas / base, 3),
+            "staged_mb_per_sec": round(base, 2),
+            "stage_wall_shares": shares,
+            "records": int(n), "duplicates_marked": dups // runs,
+            "output_bytes": int(out_bytes),
+            "byte_identical_to_oracle": bool(identical),
+            "note": ("fused read->sort->markdup->write vs staged "
+                     "sort-to-disk + serial oracle; identity pinned "
+                     "vs the oracle on the same input")}
+
+
 _RESUME_KILL_CHILD = """
 import os, signal, sys
 os.environ.pop("JAX_PLATFORMS", None)
@@ -2961,6 +3030,8 @@ def main() -> None:
                    est_s=75)
     _run_component(lambda: bench_sort_write(path), "sort_write_mb_per_sec",
                    est_s=40)
+    _run_component(lambda: bench_mkdup(path), "mkdup_mb_per_sec",
+                   est_s=55)
 
     # the scaling curve outranks the single-kernel rows (VERDICT r4 #3)
     if _remaining() > 70:

@@ -35,7 +35,8 @@ from typing import Iterator, List
 
 from hadoop_bam_tpu.analysis.core import Finding, Project, register
 
-SCOPE = ("hadoop_bam_tpu/write", "hadoop_bam_tpu/parallel/mesh_sort.py")
+SCOPE = ("hadoop_bam_tpu/write", "hadoop_bam_tpu/parallel/mesh_sort.py",
+         "hadoop_bam_tpu/prep")
 
 _RENAME_CALLS = {"replace", "rename", "renames", "link", "symlink"}
 _BLESSED_FNS = {"_publish", "open_shard"}

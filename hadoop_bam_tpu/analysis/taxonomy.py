@@ -60,6 +60,11 @@ SCOPE = (
     "hadoop_bam_tpu/cohort/manifest.py",
     "hadoop_bam_tpu/cohort/join.py",
     "hadoop_bam_tpu/cohort/serving.py",
+    # ISSUE 20: the fused preprocessing plane — oracle, device kernels,
+    # and pipeline all classify faults for retry/quarantine policy
+    "hadoop_bam_tpu/prep/oracle.py",
+    "hadoop_bam_tpu/prep/markdup.py",
+    "hadoop_bam_tpu/prep/pipeline.py",
 )
 
 _BARE = {

@@ -40,7 +40,8 @@ from hadoop_bam_tpu.analysis.core import Finding, Project, register
 
 SCOPE = ("hadoop_bam_tpu/serve", "hadoop_bam_tpu/parallel",
          "hadoop_bam_tpu/write", "hadoop_bam_tpu/jobs",
-         "hadoop_bam_tpu/resilience", "hadoop_bam_tpu/utils/pools.py")
+         "hadoop_bam_tpu/resilience", "hadoop_bam_tpu/utils/pools.py",
+         "hadoop_bam_tpu/prep")
 
 
 def _roots_phrase(names: List[str]) -> str:

@@ -151,6 +151,18 @@ def _resume_replayed(journal_path: str, config, state, kind: str) -> Dict:
             round_records=params.get("round_records"),
             journal_path=journal_path)
         return {"kind": kind, "output": params["output"], "records": n}
+    if kind == "mkdup":
+        from hadoop_bam_tpu.prep.pipeline import markdup_bam_mesh
+
+        n = markdup_bam_mesh(
+            params["input"], params["output"],
+            config=config,
+            remove_duplicates=bool(params.get("remove_duplicates",
+                                              False)),
+            library_from=params.get("library_from", "none"),
+            round_records=params.get("round_records"),
+            journal_path=journal_path)
+        return {"kind": kind, "output": params["output"], "records": n}
     if kind == "cohort_join":
         from hadoop_bam_tpu.cohort.dataset import open_cohort
 
@@ -174,7 +186,7 @@ def _resume_replayed(journal_path: str, config, state, kind: str) -> Dict:
     raise PlanError(
         f"journal {journal_path} records job kind {kind!r}, which has "
         f"no CLI resume driver (resumable kinds: mesh_sort_spill, "
-        f"mesh_sort, cohort_join)")
+        f"mesh_sort, mkdup, cohort_join)")
 
 
 # ---------------------------------------------------------------------------
@@ -201,6 +213,7 @@ class JobInfo:
 RESUME_GRAINS = {
     "mesh_sort_spill": "round",
     "mesh_sort": "job",
+    "mkdup": "round",
     "cohort_join": "chunk",
     "shard_write": "part",
 }

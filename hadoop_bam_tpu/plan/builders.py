@@ -138,6 +138,28 @@ def query_region_plan(path: str, kind: str, region: str,
         sink=SinkIR.of("chunk_columns"))
 
 
+def mkdup_plan(input_path: str, output_path: str,
+               config: Optional[HBamConfig] = None, *,
+               remove_duplicates: bool = False,
+               library_from: str = "none") -> PlanIR:
+    """The fused preprocessing pipeline (prep/): decode -> mesh sort
+    exchange -> duplicate marking -> flag-patched indexed write, as ONE
+    plan — records never re-inflate between the ops.
+
+    The output-affecting markdup options ride the op node (they are
+    part of the plan digest the journal refuses to resume across);
+    the output path is the sink's identity."""
+    return PlanIR(
+        source=SourceIR(input_path, "bam"),
+        spans=SpansIR.auto(span_bytes=PAYLOAD_SPAN_BYTES),
+        ops=(op_node("sort_exchange"),
+             op_node("markdup",
+                     remove_duplicates=bool(remove_duplicates),
+                     library_from=library_from),
+             op_node("flag_patch_write")),
+        sink=SinkIR.of("bam_file", path=os.path.abspath(output_path)))
+
+
 def cohort_plan(manifest, config: Optional[HBamConfig] = None,
                 geometry=None) -> PlanIR:
     """Cohort tensor batches: k single-sample call sets k-way

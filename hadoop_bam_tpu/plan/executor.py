@@ -304,10 +304,13 @@ def _runner_for(plan: PlanIR):
         return _run_chunk_columns
     if kind == "tensor_batches" and plan.source.role == "join":
         return _run_cohort_batches
+    if kind == "bam_file":
+        return _run_mkdup
     raise PlanError(
         f"no executor runner for sink {kind!r} "
         f"(source role {plan.source.role!r}) — known sinks: flagstat, "
-        f"seq_stats, variant_stats, chunk_columns, join/tensor_batches")
+        f"seq_stats, variant_stats, chunk_columns, join/tensor_batches, "
+        f"bam_file")
 
 
 def _run_flagstat(plan: PlanIR, cfg: HBamConfig, kw: Dict):
@@ -337,6 +340,23 @@ def _run_variant_stats(plan: PlanIR, cfg: HBamConfig, kw: Dict):
         plan.source.path, mesh=kw.get("mesh"), config=cfg,
         geometry=kw.get("geometry"), header=kw.get("header"),
         spans=kw.get("spans"), prefetch=kw.get("prefetch", 2))
+
+
+def _run_mkdup(plan: PlanIR, cfg: HBamConfig, kw: Dict):
+    """The fused preprocessing pipeline: the ``bam_file`` sink names the
+    output, the ``markdup`` op node carries the output-affecting
+    options (both under the plan digest the journal pins)."""
+    from hadoop_bam_tpu.prep.pipeline import markdup_bam_mesh
+
+    md = dict(next(op for op in plan.ops if op.op == "markdup").params)
+    sink = dict(plan.sink.params)
+    return markdup_bam_mesh(
+        plan.source.path, sink["path"], mesh=kw.get("mesh"),
+        config=cfg, header=kw.get("header"),
+        remove_duplicates=bool(md.get("remove_duplicates", False)),
+        library_from=md.get("library_from", "none"),
+        round_records=kw.get("round_records"),
+        journal_path=kw.get("journal_path"))
 
 
 def _run_chunk_columns(plan: PlanIR, cfg: HBamConfig, kw: Dict):

@@ -1,0 +1,19 @@
+"""Fused preprocessing plane: mesh duplicate marking.
+
+The last ROADMAP vertical: the NGS preprocessing stages that already
+exist as separate passes (decode planes, mesh sort exchange, the
+parallel indexed writers, journaled resume) composed into ONE pass —
+read -> sort exchange -> markdup -> indexed write — so records never
+re-inflate between stages (sam2bam's fusion argument, PAPERS.md).
+
+- ``oracle`` — the serial host oracle: the ONE definition of the
+  duplicate signature, the best-of-duplicate score, and the flag-patch
+  semantics the mesh path is byte-validated against.
+- ``markdup`` — the device kernels: the fused sort-exchange +
+  signature-column unpack step and the signature-hash markdup exchange.
+- ``pipeline`` — the journaled fused pipeline (``hbam mkdup``), with
+  per-stage resume grains: round (sort spills), markdup (the duplicate
+  bitmap), shard (the indexed write's parts).
+"""
+from hadoop_bam_tpu.prep.oracle import markdup_bam_oracle  # noqa: F401
+from hadoop_bam_tpu.prep.pipeline import markdup_bam_mesh  # noqa: F401

@@ -1,0 +1,336 @@
+"""Serial host markdup oracle — the semantics the mesh path must match.
+
+Duplicate marking here is the Picard-style *ends signature* computed
+from each record's own bytes, with two documented simplifications (both
+mirrored exactly by the device kernel, PARITY.md markdup row):
+
+- the mate key is the raw ``(next_refID, next_pos, mate-reverse)``
+  triple, not the mate's MC-derived unclipped end (no tag round trip);
+- best-of-duplicate selection is per END (each record scored by its own
+  sum of base qualities >= 15), not per pair-sum.
+
+Signature of an ELIGIBLE record (mapped, primary — ``flag & 0x904 ==
+0``): ``(refid, unclipped 5' position, library, orientation/pair-class
+bits, mate key)``.  The unclipped 5' position extends the mapped
+position through the leading (forward strand) or trailing (reverse
+strand) soft/hard clips, so trimmed copies of the same molecule still
+collide.  Within a signature group the winner is the highest score,
+ties broken by the LOWEST global input index — deterministic across
+any shard count or round size.  Every record (eligible or not) gets
+its duplicate flag (0x400) cleared and re-derived; losers are flagged,
+or dropped under ``remove_duplicates``.  Output is coordinate-sorted
+(the mesh pipeline's order) through ``write_bam_records``, sidecars
+included.
+
+Raw-record offsets (block_size-prefixed, see utils/fixmate.py):
+
+    0:4 block_size | 4:8 refID | 8:12 pos | 12 l_read_name | 13 mapq
+    | 14:16 bin | 16:18 n_cigar_op | 18:20 flag | 20:24 l_seq
+    | 24:28 next_refID | 28:32 next_pos | 32:36 tlen
+    | 36+ read_name NUL | cigar u32[n_cigar] | seq (l_seq+1)//2
+    | qual l_seq | aux
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
+from hadoop_bam_tpu.utils.errors import CorruptDataError, PlanError
+
+_CLIP_OPS = frozenset((4, 5))            # S, H [SPEC cigar ops]
+_REF_CONSUME = frozenset((0, 2, 3, 7, 8))   # M D N = X
+_U32 = 0xFFFFFFFF
+
+# sum-of-base-qualities floor (Picard's DuplicateScoringStrategy):
+# qualities below this never count toward the winner score
+SCORE_MIN_QUAL = 15
+
+LIBRARY_MODES = ("none", "rg")
+
+
+def _u16(rec, off: int) -> int:
+    return int.from_bytes(rec[off:off + 2], "little")
+
+
+def _i32(rec, off: int) -> int:
+    return int.from_bytes(rec[off:off + 4], "little", signed=True)
+
+
+def _cigar_walk(rec) -> Tuple[int, int, int]:
+    """(leading_clip, trailing_clip, ref_len) from the packed CIGAR.
+
+    Leading = the maximal S/H prefix, trailing = the maximal S/H suffix
+    (an all-clip CIGAR counts its total on both sides — the device
+    kernel's masked prefix/suffix products do the same); ref_len falls
+    back to l_seq for CIGAR-less records (the '*' convention,
+    utils/fixmate.py::_alen)."""
+    n_cigar = _u16(rec, 16)
+    if n_cigar == 0:
+        return 0, 0, _i32(rec, 20)
+    off = 36 + rec[12]
+    ops = []
+    for k in range(n_cigar):
+        v = int.from_bytes(rec[off + 4 * k:off + 4 * k + 4], "little")
+        ops.append((v & 0xF, v >> 4))
+    lead = 0
+    for op, ln in ops:
+        if op not in _CLIP_OPS:
+            break
+        lead += ln
+    trail = 0
+    for op, ln in reversed(ops):
+        if op not in _CLIP_OPS:
+            break
+        trail += ln
+    ref_len = sum(ln for op, ln in ops if op in _REF_CONSUME)
+    return lead, trail, ref_len
+
+
+def record_score(rec) -> int:
+    """Sum of base qualities >= SCORE_MIN_QUAL — the best-of-duplicate
+    selection key.  Missing-quality bytes (0xFF) count at face value on
+    both paths, keeping the mesh/oracle contract exact."""
+    l_read_name = rec[12]
+    n_cigar = _u16(rec, 16)
+    l_seq = _i32(rec, 20)
+    qual_off = 36 + l_read_name + 4 * n_cigar + (l_seq + 1) // 2
+    if qual_off + l_seq > len(rec):
+        raise CorruptDataError(
+            f"record qual array ([{qual_off}:{qual_off + l_seq}]) "
+            f"overruns the {len(rec)}-byte record")
+    return sum(q for q in rec[qual_off:qual_off + l_seq]
+               if q >= SCORE_MIN_QUAL)
+
+
+def record_signature(rec, lib: int) -> Optional[Tuple[int, int, int,
+                                                      int, int]]:
+    """The 5-tuple duplicate signature of one record, or None when the
+    record is ineligible (unmapped / secondary / supplementary).
+
+    ``(k0, k1, k2, k3, k4)`` — exactly the five uint32 key columns the
+    device kernel sorts on (prep/markdup.py), so the two definitions
+    cannot diverge silently:
+
+    - k0: refid;
+    - k1: unclipped 5' position + 1 in uint32 wraparound (the sort-key
+      convention, parallel/mesh_sort.py::_keys_of);
+    - k2: ``lib << 3 | mate_reverse << 2 | orientation << 1 |
+      pair_class``;
+    - k3/k4: mate key ``(next_refID + 1, next_pos + 1)`` as uint32,
+      zero for fragments (pair_class 0: unpaired, or mate unmapped).
+    """
+    flag = _u16(rec, 18)
+    if flag & 0x904:                 # unmapped/secondary/supplementary
+        return None
+    pos = _i32(rec, 8)
+    lead, trail, ref_len = _cigar_walk(rec)
+    orient = (flag >> 4) & 1
+    if orient:
+        upos = pos + ref_len - 1 + trail
+    else:
+        upos = pos - lead
+    pair = 1 if (flag & 0x1) and not (flag & 0x8) else 0
+    mate_rev = ((flag >> 5) & 1) if pair else 0
+    k3 = ((_i32(rec, 24) + 1) & _U32) if pair else 0
+    k4 = ((_i32(rec, 28) + 1) & _U32) if pair else 0
+    k0 = _i32(rec, 4) & _U32
+    k1 = (upos + 1) & _U32
+    k2 = ((lib << 3) | (mate_rev << 2) | (orient << 1) | pair) & _U32
+    return (k0, k1, k2, k3, k4)
+
+
+# ---------------------------------------------------------------------------
+# library resolution (--library-from)
+# ---------------------------------------------------------------------------
+
+def library_map(header, mode: str) -> Optional[Dict[bytes, int]]:
+    """RG id -> small integer library id, or None when ``mode`` is
+    "none" (every record in one anonymous library 0).
+
+    Libraries are the sorted unique ``@RG LB:`` values, numbered from
+    1; read groups without LB — and records without an RG tag — fall
+    into library 0.  Sorting makes the numbering a pure function of the
+    header, so the mesh and oracle paths (and any shard order) agree."""
+    if mode == "none":
+        return None
+    if mode != "rg":
+        raise PlanError(f"unknown library mode {mode!r}; expected one "
+                        f"of {LIBRARY_MODES}")
+    rg_lb: Dict[bytes, bytes] = {}
+    for line in header.text.splitlines():
+        if not line.startswith("@RG"):
+            continue
+        m_id = re.search(r"\tID:([^\t\n]+)", line)
+        m_lb = re.search(r"\tLB:([^\t\n]+)", line)
+        if m_id and m_lb:
+            rg_lb[m_id.group(1).encode()] = m_lb.group(1).encode()
+    libs = {lb: i + 1 for i, lb in enumerate(sorted(set(rg_lb.values())))}
+    return {rg: libs[lb] for rg, lb in rg_lb.items()}
+
+
+def _aux_rg(rec) -> Optional[bytes]:
+    """The RG:Z tag value from a record's aux block, or None."""
+    l_read_name = rec[12]
+    n_cigar = _u16(rec, 16)
+    l_seq = _i32(rec, 20)
+    off = 36 + l_read_name + 4 * n_cigar + (l_seq + 1) // 2 + l_seq
+    end = len(rec)
+    while off + 3 <= end:
+        tag = bytes(rec[off:off + 2])
+        typ = rec[off + 2]
+        off += 3
+        if typ in (0x5A, 0x48):                       # Z, H
+            nul = rec.find(b"\x00", off) if isinstance(rec, bytes) \
+                else bytes(rec).find(b"\x00", off)
+            if nul < 0:
+                raise CorruptDataError(
+                    f"unterminated {chr(typ)}-type aux tag "
+                    f"{tag!r} in record")
+            if tag == b"RG" and typ == 0x5A:
+                return bytes(rec[off:nul])
+            off = nul + 1
+        elif typ == 0x42:                             # B: array
+            if off + 5 > end:
+                raise CorruptDataError("truncated B-type aux tag")
+            sub = rec[off]
+            count = int.from_bytes(rec[off + 1:off + 5], "little")
+            size = {0x63: 1, 0x43: 1, 0x73: 2, 0x53: 2,
+                    0x69: 4, 0x49: 4, 0x66: 4}.get(sub)
+            if size is None:
+                raise CorruptDataError(
+                    f"unknown B-array subtype {sub:#x} in aux block")
+            off += 5 + size * count
+        else:
+            size = {0x41: 1, 0x63: 1, 0x43: 1, 0x73: 2, 0x53: 2,
+                    0x69: 4, 0x49: 4, 0x66: 4}.get(typ)
+            if size is None:
+                raise CorruptDataError(
+                    f"unknown aux tag type {typ:#x} in record")
+            off += size
+    return None
+
+
+def library_column(data: np.ndarray, offs: np.ndarray,
+                   lens: np.ndarray,
+                   rg_to_lib: Optional[Dict[bytes, int]]) -> np.ndarray:
+    """Per-record uint32 library ids for a decoded span — the host-side
+    column the fused pipeline ships alongside the row tile (library
+    identity lives in a text tag + header join; everything positional
+    in the signature is computed on device)."""
+    n = int(offs.size)
+    out = np.zeros(n, np.uint32)
+    if rg_to_lib is None or not n:
+        return out
+    mv = data.tobytes()
+    base = offs.astype(np.int64)
+    for i in range(n):
+        rec = mv[int(base[i]):int(base[i] + lens[i])]
+        rg = _aux_rg(rec)
+        if rg is not None:
+            out[i] = rg_to_lib.get(rg, 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the oracle pipeline
+# ---------------------------------------------------------------------------
+
+def select_duplicates(sigs: List[Optional[Tuple]],
+                      scores: List[int]) -> np.ndarray:
+    """The best-of-duplicate selection, host reference: one uint8 dup
+    bit per input record.  Winner per signature group = max score, ties
+    to the lowest global input index; ineligible records (signature
+    None) never participate."""
+    groups: Dict[Tuple, List[int]] = {}
+    for gidx, sig in enumerate(sigs):
+        if sig is not None:
+            groups.setdefault(sig, []).append(gidx)
+    dup = np.zeros(len(sigs), np.uint8)
+    for members in groups.values():
+        if len(members) < 2:
+            continue
+        winner = min(members, key=lambda g: (-scores[g], g))
+        for g in members:
+            if g != winner:
+                dup[g] = 1
+    return dup
+
+
+def patch_flag(rec: bytes, dup: bool) -> bytes:
+    """Clear-and-rederive the duplicate flag (0x400) in a raw record."""
+    flag = int.from_bytes(rec[18:20], "little")
+    nf = (flag & ~0x400) | (0x400 if dup else 0)
+    if nf == flag:
+        return rec
+    return rec[:18] + nf.to_bytes(2, "little") + rec[20:]
+
+
+def markdup_bam_oracle(input_path: str, output_path: str, *,
+                       config: HBamConfig = DEFAULT_CONFIG,
+                       remove_duplicates: bool = False,
+                       library_from: str = "none") -> int:
+    """Mark (or remove) duplicates serially: decode every record, build
+    signatures/scores, select winners, coordinate-sort, patch flags
+    during the write.  Returns the record count written.
+
+    Holds the whole file's records in memory — this is the VALIDATION
+    oracle the fused mesh pipeline is byte-compared against, not the
+    scalable path (``prep.pipeline.markdup_bam_mesh`` is)."""
+    from hadoop_bam_tpu.api.dataset import open_bam
+    from hadoop_bam_tpu.utils.sort import _sorted_header
+    from hadoop_bam_tpu.write import write_bam_records
+
+    if library_from not in LIBRARY_MODES:
+        raise PlanError(f"unknown --library-from {library_from!r}; "
+                        f"expected one of {LIBRARY_MODES}")
+    ds = open_bam(input_path, config)
+    rg_to_lib = library_map(ds.header, library_from)
+    recs: List[bytes] = []
+    sigs: List[Optional[Tuple]] = []
+    scores: List[int] = []
+    for batch in ds.batches():
+        for i in range(len(batch)):
+            rec = batch.record_bytes(i)
+            lib = 0
+            if rg_to_lib is not None:
+                rg = _aux_rg(rec)
+                lib = rg_to_lib.get(rg, 0) if rg is not None else 0
+            recs.append(rec)
+            sigs.append(record_signature(rec, lib))
+            scores.append(record_score(rec))
+    dup = select_duplicates(sigs, scores)
+
+    # coordinate order with the input index as the tie key — exactly
+    # the mesh exchange's (hi, lo, gidx) sort
+    def key(gidx: int) -> Tuple[int, int, int]:
+        rec = recs[gidx]
+        refid = _i32(rec, 4)
+        hi = _U32 if refid < 0 else refid
+        lo = (_i32(rec, 8) + 1) & _U32
+        return (hi, lo, gidx)
+
+    order = sorted(range(len(recs)), key=key)
+    out_header = _sorted_header(ds.header, by_name=False)
+
+    def chunks() -> Iterator[Tuple[bytes, np.ndarray]]:
+        buf: List[bytes] = []
+        offsets: List[int] = []
+        pos = 0
+        for gidx in order:
+            if remove_duplicates and dup[gidx]:
+                continue
+            rec = patch_flag(recs[gidx], bool(dup[gidx]))
+            buf.append(rec)
+            offsets.append(pos)
+            pos += len(rec)
+            if pos >= (8 << 20):
+                yield b"".join(buf), np.asarray(offsets, np.int64)
+                buf, offsets, pos = [], [], 0
+        if buf:
+            yield b"".join(buf), np.asarray(offsets, np.int64)
+
+    return write_bam_records(output_path, out_header, chunks(),
+                             config=config).records

@@ -448,6 +448,9 @@ def cmd_explain(args) -> int:
         plan = builders.variant_stats_plan(args.path, cfg)
     elif args.op == "cohort":
         plan = builders.cohort_plan(args.path, cfg)
+    elif args.op == "mkdup":
+        plan = builders.mkdup_plan(args.path, args.path + ".mkdup.bam",
+                                   cfg)
     elif args.op == "serve-tile":
         if args.region:
             # the realistic shape: resolve the region through the index
@@ -594,8 +597,39 @@ def cmd_sort(args) -> int:
 def cmd_fixmate(args) -> int:
     from hadoop_bam_tpu.utils.fixmate import fixmate_bam
 
-    n = fixmate_bam(args.input, args.output)
+    n = fixmate_bam(args.input, args.output, config=_write_config(args))
     print(f"wrote {args.output} ({n} records)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# mkdup
+# ---------------------------------------------------------------------------
+
+def cmd_mkdup(args) -> int:
+    """The fused preprocessing pipeline: read -> mesh sort exchange ->
+    duplicate marking -> flag-patched indexed write, one pass, driven
+    through the plan IR (`hbam explain mkdup` shows the compiled
+    plan)."""
+    if args.run_records is not None and args.run_records <= 0:
+        raise SystemExit("--run-records must be positive")
+    cfg = _write_config(args)
+    journal = None
+    if getattr(args, "journal", None) is not None:
+        from hadoop_bam_tpu.jobs import journal_path_for
+        journal = _journal_arg(args, journal_path_for(args.output))
+    from hadoop_bam_tpu.plan import builders
+    from hadoop_bam_tpu.plan.executor import execute
+
+    plan = builders.mkdup_plan(args.input, args.output, cfg,
+                               remove_duplicates=args.remove_duplicates,
+                               library_from=args.library_from)
+    n = execute(plan, config=cfg, round_records=args.run_records,
+                journal_path=journal)
+    what = "removed" if args.remove_duplicates else "marked"
+    extra = f", journal {journal}" if journal else ""
+    print(f"wrote {args.output} ({n} records, duplicates {what}, "
+          f"coordinate, fused mesh{extra})")
     return 0
 
 
@@ -1411,7 +1445,53 @@ def build_parser() -> argparse.ArgumentParser:
     f = sub.add_parser("fixmate", help="fill mate fields on name-grouped BAM")
     f.add_argument("input")
     f.add_argument("output")
+    f.add_argument("--compress-level", type=int, default=None,
+                   metavar="0-9",
+                   help="BGZF deflate level for the output (default "
+                        "config write_compress_level = 6)")
+    f.add_argument("--no-write-index", action="store_true",
+                   help="skip the index sidecars the write path "
+                        "co-writes (name-grouped output is rarely "
+                        "coordinate-compatible; the sidecars are only "
+                        "meaningful when it is)")
     f.set_defaults(fn=cmd_fixmate, uses_device=False)
+
+    md = sub.add_parser(
+        "mkdup",
+        help="mark (or remove) duplicates, fused: read -> mesh sort "
+             "exchange -> on-device signature markdup -> flag-patched "
+             "indexed write, one pass over the records")
+    md.add_argument("input")
+    md.add_argument("output")
+    md.add_argument("--remove-duplicates", action="store_true",
+                    help="drop duplicate records instead of setting "
+                         "their 0x400 flag")
+    md.add_argument("--library-from", choices=("none", "rg"),
+                    default="none",
+                    help="library component of the duplicate signature: "
+                         "'none' (one anonymous library) or 'rg' (join "
+                         "each record's RG:Z tag to its @RG LB header "
+                         "library)")
+    md.add_argument("--run-records", type=int, default=None,
+                    help="records per device per exchange round (the "
+                         "spill shuffle's memory bound; default "
+                         "1000000)")
+    md.add_argument("--compress-level", type=int, default=None,
+                    metavar="0-9",
+                    help="BGZF deflate level for the output (default "
+                         "config write_compress_level = 6)")
+    md.add_argument("--no-write-index", action="store_true",
+                    help="skip the BAI + splitting-index sidecars the "
+                         "write path co-writes with the coordinate-"
+                         "sorted output")
+    md.add_argument("--journal", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="crash-safe run: record per-round spills, the "
+                         "duplicate bitmap, and per-shard writes to an "
+                         "fsync'd journal (default PATH: "
+                         "<output>.hbam-journal) so a killed run "
+                         "resumes via `hbam resume` at stage grain")
+    md.set_defaults(fn=cmd_mkdup, uses_device=True)
 
     q = sub.add_parser("query",
                        help="batched random-access region queries via the "
@@ -1501,7 +1581,8 @@ def build_parser() -> argparse.ArgumentParser:
              "plane decision (which plane, and why each rejected "
              "plane failed its gate)")
     ex.add_argument("op", choices=["flagstat", "seq-stats", "vcf-stats",
-                                   "query", "cohort", "serve-tile"])
+                                   "query", "cohort", "serve-tile",
+                                   "mkdup"])
     ex.add_argument("path", help="input file (BAM/VCF/BCF) or cohort "
                                  "manifest JSON")
     ex.add_argument("--region", default=None,

@@ -19,7 +19,9 @@ returns them — see ops/unpack_bam.py::FIXED_FIELDS):
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Iterator, Optional
+
+import numpy as np
 
 from hadoop_bam_tpu.config import DEFAULT_CONFIG, HBamConfig
 
@@ -95,33 +97,57 @@ def fixmate_bam(input_path: str, output_path: str, *,
     other primary, not its own split alignment — samtools fixmate
     contract) and pass through untouched, as does everything unpaired.
     Returns the record count.
+
+    Output goes through ``write_bam_records`` so the write config
+    (``write_compress_level``, ``write_index_kinds``) and the co-written
+    index sidecars apply, same as every other verb.  Caveat: a BAI
+    sidecar is only meaningful when the queryname-grouped input happens
+    to also be coordinate-compatible; ``--no-write-index`` skips it.
     """
     from hadoop_bam_tpu.api.dataset import open_bam
-    from hadoop_bam_tpu.formats.bamio import BamWriter
+    from hadoop_bam_tpu.write import write_bam_records
 
     ds = open_bam(input_path, config)
     n = 0
-    pending: Optional[bytearray] = None
-    pending_name = b""
-    with BamWriter(output_path, ds.header) as w:
+
+    def fixed_records() -> "Iterator[bytes]":
+        nonlocal n
+        pending: Optional[bytearray] = None
+        pending_name = b""
         for batch in ds.batches():
             for i in range(len(batch)):
                 rec = bytearray(batch.record_bytes(i))
                 n += 1
                 if _u16(rec, 18) & 0x900:    # secondary/supplementary
-                    w.write_record_bytes(bytes(rec))
+                    yield bytes(rec)
                     continue
                 name = _qname(rec)
                 if (pending is not None and name == pending_name
                         and _u16(pending, 18) & 0x1):
                     fix_pair(pending, rec)
-                    w.write_record_bytes(bytes(pending))
-                    w.write_record_bytes(bytes(rec))
+                    yield bytes(pending)
+                    yield bytes(rec)
                     pending = None
                 else:
                     if pending is not None:
-                        w.write_record_bytes(bytes(pending))
+                        yield bytes(pending)
                     pending, pending_name = rec, name
         if pending is not None:
-            w.write_record_bytes(bytes(pending))
+            yield bytes(pending)
+
+    def chunks():
+        buf = []
+        offsets = []
+        pos = 0
+        for rec in fixed_records():
+            buf.append(rec)
+            offsets.append(pos)
+            pos += len(rec)
+            if pos >= (8 << 20):
+                yield b"".join(buf), np.asarray(offsets, np.int64)
+                buf, offsets, pos = [], [], 0
+        if buf:
+            yield b"".join(buf), np.asarray(offsets, np.int64)
+
+    write_bam_records(output_path, ds.header, chunks(), config=config)
     return n

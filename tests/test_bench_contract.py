@@ -44,6 +44,7 @@ def _fill_state(bench, n_notes=6):
         ("sort_records_per_sec_mesh", 47368.1, "records/s", 6.6),
         ("resume_overhead_pct", 1.4, "%", None),
         ("sort_write_mb_per_sec", 38.52, "MB/s", 0.97),
+        ("mkdup_mb_per_sec", 31.04, "MB/s", None),
         ("seq_pallas_kernel_bases_per_sec", 1.9e9, "bases/s", 12.2),
         ("cigar_pileup_kernel_records_per_sec", 8.1e6, "records/s", None),
         ("mesh_sort_device_sort_keys_per_sec", 5.4e7, "keys/s", None),
@@ -99,6 +100,16 @@ def _fill_state(bench, n_notes=6):
             row.update(serial_mb_per_sec=39.7, write_deflate_share=0.41,
                        records=100000, output_bytes=9_100_000,
                        byte_identical_to_serial=True)
+        if m == "mkdup_mb_per_sec":
+            # the r22 fused preprocessing row: fused vs staged arms,
+            # per-stage wall shares, oracle byte identity — full row
+            # only; the compact line keeps the fused MB/s
+            row.update(vs_staged=1.12, staged_mb_per_sec=27.7,
+                       stage_wall_shares={"sort": 0.58, "markdup": 0.07,
+                                          "write": 0.31},
+                       records=100000, duplicates_marked=1834,
+                       output_bytes=9_100_000,
+                       byte_identical_to_oracle=True)
         if m == "obs_overhead_pct":
             row.update(instrumented_s=0.1301, null_s=0.1284)
         if m == "plan_overhead_pct":
@@ -296,6 +307,18 @@ def test_full_snapshot_keeps_detail_on_progress_lines(bench):
     assert 0.0 <= sw["write_deflate_share"] <= 1.0
     assert sw["byte_identical_to_serial"] is True
     assert sw["records"] > 0 and sw["output_bytes"] > 0
+    # r22: the fused preprocessing row pins the fused-vs-staged arm
+    # pair, per-stage wall shares over the three prep spans, and byte
+    # identity against the serial markdup oracle — shape only (the
+    # ratio is host-dependent), compact line keeps the fused MB/s
+    mk = by_metric["mkdup_mb_per_sec"]
+    assert mk["staged_mb_per_sec"] > 0
+    assert set(mk["stage_wall_shares"]) == {"sort", "markdup", "write"}
+    assert all(0.0 <= v <= 1.0
+               for v in mk["stage_wall_shares"].values())
+    assert mk["byte_identical_to_oracle"] is True
+    assert mk["records"] > 0 and mk["output_bytes"] > 0
+    assert mk["duplicates_marked"] >= 0
     # r21: the device-plane families row pins per-arm host-oracle
     # identity and the ~0 host-decode wall share on every device arm —
     # full row only, the compact line keeps the payload-arm rate

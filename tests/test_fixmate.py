@@ -205,3 +205,49 @@ def test_streaming_never_materializes(tmp_path, monkeypatch):
     assert all(r.rnext == "=" for r in got)
     pnext_ok = sum(1 for r in got if r.pnext > 0)
     assert pnext_ok == 4000
+
+
+def test_cold_query_after_fixmate(tmp_path, monkeypatch):
+    """ISSUE 20 satellite pin: fixmate output routes through
+    write_bam_records, so --compress-level applies, sidecars are
+    co-written, and (when the name-grouped input happens to be
+    coordinate-compatible, as here) the result cold-opens in
+    QueryEngine with NO rescan; --no-write-index suppresses the
+    sidecars."""
+    import os
+
+    import hadoop_bam_tpu.split.bai as bai_mod
+    from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+    from hadoop_bam_tpu.tools.cli import main
+
+    # name-adjacent pairs laid out in ascending coordinates: fixmate's
+    # name-grouped requirement and the BAI's coordinate requirement
+    # hold at the same time
+    recs = []
+    for i in range(40):
+        recs += make_pair(f"p{i:03d}", 100 * i + 1, 100 * i + 41)
+    src = str(tmp_path / "in.bam")
+    write_bam(src, recs)
+
+    out = str(tmp_path / "fixed.bam")
+    main(["fixmate", src, out, "--compress-level", "1"])
+    assert os.path.exists(out + ".bai")        # sidecar co-written
+
+    def no_rescan(*a, **kw):
+        raise AssertionError("build_bai called — the co-written "
+                             "sidecar should have served the query")
+    monkeypatch.setattr(bai_mod, "build_bai", no_rescan)
+
+    res = QueryEngine().query_records(
+        [QueryRequest(out, "chr1:1-500")])
+    got = [r for r in res[0].records]
+    assert sorted({r.qname for r in got}) \
+        == [f"p{i:03d}" for i in range(5)]
+    # and the mate fields really were fixed before the write
+    assert all(r.rnext == "=" and r.pnext > 0 and r.tlen != 0
+               for r in got)
+
+    out2 = str(tmp_path / "fixed_noidx.bam")
+    main(["fixmate", src, out2, "--no-write-index"])
+    assert not os.path.exists(out2 + ".bai")
+    assert read_fields(out2) == read_fields(out)

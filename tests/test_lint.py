@@ -1772,3 +1772,152 @@ def test_lint_cache_replay_and_invalidation(tmp_path, capsys,
     assert lint_main(["--root", root, "--baseline", bl,
                       "--no-cache"]) == 0
     assert cache.stat().st_mtime_ns == stamp
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: prep/ joins the ET3xx / JS1xx / TH1xx scopes
+# ---------------------------------------------------------------------------
+
+_PREP_ET_BAD = '''
+def signature(rec):
+    if len(rec) < 36:
+        raise ValueError("record shorter than fixed header")  # ET301
+'''
+
+_PREP_ET_GOOD = '''
+from hadoop_bam_tpu.utils.errors import CorruptDataError
+
+
+def signature(rec):
+    if len(rec) < 36:
+        raise CorruptDataError("record shorter than fixed header")
+'''
+
+
+def test_et_scope_covers_prep_boundaries():
+    """ISSUE 20 scope extension: the fused preprocessing plane's
+    modules classify faults for retry/quarantine policy — a bare
+    ValueError from the signature walk would retry corrupt bytes."""
+    for mod in ("hadoop_bam_tpu/prep/oracle.py",
+                "hadoop_bam_tpu/prep/markdup.py",
+                "hadoop_bam_tpu/prep/pipeline.py"):
+        findings = lint_sources({mod: _PREP_ET_BAD}, only=["taxonomy"])
+        assert rules_of(findings) == {"ET301"}, mod
+        assert lint_sources({mod: _PREP_ET_GOOD},
+                            only=["taxonomy"]) == [], mod
+    # prep's package __init__ is not a policy boundary
+    assert lint_sources({"hadoop_bam_tpu/prep/__init__.py":
+                         _PREP_ET_BAD}, only=["taxonomy"]) == []
+
+
+_PREP_JS_BAD = '''
+import os
+
+
+def publish_bitmap(spill_dir, bits):
+    tmp = os.path.join(spill_dir, "dupbits." + str(os.getpid()))
+    with open(tmp, "wb") as f:                # JS102: pid-derived name
+        f.write(bits)
+    os.replace(tmp, os.path.join(spill_dir, "dupbits.u8"))  # JS101
+'''
+
+_PREP_JS_GOOD = '''
+import os
+
+
+def publish_bitmap(jr, spill_dir, bits, size, crc):
+    tmp = os.path.join(spill_dir, "dupbits.u8.tmp")
+    with open(tmp, "wb") as f:
+        f.write(bits)
+    final = os.path.join(spill_dir, "dupbits.u8")
+    os.replace(tmp, final)
+    jr.unit_done("markdup", 0, path=final, size=size, crc=crc)
+'''
+
+
+def test_js_scope_covers_prep_pipeline():
+    """ISSUE 20: the fused pipeline publishes spill runs, column
+    sidecars and the duplicate bitmap — JS1xx polices it like the
+    write path (deterministic temp names, journaled publication)."""
+    findings = lint_sources(
+        {"hadoop_bam_tpu/prep/pipeline.py": _PREP_JS_BAD},
+        only=["jobsafety"])
+    assert rules_of(findings) == {"JS101", "JS102"}
+    # the journaled-commit twin is the blessed shape
+    assert lint_sources({"hadoop_bam_tpu/prep/pipeline.py":
+                         _PREP_JS_GOOD}, only=["jobsafety"]) == []
+    # the same bad code outside the crash-safe scope is not JS-scoped
+    assert lint_sources({"hadoop_bam_tpu/tools/other.py": _PREP_JS_BAD},
+                        only=["jobsafety"]) == []
+
+
+_PREP_TH_BAD = '''
+import threading
+
+
+class StepCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps = {}
+        self._t = threading.Thread(target=self._warm, daemon=True)
+        self._t.start()
+
+    def _warm(self):
+        self._steps["warm"] = 1        # TH101: warmer side, no lock
+
+    def get(self, key):
+        self._steps[key] = object()    # TH101: caller side, no lock
+'''
+
+_PREP_TH_GOOD = '''
+import threading
+
+
+class StepCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps = {}
+        self._t = threading.Thread(target=self._warm, daemon=True)
+        self._t.start()
+
+    def _warm(self):
+        with self._lock:
+            self._steps["warm"] = 1
+
+    def get(self, key):
+        with self._lock:
+            self._steps[key] = object()
+'''
+
+
+def test_th_scope_covers_prep():
+    """ISSUE 20: a warmed compile-step cache in prep/ shared with a
+    background thread gets the same TH1xx policing as serve/."""
+    findings = lint_sources(
+        {"hadoop_bam_tpu/prep/steps.py": _PREP_TH_BAD},
+        only=["threadsafety"])
+    assert rules_of(findings) == {"TH101"}
+    assert lint_sources({"hadoop_bam_tpu/prep/steps.py":
+                         _PREP_TH_GOOD}, only=["threadsafety"]) == []
+
+
+def test_prep_repo_modules_lint_clean():
+    """The shipped prep/ modules themselves pass their new scopes —
+    and the committed baseline stays EMPTY (no grandfathered debt)."""
+    import json as _json
+    import os as _os
+
+    root = _os.path.join(_os.path.dirname(__file__), _os.pardir)
+    sources = {}
+    for name in ("oracle.py", "markdup.py", "pipeline.py",
+                 "__init__.py"):
+        rel = f"hadoop_bam_tpu/prep/{name}"
+        with open(_os.path.join(root, rel)) as f:
+            sources[rel] = f.read()
+    findings = run_analyzers(
+        Project.from_sources(sources),
+        only=["taxonomy", "jobsafety", "threadsafety"])
+    assert findings == []
+    with open(_os.path.join(root, "hadoop_bam_tpu", "analysis",
+                            "baseline.json")) as f:
+        assert _json.load(f)["findings"] == []

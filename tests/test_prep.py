@@ -1,0 +1,405 @@
+"""Fused preprocessing plane tests (hadoop_bam_tpu/prep/): mesh
+duplicate marking byte-validated against the serial host oracle over a
+fuzz corpus (unmapped / mate-unmapped / secondary / supplementary,
+S/H-clipped 5' ends, score ties), tie-break determinism across shard
+counts and round sizes, byte-flip corruption classing, SIGKILL-and-
+resume at every fused-stage boundary, and the cold QueryEngine open of
+the output with no rescan.
+
+The kill tests are REAL (same protocol as test_jobs.py): a subprocess
+running the real fused pipeline SIGKILLs itself after the Nth committed
+journal unit of the targeted stage — mid-sort round, mid-markdup,
+mid-write part — and the parent resumes from the journal and compares
+bytes against the uninterrupted serial oracle.
+"""
+import dataclasses
+import os
+import random
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+from hadoop_bam_tpu.api.dataset import open_bam
+from hadoop_bam_tpu.config import DEFAULT_CONFIG
+from hadoop_bam_tpu.formats.bam import SAMHeader
+from hadoop_bam_tpu.formats.bamio import BamWriter
+from hadoop_bam_tpu.formats.sam import SamRecord
+from hadoop_bam_tpu.jobs import JobJournal, journal_path_for
+from hadoop_bam_tpu.parallel.mesh import make_mesh
+from hadoop_bam_tpu.prep import markdup_bam_mesh, markdup_bam_oracle
+from hadoop_bam_tpu.utils.errors import CorruptDataError, PlanError
+from hadoop_bam_tpu.utils.metrics import MetricsContext
+
+pytestmark = pytest.mark.prep
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+NOSYNC = dataclasses.replace(DEFAULT_CONFIG, journal_fsync=False)
+
+# @RG lines: rg0/rg2 share a library, rg1 is its own — so library_from=
+# "rg" groups differently from "none"; records tagged rg3 (absent from
+# the header) and untagged records both take the "unknown library" slot
+_HDR_TEXT = (
+    "@HD\tVN:1.6\tSO:coordinate\n"
+    "@SQ\tSN:chr1\tLN:1000000\n"
+    "@SQ\tSN:chr2\tLN:2000000\n"
+    "@RG\tID:rg0\tLB:libA\tSM:s0\n"
+    "@RG\tID:rg1\tLB:libB\tSM:s0\n"
+    "@RG\tID:rg2\tLB:libA\tSM:s0\n")
+
+# leading/trailing S and H clips move the unclipped 5' end on both
+# strands; D/N/I vary the reference span without changing it
+_CIGARS = ["30M", "5S25M", "25M5S", "3H27M", "27M3H", "4S22M4H",
+           "10M2D8M3N12M", "16M2I12M"]
+# mapped fwd/rev, proper pairs both orientations, unmapped, mate-
+# unmapped primaries, secondary (both strands), supplementary
+_FLAGS = [0, 16, 99, 147, 83, 163, 4, 256, 272, 2048, 73, 137]
+
+
+def _qlen(cigar: str) -> int:
+    return sum(int(n) for n, op in re.findall(r"(\d+)([MIDNSHP=X])",
+                                              cigar) if op in "MIS=X")
+
+
+def fuzz_header() -> SAMHeader:
+    return SAMHeader(text=_HDR_TEXT, ref_names=["chr1", "chr2"],
+                     ref_lengths=[1_000_000, 2_000_000])
+
+
+def make_fuzz_records(header, n, seed):
+    """Duplicate-heavy fuzz corpus: positions drawn from a small grid so
+    signature collisions are frequent, quals drawn from four flat levels
+    so score TIES are frequent (the gidx tie-break must decide)."""
+    rng = random.Random(seed)
+    recs = []
+    for i in range(n):
+        flag = rng.choice(_FLAGS)
+        cigar = rng.choice(_CIGARS)
+        rid = rng.randrange(2)
+        pos = 1 + rng.randrange(30) * 53
+        q = rng.choice((10, 20, 30, 40))
+        tags = []
+        if rng.random() < 0.8:
+            tags.append(("RG", "Z", f"rg{rng.randrange(4)}"))
+        if flag & 0x4:
+            # half placed-unmapped (coordinate kept), half unplaced
+            placed = rng.random() < 0.5
+            rname = header.ref_names[rid] if placed else "*"
+            p, cg, l = (pos if placed else 0), "*", 20
+        else:
+            rname, p, cg = header.ref_names[rid], pos, cigar
+            l = _qlen(cigar)
+        qual = "*" if rng.random() < 0.1 else chr(33 + q) * l
+        recs.append(SamRecord(
+            qname=f"q{i:05d}", flag=flag, rname=rname, pos=p,
+            mapq=rng.randrange(61), cigar=cg,
+            rnext=("=" if flag & 0x1 else "*"),
+            pnext=(1 + rng.randrange(20) * 31 if flag & 0x1 else 0),
+            tlen=0, seq="A" * l, qual=qual, tags=tags))
+    rng.shuffle(recs)
+    return recs
+
+
+@pytest.fixture(scope="module")
+def prep_fixture(tmp_path_factory):
+    """The fuzz BAM plus serial-oracle outputs for all option pairs."""
+    d = tmp_path_factory.mktemp("prep")
+    header = fuzz_header()
+    recs = make_fuzz_records(header, 400, seed=7)
+    src = str(d / "in.bam")
+    with BamWriter(src, header) as w:
+        for r in recs:
+            w.write_sam_record(r)
+    oracle = {}
+    for rm in (False, True):
+        for lf in ("none", "rg"):
+            out = str(d / f"oracle_{int(rm)}_{lf}.bam")
+            n = markdup_bam_oracle(src, out, config=DEFAULT_CONFIG,
+                                   remove_duplicates=rm,
+                                   library_from=lf)
+            oracle[(rm, lf)] = {"path": out,
+                                "bytes": open(out, "rb").read(),
+                                "records": n}
+    return {"dir": d, "header": header, "src": src,
+            "n_input": len(recs), "oracle": oracle}
+
+
+def _read_flags(path):
+    ds = open_bam(path)
+    return [SamRecord.from_line(b.to_sam_line(i)).flag
+            for b in ds.batches() for i in range(len(b))]
+
+
+# ---------------------------------------------------------------------------
+# oracle sanity: the corpus actually exercises the policy
+# ---------------------------------------------------------------------------
+
+def test_fuzz_corpus_marks_and_removes_duplicates(prep_fixture):
+    marked = prep_fixture["oracle"][(False, "none")]
+    removed = prep_fixture["oracle"][(True, "none")]
+    flags = _read_flags(marked["path"])
+    n_dup = sum(1 for f in flags if f & 0x400)
+    assert n_dup > 0                          # collisions happened
+    assert marked["records"] == prep_fixture["n_input"]
+    assert removed["records"] == marked["records"] - n_dup
+    # ineligible classes are never marked
+    assert not any(f & 0x400 for f in flags if f & 0x904)
+    # the removal arm writes no 0x400 flag at all
+    assert not any(f & 0x400 for f in _read_flags(removed["path"]))
+    # rg mode groups by library, so it must differ from flat mode here
+    rg = prep_fixture["oracle"][(False, "rg")]
+    assert rg["bytes"] != marked["bytes"]
+
+
+# ---------------------------------------------------------------------------
+# mesh vs oracle byte identity (the tentpole acceptance bar)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k,rm,lf,rr", [
+    (2, False, "none", 64),
+    (4, False, "rg", 90),
+    (4, True, "none", 1000),
+    (8, True, "rg", 64),
+    (8, False, "none", 150),
+    (2, True, "rg", 150),
+])
+def test_mesh_markdup_matches_oracle(tmp_path, prep_fixture,
+                                     k, rm, lf, rr):
+    out = str(tmp_path / "out.bam")
+    n = markdup_bam_mesh(prep_fixture["src"], out, mesh=make_mesh((k,)),
+                         remove_duplicates=rm, library_from=lf,
+                         round_records=rr)
+    want = prep_fixture["oracle"][(rm, lf)]
+    assert n == want["records"]
+    assert open(out, "rb").read() == want["bytes"]
+    assert not os.path.isdir(out + ".mkdup-spill")
+
+
+def test_tie_breaks_deterministic_across_shards_and_rounds(
+        tmp_path, prep_fixture):
+    """Score ties are broken by global record index, which must not
+    depend on how the mesh shards or how rounds split the input: every
+    (mesh size, round size) lands on the SAME oracle bytes."""
+    want = prep_fixture["oracle"][(False, "none")]["bytes"]
+    for k, rr in ((2, 47), (4, 128), (8, 400)):
+        out = str(tmp_path / f"out_{k}_{rr}.bam")
+        markdup_bam_mesh(prep_fixture["src"], out, mesh=make_mesh((k,)),
+                         round_records=rr)
+        assert open(out, "rb").read() == want, (k, rr)
+
+
+# ---------------------------------------------------------------------------
+# corruption + misconfiguration taxonomy
+# ---------------------------------------------------------------------------
+
+def test_byte_flip_same_error_class_both_paths(tmp_path, prep_fixture):
+    raw = bytearray(open(prep_fixture["src"], "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    bad = str(tmp_path / "bad.bam")
+    with open(bad, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(CorruptDataError):
+        markdup_bam_oracle(bad, str(tmp_path / "o.bam"),
+                           config=DEFAULT_CONFIG)
+    with pytest.raises(CorruptDataError):
+        markdup_bam_mesh(bad, str(tmp_path / "m.bam"),
+                         mesh=make_mesh((2,)))
+
+
+def test_misconfiguration_is_plan_error(tmp_path, prep_fixture):
+    with pytest.raises(PlanError):
+        markdup_bam_oracle(prep_fixture["src"],
+                           str(tmp_path / "o.bam"),
+                           config=DEFAULT_CONFIG, library_from="lb")
+    with pytest.raises(PlanError):
+        markdup_bam_mesh(prep_fixture["src"], str(tmp_path / "m.bam"),
+                         mesh=make_mesh((2,)), library_from="lb")
+    with pytest.raises(PlanError):
+        markdup_bam_mesh(prep_fixture["src"], str(tmp_path / "m.bam"),
+                         mesh=make_mesh((2,)), round_records=0)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL at each fused-stage boundary -> resume, byte-identical
+# ---------------------------------------------------------------------------
+
+_MKDUP_CHILD = """
+    import os, sys
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import signal
+    from hadoop_bam_tpu.jobs import JobJournal
+    kill_kind, kill_after = sys.argv[1], int(sys.argv[2])
+    src, out, jp, rr = (sys.argv[3], sys.argv[4], sys.argv[5],
+                        int(sys.argv[6]))
+    orig = JobJournal.unit_done
+    n = [0]
+    def patched(self, kind, key, **kw):
+        orig(self, kind, key, **kw)
+        if kind == kill_kind:
+            n[0] += 1
+            if n[0] >= kill_after:
+                os.kill(os.getpid(), signal.SIGKILL)
+    JobJournal.unit_done = patched
+    import dataclasses
+    from hadoop_bam_tpu.config import DEFAULT_CONFIG
+    from hadoop_bam_tpu.prep import markdup_bam_mesh
+    cfg = dataclasses.replace(DEFAULT_CONFIG, journal_fsync=False)
+    markdup_bam_mesh(src, out, round_records=rr, journal_path=jp,
+                     config=cfg)
+    raise SystemExit("unreachable: child must have been killed")
+"""
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return env
+
+
+def _run_child(script_body, *args, timeout=240):
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(textwrap.dedent(script_body))
+        script = f.name
+    try:
+        return subprocess.run(
+            [sys.executable, script, *map(str, args)],
+            env=_child_env(), timeout=timeout, capture_output=True,
+            text=True)
+    finally:
+        os.unlink(script)
+
+
+@pytest.mark.parametrize("kill_kind,kill_after", [
+    ("round", 2),        # mid-sort: some rounds spilled, some not
+    ("markdup", 1),      # after the duplicate bitmap, before any part
+    ("shard", 3),        # mid-write: 3 of 8 parts committed
+])
+def test_sigkill_each_stage_resumes_byte_identical(
+        tmp_path, prep_fixture, kill_kind, kill_after):
+    out = str(tmp_path / "out.bam")
+    jp = journal_path_for(out)
+    # spans round up to a multiple of n_dev (8): 400 records at 15 per
+    # round plans 32 spans -> 4 sort rounds, so a kill after round 2
+    # leaves real work on both sides of the boundary
+    rr = 15
+    r = _run_child(_MKDUP_CHILD, kill_kind, kill_after,
+                   prep_fixture["src"], out, jp, rr)
+    assert r.returncode == -signal.SIGKILL, (r.returncode,
+                                             r.stderr[-2000:])
+    st = JobJournal.replay(jp)
+    committed = {k: len([u for (kk, _), u in st.units.items()
+                         if kk == k])
+                 for k in ("round", "markdup", "shard")}
+    assert committed[kill_kind] == kill_after
+    assert os.path.isdir(out + ".mkdup-spill")  # survived the kill
+
+    with MetricsContext() as m:
+        n = markdup_bam_mesh(prep_fixture["src"], out,
+                             round_records=rr, journal_path=jp,
+                             config=NOSYNC)
+    snap = m.snapshot()
+    want = prep_fixture["oracle"][(False, "none")]
+    assert n == want["records"]
+    assert open(out, "rb").read() == want["bytes"]
+    c = snap["counters"]
+    # every unit the child committed is verified and skipped, never
+    # re-run: the journal grains are the resume contract
+    assert c.get("jobs.rounds_skipped", 0) == committed["round"]
+    if committed["round"]:
+        assert c.get("jobs.spans_skipped", 0) > 0
+    assert c.get("jobs.markdup_skipped", 0) == committed["markdup"]
+    assert c.get("jobs.shards_skipped", 0) == committed["shard"]
+    ev = JobJournal.replay(jp).last_event("resume_plan")
+    assert ev is not None \
+        and ev["rounds_skipped"] == committed["round"]
+    assert not os.path.isdir(out + ".mkdup-spill")  # clean on success
+
+
+def test_completed_job_is_a_verified_noop(tmp_path, prep_fixture):
+    out = str(tmp_path / "out.bam")
+    jp = journal_path_for(out)
+    n = markdup_bam_mesh(prep_fixture["src"], out, round_records=90,
+                         journal_path=jp, config=NOSYNC)
+    want = prep_fixture["oracle"][(False, "none")]
+    assert n == want["records"]
+    with MetricsContext() as m:
+        n2 = markdup_bam_mesh(prep_fixture["src"], out,
+                              round_records=90, journal_path=jp,
+                              config=NOSYNC)
+    assert n2 == n
+    assert m.snapshot()["counters"].get("jobs.jobs_skipped") == 1
+    assert open(out, "rb").read() == want["bytes"]
+
+
+# ---------------------------------------------------------------------------
+# cold QueryEngine open — no rescan (the fused-write acceptance bar)
+# ---------------------------------------------------------------------------
+
+def test_mkdup_output_cold_queries_without_rescan(tmp_path,
+                                                  prep_fixture,
+                                                  monkeypatch):
+    from hadoop_bam_tpu.query import QueryEngine, QueryRequest
+    import hadoop_bam_tpu.split.bai as bai_mod
+
+    out = str(tmp_path / "cold.bam")
+    markdup_bam_mesh(prep_fixture["src"], out, mesh=make_mesh((4,)),
+                     round_records=120)
+    oracle_path = prep_fixture["oracle"][(False, "none")]["path"]
+
+    def no_rescan(*a, **kw):
+        raise AssertionError("build_bai called — the co-written "
+                             "sidecar should have served the query")
+    monkeypatch.setattr(bai_mod, "build_bai", no_rescan)
+
+    regions = ["chr1:1-5000", "chr2:1-2000", "chr1:999000-1000000"]
+    res_new = QueryEngine().query_records(
+        [QueryRequest(out, r) for r in regions])
+    res_old = QueryEngine().query_records(
+        [QueryRequest(oracle_path, r) for r in regions])
+    for a, b in zip(res_new, res_old):
+        assert [r.to_line() for r in a.records] \
+            == [r.to_line() for r in b.records]
+    assert sum(len(r.records) for r in res_new) > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI: hbam mkdup / hbam explain mkdup
+# ---------------------------------------------------------------------------
+
+def test_cli_mkdup_matches_oracle(tmp_path, prep_fixture, capsys):
+    from hadoop_bam_tpu.tools.cli import main
+
+    out = str(tmp_path / "cli.bam")
+    main(["mkdup", prep_fixture["src"], out,
+          "--library-from", "rg", "--run-records", "150"])
+    got = capsys.readouterr().out
+    assert got.startswith("wrote ") and "duplicates marked" in got
+    assert open(out, "rb").read() \
+        == prep_fixture["oracle"][(False, "rg")]["bytes"]
+    assert os.path.exists(out + ".bai")       # sidecars co-written
+
+    out2 = str(tmp_path / "cli_rm.bam")
+    main(["mkdup", prep_fixture["src"], out2, "--remove-duplicates",
+          "--run-records", "150"])
+    assert "duplicates removed" in capsys.readouterr().out
+    assert open(out2, "rb").read() \
+        == prep_fixture["oracle"][(True, "none")]["bytes"]
+
+
+def test_cli_explain_mkdup(prep_fixture, capsys):
+    from hadoop_bam_tpu.tools.cli import main
+
+    main(["explain", "mkdup", prep_fixture["src"]])
+    got = capsys.readouterr().out
+    assert "markdup" in got and "sort_exchange" in got \
+        and "flag_patch_write" in got
